@@ -1,0 +1,18 @@
+"""gordo-components-tpu — a TPU-native fleet-scale framework for industrial
+time-series anomaly detection.
+
+Re-implements the full capability surface of the reference project
+``ryanjdillon/gordo-components`` (``gordo_components/`` — see ``SURVEY.md``;
+the reference mount was empty during the survey so citations are at
+file-path granularity) as a brand-new JAX/Flax/pjit-first design:
+
+- the Keras model zoo (``KerasAutoEncoder``, ``KerasLSTMAutoEncoder``,
+  ``KerasLSTMForecast``) becomes Flax modules trained by jitted optax steps,
+- the pod-per-machine Argo fan-out becomes ``vmap``-over-``shard_map`` fleet
+  training on a TPU mesh (see :mod:`gordo_components_tpu.parallel`),
+- the Flask serving layer becomes a werkzeug WSGI app dispatching to
+  jit-compiled batched scoring functions,
+- dataset windowing is a static-shape gather that XLA fuses on-device.
+"""
+
+__version__ = "0.1.0"

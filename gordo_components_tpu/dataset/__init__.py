@@ -1,0 +1,20 @@
+"""Dataset layer: sensor tags, data providers, and time-series assembly.
+
+Mirrors the capability surface of the reference's ``gordo_components/dataset``
+package (SURVEY.md L1/L2) with a TPU-first twist: ``get_data`` produces
+contiguous float32 matrices ready for device transfer, and all windowing is
+done on-device with static shapes (see :mod:`gordo_components_tpu.ops`).
+"""
+
+from .base import GordoBaseDataset
+from .dataset import TimeSeriesDataset, RandomDataset, join_timeseries
+from .sensor_tag import SensorTag, normalize_sensor_tags
+
+__all__ = [
+    "GordoBaseDataset",
+    "TimeSeriesDataset",
+    "RandomDataset",
+    "join_timeseries",
+    "SensorTag",
+    "normalize_sensor_tags",
+]

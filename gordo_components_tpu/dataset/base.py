@@ -1,0 +1,42 @@
+"""Abstract dataset contract.
+
+Reference parity: ``gordo_components/dataset/base.py`` [UNVERIFIED] —
+``get_data() -> (X, y)``, ``get_metadata()``, and dict round-tripping so
+dataset configs embed in fleet YAML and in saved-model metadata.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Tuple
+
+import pandas as pd
+
+from ..utils.config import resolve_config_class
+
+
+class GordoBaseDataset(abc.ABC):
+    @abc.abstractmethod
+    def get_data(self) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        """Return the feature matrix ``X`` and target ``y`` (both time-indexed)."""
+
+    @abc.abstractmethod
+    def get_metadata(self) -> Dict[str, Any]:
+        """Stats recorded into build metadata (per-tag counts, resolution, …)."""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "type": f"{self.__class__.__module__}.{self.__class__.__name__}",
+            **getattr(self, "_init_kwargs", {}),
+        }
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]) -> "GordoBaseDataset":
+        config = dict(config)
+        type_path = config.pop("type", "TimeSeriesDataset")
+        dataset_cls = resolve_config_class(
+            type_path,
+            GordoBaseDataset,
+            default_module="gordo_components_tpu.dataset.dataset",
+        )
+        return dataset_cls(**config)

@@ -1,0 +1,27 @@
+"""Data providers: fetch raw per-tag series for a time range.
+
+Capability parity with the reference's ``gordo_components/dataset/data_provider/``
+[UNVERIFIED — path-level citation]: an abstract provider contract
+(``load_series`` / ``can_handle_tag`` / ``to_dict`` / ``from_dict``), a
+deterministic synthetic provider (the universal test backend), a
+file-system provider (per-tag parquet/CSV, the NcsReader/IrocReader
+equivalent), and a gated InfluxDB provider.
+"""
+
+from .base import GordoBaseDataProvider
+from .providers import (
+    RandomDataProvider,
+    FileDataProvider,
+    InfluxDataProvider,
+    CompositeDataProvider,
+    provider_from_dict,
+)
+
+__all__ = [
+    "GordoBaseDataProvider",
+    "RandomDataProvider",
+    "FileDataProvider",
+    "InfluxDataProvider",
+    "CompositeDataProvider",
+    "provider_from_dict",
+]

@@ -1,0 +1,58 @@
+"""Abstract data-provider contract.
+
+Reference parity: ``gordo_components/dataset/data_provider/base.py``
+[UNVERIFIED]. A provider yields one ``pd.Series`` per requested tag over a
+half-open ``[train_start_date, train_end_date)`` range; ``can_handle_tag``
+lets a composite provider dispatch per-tag to sub-readers by asset.
+"""
+
+from __future__ import annotations
+
+import abc
+from datetime import datetime
+from typing import Any, Dict, Iterable, List
+
+import pandas as pd
+
+from ...utils.config import resolve_config_class
+from ..sensor_tag import SensorTag
+
+
+class GordoBaseDataProvider(abc.ABC):
+    @abc.abstractmethod
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        """Yield one series per tag covering ``[start, end)``.
+
+        ``dry_run`` should verify availability (auth, paths) without reading
+        bulk data — used by config validation, mirroring the reference.
+        """
+
+    @abc.abstractmethod
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        """Whether this provider knows how to read ``tag``."""
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Serializable config; inverse of :meth:`from_dict`."""
+        return {
+            "type": f"{self.__class__.__module__}.{self.__class__.__name__}",
+            **getattr(self, "_init_kwargs", {}),
+        }
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]) -> "GordoBaseDataProvider":
+        config = dict(config)
+        type_path = config.pop("type", None)
+        if type_path is None:
+            raise ValueError("Provider config requires a 'type' key")
+        provider_cls = resolve_config_class(
+            type_path,
+            GordoBaseDataProvider,
+            default_module="gordo_components_tpu.dataset.data_provider.providers",
+        )
+        return provider_cls(**config)

@@ -1,0 +1,265 @@
+"""Concrete data providers.
+
+Reference parity [UNVERIFIED, path-level]:
+
+- ``RandomDataProvider`` ← ``gordo_components/dataset/data_provider/providers.py``
+  (deterministic synthetic data; the universal test/bench backend)
+- ``FileDataProvider`` ← ``ncs_reader.py`` / ``iroc_reader.py`` (per-tag
+  parquet/CSV files under per-asset directories)
+- ``InfluxDataProvider`` ← ``providers.py`` (InfluxQL reads; gated on the
+  optional ``influxdb`` client package, which this image does not ship)
+- ``CompositeDataProvider`` ← ``DataLakeProvider``'s dispatch-by-asset shape
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from datetime import datetime
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from ..sensor_tag import SensorTag
+from .base import GordoBaseDataProvider
+
+
+def provider_from_dict(config: Dict[str, Any]) -> GordoBaseDataProvider:
+    return GordoBaseDataProvider.from_dict(config)
+
+
+class RandomDataProvider(GordoBaseDataProvider):
+    """Deterministic synthetic per-tag series.
+
+    Each tag's series is a smooth, seeded random walk plus sinusoidal
+    structure, keyed by ``hash(tag.name) ^ seed`` so the same tag always
+    produces the same data — the property every test and benchmark relies on
+    (the reference's RandomDataProvider plays the same role).
+    """
+
+    def __init__(self, min_size: int = 100, max_size: int = 300, seed: int = 0):
+        self._init_kwargs = {"min_size": min_size, "max_size": max_size, "seed": seed}
+        self.min_size = min_size
+        self.max_size = max_size
+        self.seed = seed
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return True
+
+    def _tag_seed(self, tag: SensorTag) -> int:
+        digest = hashlib.md5(tag.name.encode()).digest()
+        return (int.from_bytes(digest[:4], "little") ^ self.seed) & 0x7FFFFFFF
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        if train_end_date <= train_start_date:
+            raise ValueError(
+                f"train_end_date ({train_end_date}) must be after "
+                f"train_start_date ({train_start_date})"
+            )
+        if dry_run:
+            return
+        for tag in tag_list:
+            rng = np.random.default_rng(self._tag_seed(tag))
+            n = int(rng.integers(self.min_size, self.max_size + 1))
+            # n+1 points then drop the last: date_range(end=...) is
+            # end-inclusive but the provider contract is half-open [start, end)
+            index = pd.date_range(
+                start=train_start_date, end=train_end_date, periods=n + 1, unit="ns"
+            )[:-1]
+            t = np.linspace(0.0, 8.0 * np.pi, n)
+            values = (
+                np.cumsum(rng.normal(scale=0.1, size=n))
+                + np.sin(t + rng.uniform(0, 2 * np.pi))
+                + rng.uniform(-5, 5)
+            ).astype(np.float64)
+            yield pd.Series(values, index=index, name=tag.name)
+
+
+class FileDataProvider(GordoBaseDataProvider):
+    """Read per-tag files from a directory tree.
+
+    Layout: ``<base_dir>/[<asset>/]<tag_name>.{parquet|csv}``. CSV files must
+    have columns ``(timestamp, value)``. This is the filesystem equivalent of
+    the reference's NcsReader (yearly per-tag parquet under asset dirs) and
+    IrocReader (concatenated CSV), collapsed into one provider since the
+    split was an artifact of Equinor's two data-lake layouts.
+    """
+
+    def __init__(self, base_dir: str, assets: Optional[List[str]] = None):
+        self._init_kwargs = {"base_dir": base_dir, "assets": assets}
+        self.base_dir = base_dir
+        self.assets = assets
+
+    def _candidate_paths(self, tag: SensorTag) -> List[str]:
+        stems = []
+        if tag.asset:
+            stems.append(os.path.join(self.base_dir, tag.asset, tag.name))
+        stems.append(os.path.join(self.base_dir, tag.name))
+        return [
+            stem + ext for stem in stems for ext in (".parquet", ".csv")
+        ]
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        if self.assets and tag.asset not in self.assets:
+            return False
+        return any(os.path.exists(p) for p in self._candidate_paths(tag))
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        for tag in tag_list:
+            path = next(
+                (p for p in self._candidate_paths(tag) if os.path.exists(p)), None
+            )
+            if path is None:
+                raise FileNotFoundError(
+                    f"No file for tag {tag.name!r} under {self.base_dir!r}"
+                )
+            if dry_run:
+                continue
+            if path.endswith(".parquet"):
+                frame = pd.read_parquet(path)
+            else:
+                frame = pd.read_csv(path, parse_dates=["timestamp"])
+            if "timestamp" in frame.columns:
+                frame = frame.set_index("timestamp")
+            series = frame["value"] if "value" in frame.columns else frame.iloc[:, 0]
+            # naive file timestamps are interpreted as UTC so they compare
+            # cleanly against tz-aware dataset date ranges (and vice versa)
+            if getattr(series.index, "tz", None) is None and train_start_date.tzinfo is not None:
+                series.index = series.index.tz_localize("UTC")
+            elif getattr(series.index, "tz", None) is not None and train_start_date.tzinfo is None:
+                series.index = series.index.tz_localize(None)
+            series = series[(series.index >= train_start_date) & (series.index < train_end_date)]
+            series.name = tag.name
+            yield series
+
+
+class InfluxDataProvider(GordoBaseDataProvider):
+    """InfluxQL reads (``SELECT value FROM <measurement>``), parity with the
+    reference's InfluxDataProvider. The ``influxdb`` client is optional and
+    not shipped in this image, so instantiation is allowed (configs must
+    round-trip) but reads raise with a clear message until it is installed.
+    """
+
+    def __init__(
+        self,
+        measurement: str = "sensor_data",
+        value_name: str = "value",
+        api_key: Optional[str] = None,
+        api_key_header: Optional[str] = None,
+        **influx_config: Any,
+    ):
+        # NOTE: credentials (api_key, password) are deliberately NOT
+        # serialized — to_dict() output is embedded in build metadata (served
+        # at GET /metadata) and fleet YAML round-trips.
+        self._init_kwargs = {
+            "measurement": measurement,
+            "value_name": value_name,
+            **{k: v for k, v in influx_config.items() if k != "password"},
+        }
+        self.measurement = measurement
+        self.value_name = value_name
+        self.influx_config = influx_config
+        try:
+            import influxdb  # type: ignore
+
+            headers = (
+                {api_key_header or "Ocp-Apim-Subscription-Key": api_key}
+                if api_key
+                else None
+            )
+            self._client = influxdb.DataFrameClient(headers=headers, **influx_config)
+        except ImportError:
+            self._client = None
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return True
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        if self._client is None:
+            raise RuntimeError(
+                "InfluxDataProvider requires the optional 'influxdb' package, "
+                "which is not installed in this environment."
+            )
+        for tag in tag_list:
+            # escape InfluxQL string/identifier quoting — tag names come from
+            # fleet YAML, not trusted code
+            safe_tag = tag.name.replace("\\", "\\\\").replace("'", "\\'")
+            safe_measurement = self.measurement.replace('"', '\\"')
+            safe_value = self.value_name.replace('"', '\\"')
+            query = (
+                f'SELECT "{safe_value}" FROM "{safe_measurement}" '
+                f"WHERE tag = '{safe_tag}' "
+                f"AND time >= '{train_start_date.isoformat()}' "
+                f"AND time < '{train_end_date.isoformat()}'"
+            )
+            if dry_run:
+                # availability check only — don't pull the full range
+                self._client.query(query + " LIMIT 1")
+                continue
+            result = self._client.query(query)
+            frame = result.get(self.measurement, pd.DataFrame(columns=[self.value_name]))
+            series = frame[self.value_name]
+            series.name = tag.name
+            yield series
+
+
+class CompositeDataProvider(GordoBaseDataProvider):
+    """Dispatch each tag to the first sub-provider that can handle it —
+    the shape of the reference's DataLakeProvider delegating to
+    NcsReader/IrocReader by asset."""
+
+    def __init__(self, providers: List[Any]):
+        self.providers = [
+            p if isinstance(p, GordoBaseDataProvider) else GordoBaseDataProvider.from_dict(p)
+            for p in providers
+        ]
+        self._init_kwargs = {"providers": [p.to_dict() for p in self.providers]}
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return any(p.can_handle_tag(tag) for p in self.providers)
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        # preserve tag order; batch runs of consecutive tags that share a
+        # provider into one load_series call so providers can reuse
+        # connections / vectorize reads
+        assignments: List[GordoBaseDataProvider] = []
+        for tag in tag_list:
+            provider = next((p for p in self.providers if p.can_handle_tag(tag)), None)
+            if provider is None:
+                raise ValueError(f"No provider can handle tag {tag!r}")
+            assignments.append(provider)
+        i = 0
+        while i < len(tag_list):
+            provider = assignments[i]
+            j = i
+            while j < len(tag_list) and assignments[j] is provider:
+                j += 1
+            yield from provider.load_series(
+                train_start_date, train_end_date, tag_list[i:j], dry_run=dry_run
+            )
+            i = j

@@ -1,0 +1,272 @@
+"""Time-series dataset assembly: provider series → aligned ``(X, y)``.
+
+Reference parity: ``gordo_components/dataset/datasets.py`` [UNVERIFIED] —
+``TimeSeriesDataset`` with per-tag resample/aggregate, inner join on the
+timestamp index, optional pandas-query row filtering, and per-tag count
+metadata. TPU twist: the joined frames are float32 and C-contiguous so the
+builder can ``jax.device_put`` them without copies, and the windowing that
+the reference did host-side with Keras' TimeseriesGenerator is deferred to
+on-device static-shape gathers (:mod:`gordo_components_tpu.ops.windowing`).
+"""
+
+from __future__ import annotations
+
+import logging
+from datetime import datetime
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from .base import GordoBaseDataset
+from .data_provider.base import GordoBaseDataProvider
+from .data_provider.providers import RandomDataProvider
+from .sensor_tag import SensorTag, normalize_sensor_tags
+
+logger = logging.getLogger(__name__)
+
+
+class InsufficientDataError(ValueError):
+    """Raised when the assembled dataset has fewer rows than required."""
+
+
+def _normalize_resolution(resolution: str) -> str:
+    """Accept both legacy pandas offsets ("10T", "1H", "30S") and modern
+    spellings ("10min", "1h", "30s") — ported gordo configs use the legacy
+    uppercase forms, which pandas 3 rejects."""
+    legacy = {"T": "min", "H": "h", "S": "s", "L": "ms", "U": "us"}
+    for suffix, modern in legacy.items():
+        if resolution.endswith(suffix) and (
+            resolution[:-1].isdigit() or resolution[:-1] == ""
+        ):
+            return resolution[:-1] + modern
+    return resolution
+
+
+def _parse_date(value: Union[str, datetime]) -> datetime:
+    if isinstance(value, datetime):
+        return value
+    return pd.Timestamp(value).to_pydatetime()
+
+
+def join_timeseries(
+    series_iterable: Iterable[pd.Series],
+    resampling_start: datetime,
+    resampling_end: datetime,
+    resolution: str,
+    aggregation_methods: Union[str, List[str]] = "mean",
+    interpolation_method: str = "linear_interpolation",
+    interpolation_limit: Optional[str] = "8H",
+) -> Tuple[pd.DataFrame, Dict[str, Any]]:
+    """Resample each series onto a common grid and inner-join on timestamps.
+
+    Returns the joined frame and per-tag metadata: original / resampled row
+    counts and rows dropped by the join — the numbers the reference records
+    into build metadata for data-quality debugging.
+    """
+    resolution = _normalize_resolution(resolution)
+    if interpolation_method not in ("linear_interpolation", "ffill", "none"):
+        raise ValueError(
+            f"interpolation_method must be one of 'linear_interpolation', "
+            f"'ffill', 'none'; got {interpolation_method!r}"
+        )
+    metadata: Dict[str, Any] = {}
+    resampled: List[pd.DataFrame] = []
+
+    interpolation_steps = None
+    if interpolation_limit is not None:
+        step = pd.Timedelta(resolution)
+        interpolation_steps = max(
+            1, int(pd.Timedelta(_normalize_resolution(interpolation_limit)) / step)
+        )
+
+    for series in series_iterable:
+        original_count = len(series)
+        if original_count == 0:
+            raise InsufficientDataError(f"Tag {series.name!r} has no data")
+        series = series[~series.index.duplicated(keep="first")].sort_index()
+        resampler = series.resample(resolution, origin=pd.Timestamp(resampling_start))
+        if isinstance(aggregation_methods, str):
+            frame = resampler.agg(aggregation_methods).to_frame(name=series.name)
+        else:
+            frame = resampler.agg(aggregation_methods)
+            frame.columns = [f"{series.name}_{m}" for m in aggregation_methods]
+        if interpolation_method == "linear_interpolation":
+            frame = frame.interpolate(method="linear", limit=interpolation_steps)
+        elif interpolation_method == "ffill":
+            frame = frame.ffill(limit=interpolation_steps)
+        frame = frame.dropna()
+        metadata.setdefault("tags", {})[str(series.name)] = {
+            "original_length": original_count,
+            "resampled_length": len(frame),
+        }
+        resampled.append(frame)
+
+    if not resampled:
+        raise InsufficientDataError("No series to join (empty tag list?)")
+    joined = pd.concat(resampled, axis=1, join="inner").dropna()
+    for name in list(metadata.get("tags", {})):
+        metadata["tags"][name]["dropped_by_join"] = (
+            metadata["tags"][name]["resampled_length"] - len(joined)
+        )
+    before_slice = len(joined)
+    joined = joined[(joined.index >= resampling_start) & (joined.index < resampling_end)]
+    metadata["dropped_by_range_slice"] = before_slice - len(joined)
+    metadata["joined_length"] = len(joined)
+    return joined, metadata
+
+
+class TimeSeriesDataset(GordoBaseDataset):
+    """Assemble per-tag provider series into aligned ``(X, y)`` matrices.
+
+    Parameters mirror the reference's TimeSeriesDataset so fleet configs port
+    verbatim: ``train_start_date`` / ``train_end_date`` (half-open range),
+    ``tag_list``, optional ``target_tag_list`` (defaults to ``tag_list`` —
+    the autoencoder X→X case), ``resolution`` (pandas offset, legacy "10T"
+    accepted), ``row_filter`` (pandas query string evaluated on the joined
+    frame), ``aggregation_methods``, and ``row_threshold`` (minimum rows
+    after join, else :class:`InsufficientDataError`).
+    """
+
+    def __init__(
+        self,
+        train_start_date: Union[str, datetime],
+        train_end_date: Union[str, datetime],
+        tag_list: List,
+        target_tag_list: Optional[List] = None,
+        data_provider: Union[GordoBaseDataProvider, Dict[str, Any], None] = None,
+        resolution: str = "10min",
+        row_filter: Optional[str] = None,
+        aggregation_methods: Union[str, List[str]] = "mean",
+        row_threshold: int = 0,
+        asset: Optional[str] = None,
+        interpolation_method: str = "linear_interpolation",
+        interpolation_limit: Optional[str] = "8H",
+    ):
+        self.train_start_date = _parse_date(train_start_date)
+        self.train_end_date = _parse_date(train_end_date)
+        if self.train_end_date <= self.train_start_date:
+            raise ValueError(
+                f"train_end_date ({self.train_end_date}) must be after "
+                f"train_start_date ({self.train_start_date})"
+            )
+        self.tag_list = normalize_sensor_tags(tag_list, asset=asset)
+        self.target_tag_list = (
+            normalize_sensor_tags(target_tag_list, asset=asset)
+            if target_tag_list
+            else list(self.tag_list)
+        )
+        if data_provider is None:
+            data_provider = RandomDataProvider()
+        elif isinstance(data_provider, dict):
+            data_provider = GordoBaseDataProvider.from_dict(data_provider)
+        self.data_provider = data_provider
+        self.resolution = resolution
+        self.row_filter = row_filter
+        self.aggregation_methods = aggregation_methods
+        self.row_threshold = row_threshold
+        self.asset = asset
+        self.interpolation_method = interpolation_method
+        self.interpolation_limit = interpolation_limit
+        self._metadata: Dict[str, Any] = {}
+
+        self._init_kwargs = {
+            "train_start_date": self.train_start_date.isoformat(),
+            "train_end_date": self.train_end_date.isoformat(),
+            "tag_list": [t.to_dict() for t in self.tag_list],
+            "target_tag_list": [t.to_dict() for t in self.target_tag_list],
+            "data_provider": self.data_provider.to_dict(),
+            "resolution": resolution,
+            "row_filter": row_filter,
+            "aggregation_methods": aggregation_methods,
+            "row_threshold": row_threshold,
+            "asset": asset,
+            "interpolation_method": interpolation_method,
+            "interpolation_limit": interpolation_limit,
+        }
+
+    def _columns_for(self, tags: List[SensorTag]) -> List[str]:
+        """Joined-frame column names for ``tags`` under the configured
+        aggregation (list aggregation suffixes columns per method)."""
+        if isinstance(self.aggregation_methods, str):
+            return [t.name for t in tags]
+        return [
+            f"{t.name}_{m}" for t in tags for m in self.aggregation_methods
+        ]
+
+    def get_data(self) -> Tuple[pd.DataFrame, pd.DataFrame]:
+        # fetch the union of feature+target tags once, deduped by tag *name*
+        # (the column identity); the FIRST spelling wins so a feature tag's
+        # asset is never overridden by a colliding target tag
+        seen: Dict[str, SensorTag] = {}
+        for t in self.tag_list + self.target_tag_list:
+            kept = seen.setdefault(t.name, t)
+            if kept.asset != t.asset:
+                logger.warning(
+                    "Tag %r requested with conflicting assets %r and %r; "
+                    "loading from %r",
+                    t.name,
+                    kept.asset,
+                    t.asset,
+                    kept.asset,
+                )
+        all_tags: List[SensorTag] = list(seen.values())
+        series_iter = self.data_provider.load_series(
+            self.train_start_date, self.train_end_date, all_tags
+        )
+        joined, tag_metadata = join_timeseries(
+            series_iter,
+            self.train_start_date,
+            self.train_end_date,
+            self.resolution,
+            aggregation_methods=self.aggregation_methods,
+            interpolation_method=self.interpolation_method,
+            interpolation_limit=self.interpolation_limit,
+        )
+        filtered_count = 0
+        if self.row_filter:
+            before = len(joined)
+            joined = joined.query(self.row_filter)
+            filtered_count = before - len(joined)
+        if len(joined) <= self.row_threshold:
+            raise InsufficientDataError(
+                f"Only {len(joined)} rows after join/filter "
+                f"(threshold {self.row_threshold})"
+            )
+        X = joined[self._columns_for(self.tag_list)].astype(np.float32)
+        y = joined[self._columns_for(self.target_tag_list)].astype(np.float32)
+        self._metadata = {
+            "tag_loading_metadata": tag_metadata,
+            "rows_filtered": filtered_count,
+            "x_shape": list(X.shape),
+            "y_shape": list(y.shape),
+            "resolution": self.resolution,
+            "train_start_date": self.train_start_date.isoformat(),
+            "train_end_date": self.train_end_date.isoformat(),
+        }
+        return X, y
+
+    def get_metadata(self) -> Dict[str, Any]:
+        return dict(self._metadata)
+
+
+class RandomDataset(TimeSeriesDataset):
+    """TimeSeriesDataset pre-wired to the deterministic RandomDataProvider —
+    the reference's test workhorse."""
+
+    def __init__(
+        self,
+        train_start_date: Union[str, datetime] = "2023-01-01T00:00:00+00:00",
+        train_end_date: Union[str, datetime] = "2023-02-01T00:00:00+00:00",
+        tag_list: Optional[List] = None,
+        **kwargs: Any,
+    ):
+        tag_list = tag_list or ["tag-%d" % i for i in range(4)]
+        kwargs.setdefault("data_provider", RandomDataProvider(min_size=600, max_size=900))
+        kwargs.setdefault("resolution", "10min")
+        super().__init__(
+            train_start_date=train_start_date,
+            train_end_date=train_end_date,
+            tag_list=tag_list,
+            **kwargs,
+        )

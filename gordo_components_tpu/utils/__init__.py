@@ -1,0 +1,5 @@
+"""Shared utilities: config-class resolution, disk registry, metadata helpers."""
+
+from .config import resolve_config_class
+
+__all__ = ["resolve_config_class"]

@@ -1,0 +1,44 @@
+"""Dotted-path config → class resolution, shared by every ``from_dict``.
+
+The reference resolves ``{"dotted.path.Class": {kwargs}}`` style configs in
+its serializer (``gordo_components/serializer/from_definition.py``
+[UNVERIFIED]); providers and datasets use a ``type`` key. One resolver
+serves both shapes here so the semantics can't drift between subsystems.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, Optional, Type
+
+
+def resolve_config_class(
+    type_path: str,
+    base_cls: Type,
+    default_module: Optional[str] = None,
+) -> Type:
+    """Resolve ``type_path`` (dotted path, or a bare name looked up in
+    ``default_module``) to a class and verify it subclasses ``base_cls``."""
+    if "." in type_path:
+        module_path, name = type_path.rsplit(".", 1)
+        try:
+            module = importlib.import_module(module_path)
+        except ImportError as exc:
+            raise ValueError(f"Cannot import module {module_path!r}") from exc
+        try:
+            resolved = getattr(module, name)
+        except AttributeError as exc:
+            raise ValueError(f"{module_path!r} has no attribute {name!r}") from exc
+    elif default_module:
+        module = importlib.import_module(default_module)
+        try:
+            resolved = getattr(module, type_path)
+        except AttributeError as exc:
+            raise ValueError(
+                f"Unknown {base_cls.__name__} short name {type_path!r}"
+            ) from exc
+    else:
+        raise ValueError(f"{type_path!r} is not a dotted path")
+    if not (isinstance(resolved, type) and issubclass(resolved, base_cls)):
+        raise ValueError(f"{type_path} is not a {base_cls.__name__}")
+    return resolved

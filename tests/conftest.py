@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-virtual-device CPU platform BEFORE jax
+initializes, so every sharding/mesh test exercises real multi-device
+partitioning without TPU hardware (SURVEY.md §5 rebuild implication)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

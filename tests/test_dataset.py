@@ -1,0 +1,250 @@
+"""Dataset layer tests, mirroring the reference's test strategy (SURVEY §5):
+resample/join correctness, gap handling, row_filter, tag-count metadata,
+provider dispatch and round-tripping."""
+
+from datetime import datetime, timezone
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_components_tpu.dataset import (
+    RandomDataset,
+    SensorTag,
+    TimeSeriesDataset,
+    join_timeseries,
+    normalize_sensor_tags,
+)
+from gordo_components_tpu.dataset.base import GordoBaseDataset
+from gordo_components_tpu.dataset.dataset import InsufficientDataError
+from gordo_components_tpu.dataset.data_provider import (
+    FileDataProvider,
+    GordoBaseDataProvider,
+    RandomDataProvider,
+)
+from gordo_components_tpu.dataset.sensor_tag import (
+    SensorTagNormalizationError,
+    normalize_sensor_tag,
+)
+
+UTC = timezone.utc
+START = datetime(2023, 1, 1, tzinfo=UTC)
+END = datetime(2023, 2, 1, tzinfo=UTC)
+
+
+class TestSensorTag:
+    def test_normalize_forms(self):
+        tags = normalize_sensor_tags(
+            [
+                "ASGB.tag1",
+                ["plain-tag", "assetX"],
+                {"name": "dict-tag", "asset": "assetY"},
+                SensorTag("already", "assetZ"),
+            ]
+        )
+        assert tags[0] == SensorTag("ASGB.tag1", "asgb")
+        assert tags[1] == SensorTag("plain-tag", "assetX")
+        assert tags[2] == SensorTag("dict-tag", "assetY")
+        assert tags[3] == SensorTag("already", "assetZ")
+
+    def test_default_asset_wins_over_unknown(self):
+        assert normalize_sensor_tag("unknown-tag", asset="mine").asset == "mine"
+
+    def test_prefix_inference(self):
+        assert normalize_sensor_tag("1901.PT.101").asset == "asgb"
+        assert normalize_sensor_tag("nonexistent_prefix_tag").asset is None
+
+    def test_bad_specs_raise(self):
+        with pytest.raises(SensorTagNormalizationError):
+            normalize_sensor_tag({"asset": "no-name"})
+        with pytest.raises(SensorTagNormalizationError):
+            normalize_sensor_tag(["a", "b", "c"])
+        with pytest.raises(SensorTagNormalizationError):
+            normalize_sensor_tag(123)
+
+
+class TestProviders:
+    def test_random_provider_deterministic(self):
+        provider = RandomDataProvider(seed=7)
+        tags = normalize_sensor_tags(["t1", "t2"])
+        a = list(provider.load_series(START, END, tags))
+        b = list(provider.load_series(START, END, tags))
+        for s1, s2 in zip(a, b):
+            pd.testing.assert_series_equal(s1, s2)
+        # different tags differ
+        assert not np.allclose(a[0].values[: len(a[1])], a[1].values[: len(a[0])])
+
+    def test_random_provider_bad_range(self):
+        provider = RandomDataProvider()
+        with pytest.raises(ValueError):
+            list(provider.load_series(END, START, []))
+
+    def test_provider_roundtrip(self):
+        provider = RandomDataProvider(min_size=50, max_size=60, seed=3)
+        clone = GordoBaseDataProvider.from_dict(provider.to_dict())
+        assert isinstance(clone, RandomDataProvider)
+        assert clone.min_size == 50 and clone.max_size == 60 and clone.seed == 3
+
+    def test_file_provider(self, tmp_path):
+        index = pd.date_range(START, periods=100, freq="10min")
+        frame = pd.DataFrame(
+            {"timestamp": index, "value": np.arange(100, dtype=float)}
+        )
+        frame.to_csv(tmp_path / "mytag.csv", index=False)
+        provider = FileDataProvider(base_dir=str(tmp_path))
+        tag = SensorTag("mytag")
+        assert provider.can_handle_tag(tag)
+        assert not provider.can_handle_tag(SensorTag("missing"))
+        (series,) = list(provider.load_series(START, END, [tag]))
+        assert len(series) == 100
+        assert series.iloc[5] == 5.0
+
+    def test_file_provider_naive_timestamps(self, tmp_path):
+        # naive file timestamps vs tz-aware range must not crash
+        index = pd.date_range("2023-01-01", periods=50, freq="10min")  # naive
+        pd.DataFrame({"timestamp": index, "value": np.ones(50)}).to_csv(
+            tmp_path / "naive.csv", index=False
+        )
+        provider = FileDataProvider(base_dir=str(tmp_path))
+        (series,) = list(provider.load_series(START, END, [SensorTag("naive")]))
+        assert len(series) == 50
+        assert str(series.index.tz) == "UTC"
+
+
+class TestJoinTimeseries:
+    def _series(self, name, start, periods, freq="10min", values=None):
+        index = pd.date_range(start, periods=periods, freq=freq)
+        values = values if values is not None else np.arange(periods, dtype=float)
+        return pd.Series(values, index=index, name=name)
+
+    def test_inner_join_drops_nonoverlap(self):
+        s1 = self._series("a", START, 100)
+        s2 = self._series("b", START + pd.Timedelta("300min"), 100)
+        joined, meta = join_timeseries(
+            [s1, s2], START, END, "10min", interpolation_method="none"
+        )
+        assert len(joined) == 70  # overlap of [30, 100)
+        assert meta["tags"]["a"]["original_length"] == 100
+        assert meta["tags"]["a"]["dropped_by_join"] == 30
+        assert meta["joined_length"] == 70
+
+    def test_resample_aggregates(self):
+        # 1-min data resampled to 10-min means
+        s = self._series("a", START, 60, freq="1min")
+        joined, _ = join_timeseries([s], START, END, "10min", interpolation_method="none")
+        assert len(joined) == 6
+        assert joined["a"].iloc[0] == pytest.approx(np.mean(np.arange(10)))
+
+    def test_empty_series_raises(self):
+        empty = pd.Series([], index=pd.DatetimeIndex([]), name="e", dtype=float)
+        with pytest.raises(InsufficientDataError):
+            join_timeseries([empty], START, END, "10min")
+
+    def test_legacy_resolution_spelling(self):
+        s = self._series("a", START, 60, freq="1min")
+        joined, _ = join_timeseries([s], START, END, "10T", interpolation_method="none")
+        assert len(joined) == 6
+
+
+class TestTimeSeriesDataset:
+    def test_get_data_shapes_and_metadata(self):
+        dataset = RandomDataset(tag_list=["t1", "t2", "t3"])
+        X, y = dataset.get_data()
+        assert list(X.columns) == ["t1", "t2", "t3"]
+        assert X.shape == y.shape
+        assert X.dtypes.iloc[0] == np.float32
+        meta = dataset.get_metadata()
+        assert meta["x_shape"] == list(X.shape)
+        assert "t1" in meta["tag_loading_metadata"]["tags"]
+
+    def test_target_tags(self):
+        dataset = RandomDataset(tag_list=["t1", "t2"], target_tag_list=["t2"])
+        X, y = dataset.get_data()
+        assert list(X.columns) == ["t1", "t2"]
+        assert list(y.columns) == ["t2"]
+
+    def test_row_filter(self):
+        dataset = RandomDataset(tag_list=["t1", "t2"])
+        X_all, _ = dataset.get_data()
+        threshold = float(X_all["t1"].median())
+        filtered = RandomDataset(tag_list=["t1", "t2"], row_filter=f"`t1` > {threshold}")
+        X_f, _ = filtered.get_data()
+        assert 0 < len(X_f) < len(X_all)
+        assert (X_f["t1"] > threshold).all()
+
+    def test_row_threshold(self):
+        with pytest.raises(InsufficientDataError):
+            RandomDataset(tag_list=["t1"], row_threshold=10**9).get_data()
+
+    def test_from_dict_roundtrip(self):
+        dataset = RandomDataset(tag_list=["t1", "t2"])
+        clone = GordoBaseDataset.from_dict(dataset.to_dict())
+        X1, _ = dataset.get_data()
+        X2, _ = clone.get_data()
+        pd.testing.assert_frame_equal(X1, X2)
+
+    def test_bad_date_range(self):
+        with pytest.raises(ValueError):
+            TimeSeriesDataset(
+                train_start_date="2023-02-01", train_end_date="2023-01-01", tag_list=["t"]
+            )
+
+    def test_multi_aggregation(self):
+        dataset = RandomDataset(
+            tag_list=["t1", "t2"], aggregation_methods=["mean", "max"]
+        )
+        X, y = dataset.get_data()
+        assert list(X.columns) == ["t1_mean", "t1_max", "t2_mean", "t2_max"]
+        assert (X["t1_max"] >= X["t1_mean"] - 1e-6).all()
+
+    def test_interpolation_roundtrip(self):
+        ds = RandomDataset(tag_list=["t1"], interpolation_method="none")
+        clone = GordoBaseDataset.from_dict(ds.to_dict())
+        X1, _ = ds.get_data()
+        X2, _ = clone.get_data()
+        pd.testing.assert_frame_equal(X1, X2)
+
+    def test_bad_interpolation_method(self):
+        with pytest.raises(ValueError, match="interpolation_method"):
+            RandomDataset(tag_list=["t1"], interpolation_method="linear").get_data()
+
+    def test_same_name_different_asset_dedup(self):
+        ds = RandomDataset(
+            tag_list=[{"name": "t1", "asset": "a"}],
+            target_tag_list=[{"name": "t1", "asset": "b"}],
+        )
+        X, y = ds.get_data()
+        assert X.shape[1] == 1 and y.shape[1] == 1
+
+
+class TestReviewRegressions:
+    def test_legacy_hour_resolution(self):
+        # ported gordo configs commonly use "1H"
+        ds = RandomDataset(tag_list=["t1"], resolution="1H")
+        X, _ = ds.get_data()
+        assert len(X) > 0
+
+    def test_list_tag_with_none_asset(self):
+        tag = normalize_sensor_tag(["ASGB.x", None])
+        assert tag.asset == "asgb"
+
+    def test_dedup_keeps_first_spelling(self):
+        ds = RandomDataset(
+            tag_list=[{"name": "t1", "asset": "a"}],
+            target_tag_list=[{"name": "t1", "asset": "b"}],
+        )
+        seen = {}
+        for t in ds.tag_list + ds.target_tag_list:
+            seen.setdefault(t.name, t)
+        assert seen["t1"].asset == "a"
+
+    def test_influx_password_not_serialized(self):
+        from gordo_components_tpu.dataset.data_provider import InfluxDataProvider
+
+        provider = InfluxDataProvider(
+            measurement="m", host="h", username="u", password="hunter2", api_key="k"
+        )
+        serialized = provider.to_dict()
+        assert "password" not in serialized
+        assert "api_key" not in serialized
+        assert serialized["username"] == "u"
