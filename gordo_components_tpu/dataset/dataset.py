@@ -3,8 +3,8 @@
 Reference parity: ``gordo_components/dataset/datasets.py`` [UNVERIFIED] —
 ``TimeSeriesDataset`` with per-tag resample/aggregate, inner join on the
 timestamp index, optional pandas-query row filtering, and per-tag count
-metadata. TPU twist: the joined frames are float32 and C-contiguous so the
-builder can ``jax.device_put`` them without copies, and the windowing that
+metadata. TPU twist: the joined frames are float32 (the builder re-packs them
+contiguously at ``jax.device_put`` time), and the windowing that
 the reference did host-side with Keras' TimeseriesGenerator is deferred to
 on-device static-shape gathers (:mod:`gordo_components_tpu.ops.windowing`).
 """
@@ -228,7 +228,7 @@ class TimeSeriesDataset(GordoBaseDataset):
             before = len(joined)
             joined = joined.query(self.row_filter)
             filtered_count = before - len(joined)
-        if len(joined) <= self.row_threshold:
+        if len(joined) < self.row_threshold:
             raise InsufficientDataError(
                 f"Only {len(joined)} rows after join/filter "
                 f"(threshold {self.row_threshold})"
@@ -261,7 +261,8 @@ class RandomDataset(TimeSeriesDataset):
         tag_list: Optional[List] = None,
         **kwargs: Any,
     ):
-        tag_list = tag_list or ["tag-%d" % i for i in range(4)]
+        if tag_list is None:
+            tag_list = ["tag-%d" % i for i in range(4)]
         kwargs.setdefault("data_provider", RandomDataProvider(min_size=600, max_size=900))
         kwargs.setdefault("resolution", "10min")
         super().__init__(

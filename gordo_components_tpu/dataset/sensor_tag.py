@@ -49,10 +49,11 @@ def register_tag_prefix(prefix: str, asset: str) -> None:
 def _infer_asset(tag_name: str) -> Optional[str]:
     match = _TAG_RE.match(tag_name)
     if match:
-        prefix = match.group(1).upper()
-        if prefix in TAG_PREFIX_TO_ASSET:
-            return TAG_PREFIX_TO_ASSET[prefix]
-    # fall back to longest matching registered prefix anywhere at the start
+        # a separator-delimited prefix is the tag's authoritative prefix: look
+        # it up exactly, and do NOT fall through to the loose startswith scan
+        # (else "GRADIENT.01" would wrongly match the "GRA" convention)
+        return TAG_PREFIX_TO_ASSET.get(match.group(1).upper())
+    # no separator (e.g. "1901TAG"): longest registered prefix at the start
     upper = tag_name.upper()
     best = None
     for prefix, asset in TAG_PREFIX_TO_ASSET.items():

@@ -1,0 +1,20 @@
+"""TPU-native numeric primitives shared by the model zoo, fleet engine, and
+server: static-shape windowing (the device-side replacement for Keras'
+host-side TimeseriesGenerator) and pure-function feature scaling.
+"""
+
+from .windowing import (  # noqa: F401
+    forecast_targets,
+    n_windows,
+    reconstruction_targets,
+    sliding_windows,
+    window_output_index,
+)
+from .scaling import (  # noqa: F401
+    ScalerParams,
+    fit_minmax,
+    fit_standard,
+    identity_params,
+    inverse_transform,
+    transform,
+)

@@ -1,0 +1,62 @@
+"""Pure-function feature scaling.
+
+The reference drops ``sklearn.preprocessing.MinMaxScaler`` into its pipelines
+and fits a per-tag MinMax scaler over CV residuals in the anomaly detector
+(``gordo_components/model/anomaly/diff.py`` [UNVERIFIED]). Those are stateful
+host objects; inside a jitted fleet program we need scaling as pure functions
+over explicit parameters so they vmap/shard_map over the machine axis.
+
+``ScalerParams`` is a pytree (scale, offset) applying ``x * scale + offset``
+— one shape covers minmax, standard, and identity scaling, so the fleet
+engine can stack heterogeneous machines' scalers into a single array.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class ScalerParams(NamedTuple):
+    """Affine transform ``x * scale + offset``; inverse ``(x - offset)/scale``."""
+
+    scale: jnp.ndarray
+    offset: jnp.ndarray
+
+
+def fit_minmax(
+    x: jnp.ndarray, feature_range: tuple = (0.0, 1.0), eps: float = 1e-12
+) -> ScalerParams:
+    """Per-feature min-max to ``feature_range`` (sklearn MinMaxScaler semantics:
+    zero-range features map to the range minimum)."""
+    lo, hi = feature_range
+    xmin = jnp.min(x, axis=0)
+    xmax = jnp.max(x, axis=0)
+    span = xmax - xmin
+    scale = (hi - lo) / jnp.where(span < eps, 1.0, span)
+    offset = lo - xmin * scale
+    return ScalerParams(scale=scale, offset=offset)
+
+
+def fit_standard(x: jnp.ndarray, eps: float = 1e-12) -> ScalerParams:
+    """Per-feature standardization (sklearn StandardScaler semantics:
+    zero-variance features are centered but not scaled)."""
+    mean = jnp.mean(x, axis=0)
+    std = jnp.std(x, axis=0)
+    scale = 1.0 / jnp.where(std < eps, 1.0, std)
+    return ScalerParams(scale=scale, offset=-mean * scale)
+
+
+def identity_params(n_features: int, dtype=jnp.float32) -> ScalerParams:
+    return ScalerParams(
+        scale=jnp.ones((n_features,), dtype), offset=jnp.zeros((n_features,), dtype)
+    )
+
+
+def transform(params: ScalerParams, x: jnp.ndarray) -> jnp.ndarray:
+    return x * params.scale + params.offset
+
+
+def inverse_transform(params: ScalerParams, x: jnp.ndarray) -> jnp.ndarray:
+    return (x - params.offset) / params.scale

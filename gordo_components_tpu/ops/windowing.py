@@ -1,0 +1,91 @@
+"""Static-shape sliding-window primitives.
+
+The reference windows time-series host-side with Keras' TimeseriesGenerator
+(``gordo_components/model/models.py::create_keras_timeseriesgenerator``
+[UNVERIFIED — empty reference mount, path-level citation]). Here windowing is
+a pure, jittable gather so XLA fuses it with the model's first matmul and the
+data never round-trips through host Python.
+
+THE OFF-BY-ONE CONTRACT (pinned by tests/test_windowing.py — SURVEY.md §4.5
+calls this "subtle and MUST be pinned"):
+
+Given ``x`` with ``n`` rows and ``lookback_window = L``:
+
+- ``sliding_windows(x, L)`` → shape ``(n - L + 1, L, F)``; window ``i`` is
+  rows ``[i, i+L)``.
+- **Reconstruction** (LSTM autoencoder): window ``i`` targets its own last
+  row ``x[i+L-1]``. Usable samples: ``n - L + 1``. Prediction row ``j``
+  corresponds to input timestamp index ``j + L - 1``.
+- **Forecast**: window ``i`` targets the *next* row ``x[i+L]``. Usable
+  samples: ``n - L``. Prediction row ``j`` corresponds to input timestamp
+  index ``j + L``.
+
+``window_output_index`` maps prediction rows back to input-row indices so
+the server/anomaly layers can attach the correct timestamps.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def n_windows(n_rows: int, lookback_window: int, lookahead: int = 0) -> int:
+    """Number of usable windows for ``n_rows`` of input.
+
+    ``lookahead=0`` → reconstruction (target = last row of window);
+    ``lookahead=1`` → one-step forecast (target = row after window).
+    """
+    if lookback_window < 1:
+        raise ValueError(f"lookback_window must be >= 1, got {lookback_window}")
+    if lookahead not in (0, 1):
+        raise ValueError(f"lookahead must be 0 or 1, got {lookahead}")
+    return max(0, n_rows - lookback_window + 1 - lookahead)
+
+
+def sliding_windows(
+    x: jnp.ndarray, lookback_window: int, lookahead: int = 0
+) -> jnp.ndarray:
+    """``(n, F) → (n - L + 1 - lookahead, L, F)`` sliding windows as a static
+    gather.
+
+    ``lookahead`` trims trailing windows so the result zips exactly with the
+    matching target fn — ``lookahead=0`` ⇄ :func:`reconstruction_targets`,
+    ``lookahead=1`` ⇄ :func:`forecast_targets` — keeping the off-by-one
+    contract in one place instead of at every call site.
+
+    Jittable; the index matrix is a compile-time constant so XLA lowers this
+    to a single gather that fuses into downstream ops.
+    """
+    n = x.shape[0]
+    count = n_windows(n, lookback_window, lookahead)
+    if count <= 0:
+        raise ValueError(
+            f"Need at least lookback_window+lookahead={lookback_window + lookahead} "
+            f"rows, got {n}"
+        )
+    idx = np.arange(count)[:, None] + np.arange(lookback_window)[None, :]
+    return x[idx]
+
+
+def reconstruction_targets(x: jnp.ndarray, lookback_window: int) -> jnp.ndarray:
+    """Targets for the LSTM-autoencoder contract: row ``i+L-1`` per window."""
+    return x[lookback_window - 1 :]
+
+
+def forecast_targets(x: jnp.ndarray, lookback_window: int) -> jnp.ndarray:
+    """Targets for the forecast contract: row ``i+L`` per window."""
+    return x[lookback_window:]
+
+
+def window_output_index(
+    n_rows: int, lookback_window: int, lookahead: int = 0
+) -> np.ndarray:
+    """Input-row index each prediction row corresponds to.
+
+    Reconstruction: ``[L-1, …, n-1]``; forecast: ``[L, …, n-1]``. Used to
+    slice timestamps for server responses and anomaly frames.
+    """
+    count = n_windows(n_rows, lookback_window, lookahead)
+    offset = lookback_window - 1 + lookahead
+    return np.arange(count) + offset

@@ -1,0 +1,99 @@
+"""Golden tests pinning the windowing off-by-one contract (SURVEY.md §4.5)
+and the pure-fn scaler semantics against sklearn."""
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu.ops import (
+    fit_minmax,
+    fit_standard,
+    forecast_targets,
+    inverse_transform,
+    n_windows,
+    reconstruction_targets,
+    sliding_windows,
+    transform,
+    window_output_index,
+)
+
+
+class TestWindowing:
+    def test_sliding_windows_shape_and_content(self):
+        x = np.arange(10, dtype=np.float32).reshape(10, 1)
+        w = np.asarray(sliding_windows(x, 3))
+        assert w.shape == (8, 3, 1)
+        np.testing.assert_array_equal(w[0, :, 0], [0, 1, 2])
+        np.testing.assert_array_equal(w[-1, :, 0], [7, 8, 9])
+
+    def test_reconstruction_contract(self):
+        # window i = rows [i, i+L); target = row i+L-1 (its own last row)
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        L = 4
+        w = np.asarray(sliding_windows(x, L))
+        t = np.asarray(reconstruction_targets(x, L))
+        assert len(w) == len(t) == n_windows(10, L, lookahead=0) == 7
+        for i in range(len(w)):
+            np.testing.assert_array_equal(w[i, -1], t[i])
+
+    def test_forecast_contract(self):
+        # window i = rows [i, i+L); target = row i+L (the NEXT row);
+        # lookahead=1 trims the trailing window so w zips exactly with t
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        L = 4
+        w = np.asarray(sliding_windows(x, L, lookahead=1))
+        t = np.asarray(forecast_targets(x, L))
+        assert len(w) == len(t)
+        assert len(t) == n_windows(10, L, lookahead=1) == 6
+        for i in range(len(t)):
+            np.testing.assert_array_equal(x[i + L], t[i])
+            assert w[i, -1, 0] == x[i + L - 1, 0]
+
+    def test_output_index_maps_to_timestamps(self):
+        idx0 = window_output_index(10, 4, lookahead=0)
+        np.testing.assert_array_equal(idx0, [3, 4, 5, 6, 7, 8, 9])
+        idx1 = window_output_index(10, 4, lookahead=1)
+        np.testing.assert_array_equal(idx1, [4, 5, 6, 7, 8, 9])
+
+    def test_too_few_rows_raises(self):
+        x = np.zeros((2, 1), dtype=np.float32)
+        with pytest.raises(ValueError):
+            sliding_windows(x, 5)
+        assert n_windows(2, 5) == 0
+
+    def test_bad_args(self):
+        with pytest.raises(ValueError):
+            n_windows(10, 0)
+        with pytest.raises(ValueError):
+            n_windows(10, 2, lookahead=2)
+
+
+class TestScaling:
+    def test_minmax_matches_sklearn(self, rng):
+        from sklearn.preprocessing import MinMaxScaler
+
+        x = rng.normal(size=(50, 4)).astype(np.float32)
+        params = fit_minmax(x)
+        ours = np.asarray(transform(params, x))
+        ref = MinMaxScaler().fit_transform(x)
+        np.testing.assert_allclose(ours, ref, atol=1e-6)
+
+    def test_standard_matches_sklearn(self, rng):
+        from sklearn.preprocessing import StandardScaler
+
+        x = rng.normal(size=(50, 4)).astype(np.float32)
+        params = fit_standard(x)
+        ours = np.asarray(transform(params, x))
+        ref = StandardScaler().fit_transform(x)
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_inverse_round_trip(self, rng):
+        x = rng.normal(size=(30, 3)).astype(np.float32)
+        params = fit_minmax(x, feature_range=(-1.0, 2.0))
+        back = np.asarray(inverse_transform(params, transform(params, x)))
+        np.testing.assert_allclose(back, x, atol=1e-5)
+
+    def test_constant_feature_no_nan(self):
+        x = np.ones((10, 2), dtype=np.float32)
+        for fit in (fit_minmax, fit_standard):
+            out = np.asarray(transform(fit(x), x))
+            assert np.isfinite(out).all()
