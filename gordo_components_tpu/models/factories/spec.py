@@ -1,0 +1,60 @@
+"""What a model factory produces.
+
+The reference's factories return *compiled Keras models* (architecture +
+optimizer + loss bundled by ``keras.Model.compile`` — see
+``gordo_components/model/factories/`` [UNVERIFIED]). The JAX equivalent of
+"compiled model" is this spec: a Flax module (pure apply), an optax
+gradient transformation, and the loss name — everything the train step
+needs, nothing stateful.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import flax.linen as nn
+import optax
+
+_OPTIMIZERS = {
+    "adam": optax.adam,
+    "adamw": optax.adamw,
+    "sgd": optax.sgd,
+    "rmsprop": optax.rmsprop,
+    "adagrad": optax.adagrad,
+    "adamax": optax.adamax,
+    "nadam": optax.nadam,
+}
+
+
+def make_optimizer(
+    optimizer: str = "Adam", optimizer_kwargs: Optional[Dict[str, Any]] = None
+) -> optax.GradientTransformation:
+    """Keras optimizer name + kwargs → optax transform. Accepts the Keras
+    spelling ``lr`` as well as ``learning_rate`` so ported configs run
+    unchanged."""
+    kwargs = dict(optimizer_kwargs or {})
+    if "lr" in kwargs:
+        kwargs["learning_rate"] = kwargs.pop("lr")
+    kwargs.setdefault("learning_rate", 1e-3)
+    name = optimizer.lower()
+    if name not in _OPTIMIZERS:
+        raise ValueError(
+            f"Unknown optimizer {optimizer!r}; supported: {sorted(_OPTIMIZERS)}"
+        )
+    return _OPTIMIZERS[name](**kwargs)
+
+
+class ModelSpec(NamedTuple):
+    """A ready-to-train model: pure module + optimizer + loss.
+
+    ``input_kind`` is ``"flat"`` for ``(batch, F)`` models and ``"window"``
+    for ``(batch, L, F)`` models — the estimator wrapper validates it against
+    its own windowing behavior so a dense kind can't silently be used where
+    an LSTM kind is required.
+    """
+
+    module: nn.Module
+    optimizer: optax.GradientTransformation
+    loss: str
+    input_kind: str
+    config: Dict[str, Any]  # JSON-able record of the resolved architecture

@@ -1,0 +1,54 @@
+"""Evaluation metrics as plain numpy functions.
+
+The reference scores with sklearn metrics (explained variance is
+``KerasAutoEncoder.score``'s metric; the builder's CV also records r2 /
+MAE / MSE — ``gordo_components/builder/build_model.py`` [UNVERIFIED]).
+Implemented here directly so scoring has no sklearn dependency in the hot
+path and matches sklearn's multioutput="uniform_average" semantics (pinned
+against sklearn in tests/test_models.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def explained_variance_score(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    y_true = np.asarray(y_true, dtype=np.float64)
+    y_pred = np.asarray(y_pred, dtype=np.float64)
+    num = np.var(y_true - y_pred, axis=0)
+    den = np.var(y_true, axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        scores = 1.0 - num / den
+    # sklearn: zero-variance outputs score 1.0 if perfectly predicted else 0.0
+    scores = np.where(den == 0.0, np.where(num == 0.0, 1.0, 0.0), scores)
+    return float(np.mean(scores))
+
+
+def r2_score(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    y_true = np.asarray(y_true, dtype=np.float64)
+    y_pred = np.asarray(y_pred, dtype=np.float64)
+    num = np.sum((y_true - y_pred) ** 2, axis=0)
+    den = np.sum((y_true - np.mean(y_true, axis=0)) ** 2, axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        scores = 1.0 - num / den
+    scores = np.where(den == 0.0, np.where(num == 0.0, 1.0, 0.0), scores)
+    return float(np.mean(scores))
+
+
+def mean_squared_error(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    diff = np.asarray(y_true, np.float64) - np.asarray(y_pred, np.float64)
+    return float(np.mean(diff * diff))
+
+
+def mean_absolute_error(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    diff = np.asarray(y_true, np.float64) - np.asarray(y_pred, np.float64)
+    return float(np.mean(np.abs(diff)))
+
+
+METRICS = {
+    "explained_variance_score": explained_variance_score,
+    "r2_score": r2_score,
+    "mean_squared_error": mean_squared_error,
+    "mean_absolute_error": mean_absolute_error,
+}

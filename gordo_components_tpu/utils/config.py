@@ -12,6 +12,20 @@ import importlib
 from typing import Any, Dict, Optional, Type
 
 
+def resolve_dotted_path(type_path: str) -> Any:
+    """Import ``module.attr`` from a dotted path with distinct errors for
+    import vs attribute failures."""
+    module_path, name = type_path.rsplit(".", 1)
+    try:
+        module = importlib.import_module(module_path)
+    except ImportError as exc:
+        raise ValueError(f"Cannot import module {module_path!r}") from exc
+    try:
+        return getattr(module, name)
+    except AttributeError as exc:
+        raise ValueError(f"{module_path!r} has no attribute {name!r}") from exc
+
+
 def resolve_config_class(
     type_path: str,
     base_cls: Type,
@@ -20,15 +34,7 @@ def resolve_config_class(
     """Resolve ``type_path`` (dotted path, or a bare name looked up in
     ``default_module``) to a class and verify it subclasses ``base_cls``."""
     if "." in type_path:
-        module_path, name = type_path.rsplit(".", 1)
-        try:
-            module = importlib.import_module(module_path)
-        except ImportError as exc:
-            raise ValueError(f"Cannot import module {module_path!r}") from exc
-        try:
-            resolved = getattr(module, name)
-        except AttributeError as exc:
-            raise ValueError(f"{module_path!r} has no attribute {name!r}") from exc
+        resolved = resolve_dotted_path(type_path)
     elif default_module:
         module = importlib.import_module(default_module)
         try:

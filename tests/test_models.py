@@ -1,0 +1,258 @@
+"""Model-zoo tests: factory dims, registry, fit/predict contracts, the
+LSTM windowing off-by-one golden tests (SURVEY.md §4.5: "subtle and MUST be
+pinned"), metric parity with sklearn, and state round-trips."""
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu.models import (
+    DenseAutoEncoder,
+    KerasAutoEncoder,
+    LSTMAutoEncoder,
+    LSTMForecast,
+    get_factory,
+    list_kinds,
+    register_model_factory,
+)
+from gordo_components_tpu.models.base import clone_estimator
+from gordo_components_tpu.models.factories.feedforward import hourglass_calc_dims
+from gordo_components_tpu.models.metrics import (
+    explained_variance_score,
+    mean_absolute_error,
+    mean_squared_error,
+    r2_score,
+)
+
+
+@pytest.fixture(scope="module")
+def X(rng_module):
+    return rng_module.normal(size=(200, 5)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def rng_module():
+    return np.random.default_rng(7)
+
+
+# ---------------------------------------------------------------- factories
+def test_hourglass_calc_dims_golden():
+    # the reference's pinned contract values
+    assert hourglass_calc_dims(0.5, 3, 10) == (8, 7, 5)
+    assert hourglass_calc_dims(0.2, 3, 5) == (4, 2, 1)
+    assert hourglass_calc_dims(1.0, 3, 10) == (10, 10, 10)
+    assert hourglass_calc_dims(0.5, 1, 128) == (64,)
+
+
+def test_hourglass_dims_validation():
+    with pytest.raises(ValueError):
+        hourglass_calc_dims(1.5, 3, 10)
+    with pytest.raises(ValueError):
+        hourglass_calc_dims(0.5, 0, 10)
+
+
+def test_registry_lists_all_reference_kinds():
+    kinds = list_kinds()
+    for kind in (
+        "feedforward_model",
+        "feedforward_symmetric",
+        "feedforward_hourglass",
+        "lstm_model",
+        "lstm_symmetric",
+        "lstm_hourglass",
+    ):
+        assert kind in kinds
+
+
+def test_registry_dotted_path_and_unknown():
+    factory = get_factory(
+        "gordo_components_tpu.models.factories.feedforward.feedforward_model"
+    )
+    assert callable(factory)
+    with pytest.raises(ValueError, match="Unknown model kind"):
+        get_factory("no_such_kind")
+
+
+def test_register_duplicate_kind_rejected():
+    @register_model_factory("test_dup_kind")
+    def factory_a(**kwargs):
+        pass
+
+    with pytest.raises(ValueError, match="already registered"):
+
+        @register_model_factory("test_dup_kind")
+        def factory_b(**kwargs):
+            pass
+
+
+def test_factory_spec_shapes():
+    spec = get_factory("feedforward_symmetric")(n_features=12, dims=(8, 4))
+    assert spec.config["encoding_dim"] == [8, 4]
+    assert spec.config["decoding_dim"] == [4, 8]
+    assert spec.input_kind == "flat"
+    spec = get_factory("lstm_hourglass")(
+        n_features=10, lookback_window=4, encoding_layers=2, compression_factor=0.5
+    )
+    assert spec.config["units"] == [8, 5, 5, 8]
+    assert spec.input_kind == "window"
+
+
+# ------------------------------------------------------------- dense estimator
+def test_dense_autoencoder_fit_predict_score(X):
+    model = DenseAutoEncoder(kind="feedforward_hourglass", epochs=3, batch_size=64)
+    assert model.fit(X) is model
+    pred = model.predict(X)
+    assert pred.shape == X.shape
+    assert np.isfinite(pred).all()
+    assert len(model.history_) == 3
+    # training reduced the loss
+    assert model.history_[-1] < model.history_[0]
+    assert isinstance(model.score(X), float)
+
+
+def test_dense_autoencoder_separate_targets(X):
+    y = X[:, :2]
+    model = DenseAutoEncoder(kind="feedforward_model", encoding_dim=(8,),
+                             decoding_dim=(8,), epochs=2, batch_size=64)
+    model.fit(X, y)
+    assert model.predict(X).shape == (len(X), 2)
+
+
+def test_deterministic_given_seed(X):
+    preds = []
+    for _ in range(2):
+        m = DenseAutoEncoder(kind="feedforward_symmetric", dims=(8, 4),
+                             epochs=2, batch_size=64, seed=11)
+        m.fit(X)
+        preds.append(m.predict(X))
+    np.testing.assert_allclose(preds[0], preds[1], rtol=1e-6)
+
+
+def test_predict_before_fit_raises(X):
+    with pytest.raises(ValueError, match="not fitted"):
+        DenseAutoEncoder().predict(X)
+
+
+def test_kind_mismatch_rejected(X):
+    with pytest.raises(ValueError, match="requires"):
+        LSTMAutoEncoder(kind="feedforward_model", lookback_window=4).fit(X)
+    with pytest.raises(ValueError, match="requires"):
+        DenseAutoEncoder(kind="lstm_model").fit(X)
+
+
+def test_keras_alias_is_dense_autoencoder():
+    assert KerasAutoEncoder is DenseAutoEncoder
+
+
+# ---------------------------------------------------- LSTM off-by-one contract
+def test_lstm_autoencoder_output_rows(X):
+    L = 6
+    m = LSTMAutoEncoder(kind="lstm_symmetric", lookback_window=L, dims=(8,),
+                        epochs=1, batch_size=64)
+    m.fit(X)
+    assert m.predict(X).shape == (len(X) - L + 1, X.shape[1])
+
+
+def test_lstm_forecast_output_rows(X):
+    L = 6
+    m = LSTMForecast(kind="lstm_symmetric", lookback_window=L, dims=(8,),
+                     epochs=1, batch_size=64)
+    m.fit(X)
+    assert m.predict(X).shape == (len(X) - L, X.shape[1])
+
+
+def test_forecast_targets_are_shifted():
+    """Golden off-by-one: a perfectly-learnable identity forecast must align
+    window i with target row i+L, not i+L-1."""
+    n, L = 40, 3
+    X = np.arange(n, dtype=np.float32)[:, None].repeat(2, axis=1)
+    m = LSTMForecast(kind="lstm_model", lookback_window=L, units=(4,), epochs=1,
+                     batch_size=8)
+    m.fit(X)
+    from gordo_components_tpu.ops.windowing import forecast_targets
+
+    targets = forecast_targets(X, L)
+    assert targets.shape == (n - L, 2)
+    np.testing.assert_array_equal(np.asarray(targets)[0], X[L])
+
+
+def test_lstm_dropout_trains(X):
+    m = LSTMAutoEncoder(kind="lstm_hourglass", lookback_window=4,
+                        encoding_layers=1, dropout=0.3, epochs=2, batch_size=64)
+    m.fit(X)
+    assert np.isfinite(m.predict(X)).all()
+
+
+# ------------------------------------------------------------------- metrics
+def test_metrics_match_sklearn(rng_module):
+    import sklearn.metrics as skm
+
+    y = rng_module.normal(size=(50, 3))
+    p = y + rng_module.normal(scale=0.3, size=(50, 3))
+    assert explained_variance_score(y, p) == pytest.approx(
+        skm.explained_variance_score(y, p)
+    )
+    assert r2_score(y, p) == pytest.approx(skm.r2_score(y, p))
+    assert mean_squared_error(y, p) == pytest.approx(skm.mean_squared_error(y, p))
+    assert mean_absolute_error(y, p) == pytest.approx(skm.mean_absolute_error(y, p))
+
+
+# ----------------------------------------------------------- params / cloning
+def test_get_params_round_trip(X):
+    m = DenseAutoEncoder(kind="feedforward_hourglass", compression_factor=0.3,
+                         epochs=2, batch_size=16)
+    clone = clone_estimator(m)
+    assert clone.get_params() == m.get_params()
+    assert clone.params_ is None
+
+
+def test_state_round_trip(X):
+    m = DenseAutoEncoder(kind="feedforward_symmetric", dims=(8, 4), epochs=2,
+                         batch_size=64)
+    m.fit(X)
+    m2 = clone_estimator(m)
+    m2.set_state(m.get_state())
+    np.testing.assert_allclose(m2.predict(X), m.predict(X), rtol=1e-6)
+    assert m2.history_ == m.history_
+
+
+def test_set_params_routes_factory_kwargs(X):
+    m = LSTMAutoEncoder(kind="lstm_symmetric", lookback_window=4, dims=(8,))
+    m.set_params(lookback_window=6, dims=(4,), epochs=2, batch_size=64)
+    assert m.lookback_window == 6
+    assert m.epochs == 2
+    m.fit(X)
+    assert m.predict(X).shape == (len(X) - 6 + 1, X.shape[1])
+
+
+def test_fitted_estimator_pickles(X):
+    import pickle
+
+    m = DenseAutoEncoder(kind="feedforward_symmetric", dims=(8,), epochs=1,
+                         batch_size=64)
+    m.fit(X)
+    m2 = pickle.loads(pickle.dumps(m))
+    np.testing.assert_allclose(m2.predict(X), m.predict(X), rtol=1e-6)
+    # unfitted estimators round-trip too
+    pickle.loads(pickle.dumps(DenseAutoEncoder()))
+
+
+def test_fit_accepts_1d_y(X):
+    m = DenseAutoEncoder(kind="feedforward_model", encoding_dim=(8,),
+                         decoding_dim=(8,), epochs=1, batch_size=64)
+    m.fit(X, X[:, 0])
+    assert m.predict(X).shape == (len(X), 1)
+
+
+def test_metadata_contract(X):
+    m = DenseAutoEncoder(kind="feedforward_hourglass", epochs=2, batch_size=64)
+    meta_unfitted = m.get_metadata()
+    assert meta_unfitted["kind"] == "feedforward_hourglass"
+    assert "history" not in meta_unfitted
+    m.fit(X)
+    meta = m.get_metadata()
+    assert len(meta["history"]["loss"]) == 2
+    assert meta["num_parameters"] > 0
+    assert meta["architecture"]["n_features"] == X.shape[1]
+    import json
+
+    json.dumps(meta)  # must be JSON-serializable for build metadata
