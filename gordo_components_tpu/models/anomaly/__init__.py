@@ -1,0 +1,4 @@
+from .base import AnomalyDetectorBase
+from .diff import DiffBasedAnomalyDetector
+
+__all__ = ["AnomalyDetectorBase", "DiffBasedAnomalyDetector"]

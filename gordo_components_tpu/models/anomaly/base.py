@@ -1,0 +1,21 @@
+"""Anomaly-detector contract.
+
+Reference parity: ``gordo_components/model/anomaly/base.py`` [UNVERIFIED] —
+an anomaly detector is an estimator whose ``anomaly(X, y)`` returns a
+DataFrame of scores aligned to the input timestamps.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import pandas as pd
+
+from ..base import GordoBase
+
+
+class AnomalyDetectorBase(GordoBase):
+    @abc.abstractmethod
+    def anomaly(self, X, y=None) -> pd.DataFrame:
+        """Per-row anomaly frame: model input/output, per-tag scaled errors,
+        and the total anomaly score."""

@@ -16,6 +16,15 @@ from ..register import register_model_factory
 from .spec import ModelSpec, make_optimizer
 
 
+def _reject_unknown(kind: str, unknown: dict) -> None:
+    """A misspelled hyperparameter must fail the build, not silently train
+    the default architecture."""
+    if unknown:
+        raise ValueError(
+            f"Unknown hyperparameters for kind {kind!r}: {sorted(unknown)}"
+        )
+
+
 def _broadcast_funcs(funcs, dims, default: str) -> Tuple[str, ...]:
     if funcs is None:
         return tuple(default for _ in dims)
@@ -112,9 +121,10 @@ def feedforward_model(
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     loss: str = "mse",
     compute_dtype: str = "float32",
-    **_ignored: Any,
+    **unknown: Any,
 ) -> ModelSpec:
     """Explicit encoder/decoder dims — the reference's base factory."""
+    _reject_unknown("feedforward_model", unknown)
     return _build(
         n_features,
         n_features_out,
@@ -141,9 +151,10 @@ def feedforward_symmetric(
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     loss: str = "mse",
     compute_dtype: str = "float32",
-    **_ignored: Any,
+    **unknown: Any,
 ) -> ModelSpec:
     """Encoder ``dims``, decoder mirrored (reversed) automatically."""
+    _reject_unknown("feedforward_symmetric", unknown)
     if not dims:
         raise ValueError("dims must contain at least one layer size")
     encoding_funcs = _broadcast_funcs(funcs, dims, "tanh")
@@ -174,10 +185,11 @@ def feedforward_hourglass(
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     loss: str = "mse",
     compute_dtype: str = "float32",
-    **_ignored: Any,
+    **unknown: Any,
 ) -> ModelSpec:
     """Hourglass: dims interpolate down to ``n_features * compression_factor``
     then mirror back up."""
+    _reject_unknown("feedforward_hourglass", unknown)
     dims = hourglass_calc_dims(compression_factor, encoding_layers, n_features)
     return _build(
         n_features,

@@ -13,7 +13,7 @@ from typing import Any, Dict, Optional, Sequence
 
 from ..modules import LSTMModule
 from ..register import register_model_factory
-from .feedforward import _broadcast_funcs, hourglass_calc_dims
+from .feedforward import _broadcast_funcs, _reject_unknown, hourglass_calc_dims
 from .spec import ModelSpec, make_optimizer
 
 
@@ -77,9 +77,10 @@ def lstm_model(
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     loss: str = "mse",
     compute_dtype: str = "float32",
-    **_ignored: Any,
+    **unknown: Any,
 ) -> ModelSpec:
     """Explicit per-layer LSTM units — the reference's base LSTM factory."""
+    _reject_unknown("lstm_model", unknown)
     return _build(
         n_features,
         n_features_out,
@@ -108,9 +109,10 @@ def lstm_symmetric(
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     loss: str = "mse",
     compute_dtype: str = "float32",
-    **_ignored: Any,
+    **unknown: Any,
 ) -> ModelSpec:
     """Encoder ``dims`` then mirrored decoder dims."""
+    _reject_unknown("lstm_symmetric", unknown)
     if not dims:
         raise ValueError("dims must contain at least one layer size")
     encoding_funcs = _broadcast_funcs(funcs, dims, "tanh")
@@ -143,10 +145,11 @@ def lstm_hourglass(
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     loss: str = "mse",
     compute_dtype: str = "float32",
-    **_ignored: Any,
+    **unknown: Any,
 ) -> ModelSpec:
     """Hourglass dims (same ``hourglass_calc_dims`` contract as feedforward)
     mirrored into a symmetric LSTM stack."""
+    _reject_unknown("lstm_hourglass", unknown)
     dims = hourglass_calc_dims(compression_factor, encoding_layers, n_features)
     return _build(
         n_features,

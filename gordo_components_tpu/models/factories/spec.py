@@ -26,22 +26,53 @@ _OPTIMIZERS = {
 }
 
 
+# Keras kwarg spellings → optax spellings (per-optimizer where they apply)
+_KERAS_KWARG_MAP = {
+    "lr": "learning_rate",
+    "beta_1": "b1",
+    "beta_2": "b2",
+    "epsilon": "eps",
+    "rho": "decay",  # RMSprop's smoothing constant
+}
+
+
 def make_optimizer(
     optimizer: str = "Adam", optimizer_kwargs: Optional[Dict[str, Any]] = None
 ) -> optax.GradientTransformation:
-    """Keras optimizer name + kwargs → optax transform. Accepts the Keras
-    spelling ``lr`` as well as ``learning_rate`` so ported configs run
-    unchanged."""
-    kwargs = dict(optimizer_kwargs or {})
-    if "lr" in kwargs:
-        kwargs["learning_rate"] = kwargs.pop("lr")
+    """Keras optimizer name + kwargs → optax transform. Keras spellings
+    (``lr``, ``beta_1``, ``beta_2``, ``epsilon``, ``momentum``, ``rho``) are
+    translated so ported configs run unchanged; Keras' ``decay``
+    (learning-rate schedule, no optax equivalent here) is dropped with a
+    warning rather than crashing the build."""
+    import inspect
+    import logging
+
+    raw = dict(optimizer_kwargs or {})
+    if "decay" in raw:  # Keras lr-decay schedule — no optax equivalent here;
+        # must be dropped BEFORE mapping so it can't collide with optax
+        # rmsprop's own `decay` (the smoothing constant, Keras' `rho`)
+        import logging as _logging
+
+        _logging.getLogger(__name__).warning(
+            "Optimizer %s: Keras 'decay' (lr schedule) is not supported; ignored",
+            optimizer,
+        )
+        raw.pop("decay")
+    kwargs = {_KERAS_KWARG_MAP.get(k, k): v for k, v in raw.items()}
     kwargs.setdefault("learning_rate", 1e-3)
     name = optimizer.lower()
     if name not in _OPTIMIZERS:
         raise ValueError(
             f"Unknown optimizer {optimizer!r}; supported: {sorted(_OPTIMIZERS)}"
         )
-    return _OPTIMIZERS[name](**kwargs)
+    fn = _OPTIMIZERS[name]
+    accepted = set(inspect.signature(fn).parameters)
+    dropped = {k: kwargs.pop(k) for k in list(kwargs) if k not in accepted}
+    if dropped:
+        logging.getLogger(__name__).warning(
+            "Optimizer %s ignores unsupported kwargs: %s", optimizer, sorted(dropped)
+        )
+    return fn(**kwargs)
 
 
 class ModelSpec(NamedTuple):

@@ -117,6 +117,10 @@ class BaseFlaxEstimator(GordoBase):
         y_arr = X if y is None else _as_float32(y)
         if X.ndim != 2:
             raise ValueError(f"Expected 2-D (rows, features) input, got {X.shape}")
+        if len(y_arr) != len(X):
+            raise ValueError(
+                f"X and y row counts differ: {len(X)} vs {len(y_arr)}"
+            )
         inputs = self._prepare_inputs(X)
         targets = self._prepare_targets(y_arr)
         self.n_features_ = int(X.shape[1])

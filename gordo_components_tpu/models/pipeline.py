@@ -1,0 +1,195 @@
+"""Composable pipeline: the sklearn ``Pipeline`` surface the reference's
+configs are written against (``sklearn.pipeline.Pipeline`` steps with a final
+estimator — the serializer aliases that dotted path here).
+
+Unlike sklearn's, every step is expected to expose the pure-state contract
+(:meth:`GordoBase.get_state`) so a whole fitted pipeline serializes to
+numpy + JSON — and so the fleet engine can lift all steps of all machines
+into stacked arrays. Steps that only implement fit/transform still work for
+single-machine use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .base import GordoBase
+
+
+def _name_steps(
+    steps: Sequence[Union[Tuple[str, Any], Any]]
+) -> List[Tuple[str, Any]]:
+    named: List[Tuple[str, Any]] = []
+    seen: Dict[str, int] = {}
+    for step in steps:
+        if isinstance(step, (tuple, list)) and len(step) == 2 and isinstance(step[0], str):
+            name, obj = step
+        else:
+            obj = step
+            base = f"step_{len(named)}_{type(obj).__name__.lower()}"
+            name = base
+        if name in seen:
+            raise ValueError(f"Duplicate step name {name!r}")
+        seen[name] = 1
+        named.append((name, obj))
+    return named
+
+
+class Pipeline(GordoBase):
+    def __init__(self, steps: Sequence[Union[Tuple[str, Any], Any]]):
+        self.steps = _name_steps(steps)
+
+    # -- helpers ------------------------------------------------------------
+    @property
+    def _final(self) -> Any:
+        return self.steps[-1][1]
+
+    def _transform_through(self, X, fit: bool = False, y=None):
+        for _, step in self.steps[:-1]:
+            if not fit:
+                X = step.transform(X)
+            elif hasattr(step, "fit_transform"):
+                X = step.fit_transform(X, y)
+            else:
+                step.fit(X, y)
+                X = step.transform(X)
+        return X
+
+    # -- sklearn API --------------------------------------------------------
+    def fit(self, X, y=None, **kwargs) -> "Pipeline":
+        Xt = self._transform_through(X, fit=True, y=y)
+        self._final.fit(Xt, y, **kwargs)
+        return self
+
+    def transform(self, X):
+        Xt = self._transform_through(X)
+        return self._final.transform(Xt)
+
+    def predict(self, X) -> np.ndarray:
+        return self._final.predict(self._transform_through(X))
+
+    def score(self, X, y=None) -> float:
+        return self._final.score(self._transform_through(X), y)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return Pipeline(self.steps[key])
+        if isinstance(key, str):
+            return dict(self.steps)[key]
+        return self.steps[key][1]
+
+    # -- GordoBase ----------------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        return {"steps": list(self.steps)}
+
+    def get_metadata(self) -> Dict[str, Any]:
+        return {
+            "type": "Pipeline",
+            "steps": [
+                {name: step.get_metadata() if hasattr(step, "get_metadata") else {}}
+                for name, step in self.steps
+            ],
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        # keyed by position, not name: into_definition does not preserve
+        # custom step names, so positional keys are what survives a
+        # dump → load round-trip
+        return {
+            f"step_{i}": step.get_state() if hasattr(step, "get_state") else {}
+            for i, (_, step) in enumerate(self.steps)
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> "Pipeline":
+        for i, (_, step) in enumerate(self.steps):
+            if hasattr(step, "set_state"):
+                step.set_state(state.get(f"step_{i}", {}))
+        return self
+
+
+class TransformedTargetRegressor(GordoBase):
+    """Fit ``regressor`` on ``transformer``-transformed targets; ``predict``
+    inverse-transforms back (sklearn.compose.TransformedTargetRegressor
+    surface — the reference's configs wrap models in it [VERSION?])."""
+
+    def __init__(self, regressor: Any, transformer: Optional[Any] = None):
+        self.regressor = regressor
+        self.transformer = transformer
+
+    def fit(self, X, y=None, **kwargs) -> "TransformedTargetRegressor":
+        y_arr = X if y is None else y
+        if self.transformer is not None:
+            y_arr = self.transformer.fit_transform(y_arr)
+        self.regressor.fit(X, y_arr, **kwargs)
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        pred = self.regressor.predict(X)
+        if self.transformer is not None:
+            pred = self.transformer.inverse_transform(pred)
+        return np.asarray(pred)
+
+    def score(self, X, y=None) -> float:
+        from .metrics import explained_variance_score
+
+        y_arr = np.asarray(getattr(X if y is None else y, "values", X if y is None else y))
+        return explained_variance_score(y_arr, self.predict(X))
+
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        return {"regressor": self.regressor, "transformer": self.transformer}
+
+    def get_metadata(self) -> Dict[str, Any]:
+        return {
+            "type": "TransformedTargetRegressor",
+            "regressor": (
+                self.regressor.get_metadata()
+                if hasattr(self.regressor, "get_metadata")
+                else {}
+            ),
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "regressor": (
+                self.regressor.get_state() if hasattr(self.regressor, "get_state") else {}
+            ),
+            "transformer": (
+                self.transformer.get_state()
+                if hasattr(self.transformer, "get_state")
+                else {}
+            ),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> "TransformedTargetRegressor":
+        if hasattr(self.regressor, "set_state"):
+            self.regressor.set_state(state.get("regressor", {}))
+        if self.transformer is not None and hasattr(self.transformer, "set_state"):
+            self.transformer.set_state(state.get("transformer", {}))
+        return self
+
+
+def clone_pipeline(obj):
+    """Deep unfitted clone of a pipeline/estimator graph."""
+    if isinstance(obj, Pipeline):
+        return Pipeline([(name, clone_pipeline(step)) for name, step in obj.steps])
+    if isinstance(obj, TransformedTargetRegressor):
+        return TransformedTargetRegressor(
+            regressor=clone_pipeline(obj.regressor),
+            transformer=(
+                clone_pipeline(obj.transformer) if obj.transformer is not None else None
+            ),
+        )
+    if isinstance(obj, GordoBase):
+        params = obj.get_params(deep=False)
+        # nested estimators (anomaly wrappers) must be deep-cloned too, or
+        # CV folds would share fitted state
+        params = {
+            k: clone_pipeline(v) if isinstance(v, (GordoBase, Pipeline)) else v
+            for k, v in params.items()
+        }
+        return type(obj)(**params)
+    import copy
+
+    return copy.deepcopy(obj)

@@ -1,0 +1,27 @@
+"""Serializer: YAML/dict model definitions ⇄ live pipelines ⇄ disk artifacts.
+
+Reference parity: ``gordo_components/serializer/`` [UNVERIFIED] —
+``pipeline_from_definition`` / ``pipeline_into_definition`` (the config
+system's heart: dotted-path classes + kwargs, recursively) and ``dump`` /
+``load`` persisting a fitted pipeline to a directory tree, plus
+``load_metadata``. The artifact format here is pure-state: per-step numpy
+``.npz`` + JSON (no pickle on the load path), which is what lets a serving
+process mmap many machines' params and the fleet engine stack them.
+"""
+
+from .from_definition import pipeline_from_definition, from_definition
+from .into_definition import pipeline_into_definition, into_definition
+from .persistence import dump, dumps, load, loads, load_metadata, METADATA_FILE
+
+__all__ = [
+    "pipeline_from_definition",
+    "from_definition",
+    "pipeline_into_definition",
+    "into_definition",
+    "dump",
+    "dumps",
+    "load",
+    "loads",
+    "load_metadata",
+    "METADATA_FILE",
+]

@@ -1,0 +1,180 @@
+"""Definition dict/YAML → live pipeline.
+
+Reference parity: ``gordo_components/serializer/from_definition.py``
+[UNVERIFIED]. A definition node is either
+
+- a dotted path string (instantiated with no kwargs),
+- ``{dotted.path.Class: {kwargs}}`` (single-key mapping), or
+- inside kwargs, lists/dicts recursed into (``steps`` lists, nested
+  regressors, FunctionTransformer funcs).
+
+Ported gordo configs name ``sklearn.*`` and ``gordo_components.*`` classes;
+an alias table rewrites those onto this package's TPU-native equivalents so
+reference fleet YAML loads unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Union
+
+import yaml
+
+from ..utils.config import resolve_dotted_path
+
+# reference-world dotted paths → TPU-native equivalents
+_ALIASES: Dict[str, str] = {
+    # sklearn surface the reference's configs use
+    "sklearn.pipeline.Pipeline": "gordo_components_tpu.models.pipeline.Pipeline",
+    "sklearn.compose.TransformedTargetRegressor": (
+        "gordo_components_tpu.models.pipeline.TransformedTargetRegressor"
+    ),
+    "sklearn.preprocessing.MinMaxScaler": (
+        "gordo_components_tpu.models.transformers.MinMaxScaler"
+    ),
+    "sklearn.preprocessing.data.MinMaxScaler": (
+        "gordo_components_tpu.models.transformers.MinMaxScaler"
+    ),
+    "sklearn.preprocessing.StandardScaler": (
+        "gordo_components_tpu.models.transformers.StandardScaler"
+    ),
+    "sklearn.preprocessing.data.StandardScaler": (
+        "gordo_components_tpu.models.transformers.StandardScaler"
+    ),
+    "sklearn.preprocessing.FunctionTransformer": (
+        "gordo_components_tpu.models.transformers.FunctionTransformer"
+    ),
+    # the reference's own package paths
+    "gordo_components.model.models.KerasAutoEncoder": (
+        "gordo_components_tpu.models.models.DenseAutoEncoder"
+    ),
+    "gordo_components.model.models.KerasLSTMAutoEncoder": (
+        "gordo_components_tpu.models.models.LSTMAutoEncoder"
+    ),
+    "gordo_components.model.models.KerasLSTMForecast": (
+        "gordo_components_tpu.models.models.LSTMForecast"
+    ),
+    "gordo_components.model.anomaly.diff.DiffBasedAnomalyDetector": (
+        "gordo_components_tpu.models.anomaly.diff.DiffBasedAnomalyDetector"
+    ),
+    "gordo_components.model.transformer_funcs.general.multiply": (
+        "gordo_components_tpu.models.transformers.multiply"
+    ),
+    "gordo_components.model.transformers.imputer.InfImputer": (
+        "gordo_components_tpu.models.transformers.InfImputer"
+    ),
+}
+# short names for the local zoo, so hand-written configs stay terse
+_SHORT_NAMES: Dict[str, str] = {
+    name: f"gordo_components_tpu.models.models.{name}"
+    for name in (
+        "DenseAutoEncoder",
+        "LSTMAutoEncoder",
+        "LSTMForecast",
+        "KerasAutoEncoder",
+        "KerasLSTMAutoEncoder",
+        "KerasLSTMForecast",
+    )
+}
+_SHORT_NAMES.update(
+    {
+        "Pipeline": "gordo_components_tpu.models.pipeline.Pipeline",
+        "TransformedTargetRegressor": (
+            "gordo_components_tpu.models.pipeline.TransformedTargetRegressor"
+        ),
+        "MinMaxScaler": "gordo_components_tpu.models.transformers.MinMaxScaler",
+        "StandardScaler": "gordo_components_tpu.models.transformers.StandardScaler",
+        "InfImputer": "gordo_components_tpu.models.transformers.InfImputer",
+        "FunctionTransformer": (
+            "gordo_components_tpu.models.transformers.FunctionTransformer"
+        ),
+        "DiffBasedAnomalyDetector": (
+            "gordo_components_tpu.models.anomaly.diff.DiffBasedAnomalyDetector"
+        ),
+    }
+)
+
+
+def resolve_class_path(path: str) -> Any:
+    """Alias- and short-name-aware dotted-path resolution (also used by
+    FunctionTransformer to resolve ``func`` strings lazily)."""
+    path = _ALIASES.get(path, path)
+    path = _SHORT_NAMES.get(path, path)
+    if "." not in path:
+        raise ValueError(
+            f"Unknown class short name {path!r}; known: {sorted(_SHORT_NAMES)}"
+        )
+    return resolve_dotted_path(path)
+
+
+def _is_class_definition(node: Any) -> bool:
+    """A single-key mapping whose key looks like a class reference."""
+    if isinstance(node, dict) and len(node) == 1:
+        key = next(iter(node))
+        return isinstance(key, str) and (
+            key in _SHORT_NAMES or key in _ALIASES or "." in key
+        )
+    return False
+
+
+def _build_string(s: str) -> Any:
+    """Instantiate strings that resolve to classes (bare steps like
+    ``sklearn.preprocessing.data.MinMaxScaler``); keep everything else —
+    including function dotted paths like FunctionTransformer's ``func``,
+    which resolve lazily — as plain strings."""
+    if not (s in _SHORT_NAMES or s in _ALIASES or "." in s):
+        return s
+    try:
+        target = resolve_class_path(s)
+    except ValueError:
+        return s
+    return target() if isinstance(target, type) else s
+
+
+def _build(node: Any) -> Any:
+    if isinstance(node, str):
+        return _build_string(node)
+    if _is_class_definition(node):
+        path, kwargs = next(iter(node.items()))
+        target = resolve_class_path(path)
+        if not isinstance(target, type):
+            raise ValueError(f"{path!r} resolves to a non-class; cannot take kwargs")
+        if kwargs is None:
+            kwargs = {}
+        if not isinstance(kwargs, dict):
+            raise ValueError(
+                f"Definition for {path!r} must map to kwargs, got {type(kwargs)}"
+            )
+        return target(**{k: _build_value(v) for k, v in kwargs.items()})
+    return node
+
+
+def _build_value(value: Any) -> Any:
+    """Recurse into kwarg values: lists of definitions (steps lists), nested
+    definitions (regressor/base_estimator), plain data otherwise."""
+    if isinstance(value, str):
+        return _build_string(value)
+    if _is_class_definition(value):
+        return _build(value)
+    if isinstance(value, list):
+        return [_build_value(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _build_value(v) for k, v in value.items()}
+    return value
+
+
+def pipeline_from_definition(definition: Union[str, Dict[str, Any]]) -> Any:
+    """Materialize a model definition (dict, or YAML string) into a live
+    (unfitted) pipeline/estimator graph."""
+    if isinstance(definition, str):
+        definition = yaml.safe_load(definition)
+    built = _build(definition)
+    if isinstance(built, (str, dict)) or built is definition:
+        raise ValueError(
+            "Model definition must be a single-key {dotted.path: kwargs} "
+            f"mapping or a class dotted-path string; got: {definition!r}"
+        )
+    return built
+
+
+# reference-era alias
+from_definition = pipeline_from_definition

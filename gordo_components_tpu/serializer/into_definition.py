@@ -1,0 +1,79 @@
+"""Live pipeline → definition dict (inverse of ``from_definition``).
+
+Reference parity: ``gordo_components/serializer/into_definition.py``
+[UNVERIFIED]. Walks ``get_params`` recursively, emitting
+``{dotted.path.Class: {kwargs}}`` nodes — the round-trip
+``from_definition(into_definition(p))`` must reproduce an equivalent
+unfitted pipeline (pinned in tests/test_serializer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+import yaml
+
+
+def _class_path(obj: Any) -> str:
+    cls = type(obj)
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+def _plain(value: Any) -> Any:
+    """JSON/YAML-safe conversion of a kwarg value."""
+    if isinstance(value, np.generic):
+        return value.item()
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if isinstance(value, tuple):
+        return [_plain(v) for v in value]
+    if isinstance(value, list):
+        return [_plain(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _plain(v) for k, v in value.items()}
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if hasattr(value, "get_params"):
+        return _definition_of(value)
+    raise ValueError(
+        f"Cannot serialize {value!r} ({type(value)}) into a definition"
+    )
+
+
+def _definition_of(obj: Any) -> Dict[str, Any]:
+    params = obj.get_params(deep=False) if _takes_deep(obj) else obj.get_params()
+    kwargs: Dict[str, Any] = {}
+    for key, value in params.items():
+        if key == "steps" and isinstance(value, list):
+            # Pipeline steps: [(name, est), …] → list of nested definitions
+            kwargs[key] = [
+                _definition_of(step if not isinstance(step, (tuple, list)) else step[1])
+                for step in value
+            ]
+        else:
+            kwargs[key] = _plain(value)
+    return {_class_path(obj): kwargs}
+
+
+def _takes_deep(obj: Any) -> bool:
+    try:
+        import inspect
+
+        return "deep" in inspect.signature(obj.get_params).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def pipeline_into_definition(pipeline: Any) -> Dict[str, Any]:
+    """Serialize an (un)fitted pipeline/estimator graph back into the
+    definition-dict shape ``pipeline_from_definition`` accepts."""
+    return _definition_of(pipeline)
+
+
+def into_definition_yaml(pipeline: Any) -> str:
+    return yaml.safe_dump(pipeline_into_definition(pipeline), sort_keys=False)
+
+
+# reference-era alias
+into_definition = pipeline_into_definition

@@ -1,0 +1,148 @@
+"""DiffBasedAnomalyDetector tests: CV error-scaler fitting, the anomaly
+DataFrame contract (reference field names), tail alignment for windowed
+models, thresholds, and persistence round-trip."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_components_tpu.models.anomaly import DiffBasedAnomalyDetector
+from gordo_components_tpu.models.models import DenseAutoEncoder, LSTMAutoEncoder
+from gordo_components_tpu.models.pipeline import Pipeline
+from gordo_components_tpu.models.transformers import MinMaxScaler
+from gordo_components_tpu.serializer import (
+    dump,
+    load,
+    pipeline_from_definition,
+    pipeline_into_definition,
+)
+
+N, F = 240, 4
+TAGS = [f"sensor-{i}" for i in range(F)]
+
+
+@pytest.fixture(scope="module")
+def frame():
+    rng = np.random.default_rng(5)
+    idx = pd.date_range("2023-01-01", periods=N, freq="10min", tz="UTC")
+    data = np.sin(np.linspace(0, 20, N))[:, None] + rng.normal(
+        scale=0.1, size=(N, F)
+    )
+    return pd.DataFrame(data.astype(np.float32), index=idx, columns=TAGS)
+
+
+@pytest.fixture(scope="module")
+def fitted(frame):
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline(
+            [
+                MinMaxScaler(),
+                DenseAutoEncoder(kind="feedforward_hourglass", epochs=3,
+                                 batch_size=32),
+            ]
+        )
+    )
+    det.cross_validate(frame)
+    det.fit(frame)
+    return det
+
+
+def test_cross_validate_scores_and_scaler(fitted):
+    cv = fitted.cross_validation_
+    assert cv["n_splits"] == 3
+    assert len(cv["splits"]) == 3
+    assert "explained_variance_score" in cv["scores"]
+    # error scaler is fitted on pooled residuals
+    assert fitted.scaler.params_ is not None
+    assert fitted.tag_thresholds_.shape == (F,)
+    assert fitted.total_threshold_ > 0
+
+
+def test_anomaly_frame_contract(fitted, frame):
+    out = fitted.anomaly(frame)
+    assert isinstance(out, pd.DataFrame)
+    top = set(out.columns.get_level_values(0))
+    assert top == {
+        "model-input",
+        "model-output",
+        "tag-anomaly-scores",
+        "total-anomaly-score",
+    }
+    assert len(out) == len(frame)  # dense model: one score row per input row
+    assert (out.index == frame.index).all()
+    # total score is the L2 norm of the per-tag scaled scores
+    scores = out["tag-anomaly-scores"].values
+    np.testing.assert_allclose(
+        np.ravel(out["total-anomaly-score"].values),
+        np.linalg.norm(scores, axis=1),
+        rtol=1e-5,
+    )
+    # scaled scores may dip slightly below 0 (minmax fitted on CV residuals)
+    assert np.isfinite(scores).all()
+
+
+def test_anomaly_detects_injected_spike(fitted, frame):
+    corrupted = frame.copy()
+    corrupted.iloc[100, 0] = frame.iloc[:, 0].max() * 30
+    base = np.ravel(fitted.anomaly(frame)["total-anomaly-score"].values)
+    spiked = np.ravel(fitted.anomaly(corrupted)["total-anomaly-score"].values)
+    assert spiked[100] > base[100] * 2
+    assert spiked[100] > np.median(spiked) * 3
+
+
+def test_anomaly_tail_alignment_lstm(frame):
+    L = 8
+    det = DiffBasedAnomalyDetector(
+        base_estimator=LSTMAutoEncoder(
+            kind="lstm_symmetric", lookback_window=L, dims=(8,), epochs=1,
+            batch_size=32
+        )
+    )
+    det.cross_validate(frame, n_splits=2)
+    det.fit(frame)
+    out = det.anomaly(frame)
+    assert len(out) == len(frame) - L + 1
+    # index rows are the window-END timestamps
+    assert out.index[0] == frame.index[L - 1]
+    assert out.index[-1] == frame.index[-1]
+
+
+def test_require_thresholds_enforced(frame):
+    det = DiffBasedAnomalyDetector(
+        base_estimator=DenseAutoEncoder(kind="feedforward_symmetric", dims=(6,),
+                                        epochs=1, batch_size=32),
+        require_thresholds=True,
+    )
+    det.fit(frame)
+    with pytest.raises(ValueError, match="cross_validate"):
+        det.anomaly(frame)
+
+
+def test_definition_round_trip(frame):
+    definition = {
+        "gordo_components.model.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "Pipeline": {
+                    "steps": [
+                        "MinMaxScaler",
+                        {"DenseAutoEncoder": {"epochs": 1, "batch_size": 32}},
+                    ]
+                }
+            }
+        }
+    }
+    det = pipeline_from_definition(definition)
+    assert isinstance(det, DiffBasedAnomalyDetector)
+    round_tripped = pipeline_from_definition(pipeline_into_definition(det))
+    assert isinstance(round_tripped, DiffBasedAnomalyDetector)
+
+
+def test_dump_load_round_trip(fitted, frame, tmp_path):
+    out_dir = str(tmp_path / "anomaly_model")
+    dump(fitted, out_dir, metadata={"name": "m1"})
+    loaded = load(out_dir)
+    expected = fitted.anomaly(frame)
+    got = loaded.anomaly(frame)
+    np.testing.assert_allclose(got.values, expected.values, rtol=1e-4)
+    assert loaded.total_threshold_ == pytest.approx(fitted.total_threshold_)
+    assert loaded.cross_validation_["n_splits"] == 3

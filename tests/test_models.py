@@ -84,6 +84,31 @@ def test_register_duplicate_kind_rejected():
             pass
 
 
+def test_factory_rejects_unknown_hyperparams():
+    with pytest.raises(ValueError, match="compression_facter"):
+        get_factory("feedforward_hourglass")(n_features=4, compression_facter=0.1)
+    with pytest.raises(ValueError, match="Unknown hyperparameters"):
+        get_factory("lstm_model")(n_features=4, lookback=3)
+
+
+def test_optimizer_keras_kwarg_translation():
+    from gordo_components_tpu.models.factories.spec import make_optimizer
+
+    # Keras spellings must translate, not crash
+    make_optimizer("Adam", {"lr": 1e-3, "beta_1": 0.9, "beta_2": 0.999,
+                            "epsilon": 1e-7})
+    make_optimizer("SGD", {"momentum": 0.9, "decay": 1e-6})  # decay dropped
+    make_optimizer("RMSprop", {"rho": 0.9})
+    with pytest.raises(ValueError, match="Unknown optimizer"):
+        make_optimizer("NoSuchOpt")
+
+
+def test_fit_rejects_mismatched_rows(X):
+    m = DenseAutoEncoder(kind="feedforward_symmetric", dims=(4,), epochs=1)
+    with pytest.raises(ValueError, match="row counts differ"):
+        m.fit(X, X[: len(X) // 2])
+
+
 def test_factory_spec_shapes():
     spec = get_factory("feedforward_symmetric")(n_features=12, dims=(8, 4))
     assert spec.config["encoding_dim"] == [8, 4]
@@ -133,7 +158,9 @@ def test_predict_before_fit_raises(X):
 
 
 def test_kind_mismatch_rejected(X):
-    with pytest.raises(ValueError, match="requires"):
+    # a dense kind under an LSTM estimator fails fast (either the factory
+    # rejects lookback_window or the spec's input_kind check fires)
+    with pytest.raises(ValueError, match="Unknown hyperparameters|requires"):
         LSTMAutoEncoder(kind="feedforward_model", lookback_window=4).fit(X)
     with pytest.raises(ValueError, match="requires"):
         DenseAutoEncoder(kind="lstm_model").fit(X)

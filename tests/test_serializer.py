@@ -1,0 +1,221 @@
+"""Serializer tests: definition ⇄ pipeline round-trips (including reference
+``gordo_components.*`` / ``sklearn.*`` dotted paths via the alias table),
+dump/load dir-tree persistence, dumps/loads blobs, and transformer/pipeline
+behavior."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu.models.models import DenseAutoEncoder
+from gordo_components_tpu.models.pipeline import (
+    Pipeline,
+    TransformedTargetRegressor,
+    clone_pipeline,
+)
+from gordo_components_tpu.models.transformers import (
+    FunctionTransformer,
+    InfImputer,
+    MinMaxScaler,
+    StandardScaler,
+    multiply,
+)
+from gordo_components_tpu import serializer
+from gordo_components_tpu.serializer import (
+    dump,
+    dumps,
+    load,
+    load_metadata,
+    loads,
+    pipeline_from_definition,
+    pipeline_into_definition,
+)
+
+
+@pytest.fixture(scope="module")
+def X():
+    return np.random.default_rng(3).normal(size=(150, 4)).astype(np.float32) * 5 + 2
+
+
+# ------------------------------------------------------------- transformers
+def test_minmax_scaler_sklearn_parity(X):
+    import sklearn.preprocessing as skp
+
+    ours = MinMaxScaler(feature_range=(0, 1)).fit(X)
+    theirs = skp.MinMaxScaler().fit(X)
+    np.testing.assert_allclose(ours.transform(X), theirs.transform(X), atol=1e-5)
+    np.testing.assert_allclose(ours.inverse_transform(ours.transform(X)), X, atol=1e-4)
+
+
+def test_standard_scaler_sklearn_parity(X):
+    import sklearn.preprocessing as skp
+
+    ours = StandardScaler().fit(X)
+    theirs = skp.StandardScaler().fit(X)
+    np.testing.assert_allclose(ours.transform(X), theirs.transform(X), atol=1e-4)
+    partial = StandardScaler(with_std=False).fit(X)
+    np.testing.assert_allclose(
+        partial.transform(X), X - X.mean(axis=0), atol=1e-4
+    )
+
+
+def test_inf_imputer(X):
+    Xi = X.copy()
+    Xi[0, 0] = np.inf
+    Xi[1, 1] = -np.inf
+    out = InfImputer().fit_transform(Xi)
+    assert np.isfinite(out).all()
+    filled = InfImputer(inf_fill_value=99.0).fit_transform(Xi)
+    assert filled[0, 0] == 99.0
+
+
+def test_function_transformer_multiply(X):
+    ft = FunctionTransformer(
+        func="gordo_components.model.transformer_funcs.general.multiply",
+        kw_args={"factor": 2.0},
+    )
+    np.testing.assert_allclose(ft.fit_transform(X), multiply(X, 2.0))
+
+
+# ------------------------------------------------------------------ pipeline
+def test_pipeline_fit_predict_score(X):
+    pipe = Pipeline(
+        [
+            ("scaler", MinMaxScaler()),
+            ("model", DenseAutoEncoder(kind="feedforward_hourglass", epochs=3,
+                                       batch_size=32)),
+        ]
+    )
+    pipe.fit(X)
+    assert pipe.predict(X).shape == X.shape
+    # scaling should make the AE learn far better than the unscaled smoke runs
+    assert pipe.score(X) > -1.0
+    assert pipe["scaler"] is pipe[0]
+
+
+def test_transformed_target_regressor(X):
+    ttr = TransformedTargetRegressor(
+        regressor=DenseAutoEncoder(kind="feedforward_symmetric", dims=(8,),
+                                   epochs=2, batch_size=32),
+        transformer=MinMaxScaler(),
+    )
+    ttr.fit(X)
+    pred = ttr.predict(X)
+    assert pred.shape == X.shape
+    # contract: predict = transformer.inverse_transform(regressor.predict(X))
+    np.testing.assert_allclose(
+        pred,
+        ttr.transformer.inverse_transform(ttr.regressor.predict(X)),
+        rtol=1e-5,
+    )
+
+
+# -------------------------------------------------------- from/into definition
+REFERENCE_STYLE_DEFINITION = """
+sklearn.pipeline.Pipeline:
+  steps:
+    - sklearn.preprocessing.data.MinMaxScaler
+    - gordo_components.model.models.KerasAutoEncoder:
+        kind: feedforward_hourglass
+        compression_factor: 0.5
+        epochs: 2
+        batch_size: 32
+"""
+
+
+def test_from_definition_reference_yaml(X):
+    pipe = pipeline_from_definition(REFERENCE_STYLE_DEFINITION)
+    assert isinstance(pipe, Pipeline)
+    assert isinstance(pipe[0], MinMaxScaler)
+    assert isinstance(pipe[1], DenseAutoEncoder)
+    assert pipe[1].factory_kwargs["compression_factor"] == 0.5
+    pipe.fit(X)
+    assert pipe.predict(X).shape == X.shape
+
+
+def test_from_definition_short_names():
+    pipe = pipeline_from_definition(
+        {"Pipeline": {"steps": ["MinMaxScaler", {"DenseAutoEncoder": {"epochs": 1}}]}}
+    )
+    assert isinstance(pipe[0], MinMaxScaler)
+    assert isinstance(pipe[1], DenseAutoEncoder)
+
+
+def test_from_definition_nested_ttr():
+    obj = pipeline_from_definition(
+        {
+            "TransformedTargetRegressor": {
+                "regressor": {"DenseAutoEncoder": {"epochs": 1}},
+                "transformer": "MinMaxScaler",
+            }
+        }
+    )
+    assert isinstance(obj, TransformedTargetRegressor)
+    assert isinstance(obj.transformer, MinMaxScaler)
+
+
+def test_from_definition_rejects_garbage():
+    with pytest.raises(ValueError):
+        pipeline_from_definition({"not a definition": 1, "two keys": 2})
+    with pytest.raises(ValueError):
+        pipeline_from_definition("no_such_short_name")
+
+
+def test_round_trip_definition(X):
+    pipe = pipeline_from_definition(REFERENCE_STYLE_DEFINITION)
+    definition = pipeline_into_definition(pipe)
+    rebuilt = pipeline_from_definition(definition)
+    assert isinstance(rebuilt[0], MinMaxScaler)
+    assert rebuilt[1].get_params() == pipe[1].get_params()
+    json.dumps(definition)  # definition must be JSON-able
+
+
+# ------------------------------------------------------------- dump / load
+def test_dump_load_round_trip(X, tmp_path):
+    pipe = pipeline_from_definition(REFERENCE_STYLE_DEFINITION)
+    pipe.fit(X)
+    expected = pipe.predict(X)
+    out = str(tmp_path / "model")
+    dump(pipe, out, metadata={"name": "machine-1", "user": {"a": 1}})
+    assert os.path.exists(os.path.join(out, "definition.json"))
+    loaded = load(out)
+    np.testing.assert_allclose(loaded.predict(X), expected, rtol=1e-5)
+    meta = load_metadata(out)
+    assert meta["name"] == "machine-1"
+    assert load_metadata(str(tmp_path)) == {}  # missing metadata → empty
+
+
+def test_dumps_loads_round_trip(X):
+    pipe = Pipeline([MinMaxScaler(), DenseAutoEncoder(
+        kind="feedforward_symmetric", dims=(6,), epochs=1, batch_size=32)])
+    pipe.fit(X)
+    blob = dumps(pipe)
+    assert isinstance(blob, bytes) and len(blob) > 0
+    loaded = loads(blob)
+    np.testing.assert_allclose(loaded.predict(X), pipe.predict(X), rtol=1e-5)
+
+
+def test_dump_load_custom_step_names(X, tmp_path):
+    """Custom step names don't survive into_definition; fitted state must
+    still round-trip because it is keyed positionally."""
+    pipe = Pipeline([("my_scaler", MinMaxScaler()),
+                     ("my_model", DenseAutoEncoder(kind="feedforward_symmetric",
+                                                   dims=(6,), epochs=1,
+                                                   batch_size=32))])
+    pipe.fit(X)
+    out = str(tmp_path / "named")
+    dump(pipe, out)
+    loaded = load(out)
+    np.testing.assert_allclose(loaded.predict(X), pipe.predict(X), rtol=1e-5)
+
+
+def test_clone_pipeline_is_unfitted(X):
+    pipe = Pipeline([MinMaxScaler(), DenseAutoEncoder(
+        kind="feedforward_symmetric", dims=(6,), epochs=1, batch_size=32)])
+    pipe.fit(X)
+    fresh = clone_pipeline(pipe)
+    assert fresh[0].params_ is None
+    assert fresh[1].params_ is None
+    fresh.fit(X)  # must be fittable again
