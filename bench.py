@@ -1,0 +1,117 @@
+"""Benchmark: machines trained per hour (the north-star fleet metric).
+
+Measures two things on whatever device JAX provides (the real TPU chip
+under the driver; CPU elsewhere):
+
+1. **Baseline anchor** — one 10-tag dense-AE machine built the
+   single-machine way (BASELINE.md: the reference publishes no numbers, so
+   the measured single-machine rate is the comparison anchor; it
+   corresponds to the reference's one-model-per-pod throughput).
+2. **Fleet rate** — M machines trained in one compiled vmap-over-mesh
+   program (full build per machine: scaler fits, 3-fold masked CV,
+   error-scaler fit, final fit — identical work per machine to the
+   baseline path).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env overrides: BENCH_MACHINES (default 128), BENCH_ROWS (864 = 6 days at
+10-min resolution), BENCH_TAGS (10), BENCH_EPOCHS (10).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+
+def _synthetic(machines: int, rows: int, tags: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    base = np.sin(np.linspace(0, 24 * np.pi, rows))[None, :, None]
+    X = base * rng.uniform(0.5, 2.0, size=(machines, 1, tags)) + rng.normal(
+        scale=0.15, size=(machines, rows, tags)
+    )
+    return (X + rng.uniform(-3, 3, size=(machines, 1, tags))).astype(np.float32)
+
+
+def main() -> None:
+    machines = int(os.environ.get("BENCH_MACHINES", "128"))
+    rows = int(os.environ.get("BENCH_ROWS", "864"))
+    tags = int(os.environ.get("BENCH_TAGS", "10"))
+    epochs = int(os.environ.get("BENCH_EPOCHS", "10"))
+    n_splits = 3
+    batch_size = 64
+
+    from gordo_components_tpu.parallel import MachineBatch, train_fleet_arrays
+    from gordo_components_tpu.parallel.build_fleet import _analyze_model, _spec_for
+    from gordo_components_tpu.serializer import pipeline_from_definition
+
+    model_config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "TransformedTargetRegressor": {
+                    "regressor": {
+                        "Pipeline": {
+                            "steps": [
+                                "MinMaxScaler",
+                                {
+                                    "DenseAutoEncoder": {
+                                        "kind": "feedforward_hourglass",
+                                        "epochs": epochs,
+                                        "batch_size": batch_size,
+                                    }
+                                },
+                            ]
+                        }
+                    },
+                    "transformer": "MinMaxScaler",
+                }
+            }
+        }
+    }
+    probe = pipeline_from_definition(model_config)
+    spec = _spec_for(_analyze_model(probe), tags, tags, n_splits=n_splits)
+
+    def run(n_machines: int, seed: int) -> float:
+        X = _synthetic(n_machines, rows, tags, seed)
+        batch = MachineBatch(
+            X=X,
+            y=X.copy(),
+            w=np.ones((n_machines, rows), np.float32),
+            keys=jax.random.split(jax.random.PRNGKey(seed), n_machines),
+        )
+        started = time.perf_counter()
+        result = train_fleet_arrays(spec, batch)
+        jax.block_until_ready(result.params)
+        elapsed = time.perf_counter() - started
+        history = np.asarray(result.loss_history)
+        assert np.isfinite(history).all()
+        assert (history[:, -1] <= history[:, 0]).all(), "training must reduce loss"
+        return elapsed
+
+    # -- baseline anchor: single machine (includes its compile, as the
+    # reference's per-pod run includes TF graph setup) ----------------------
+    t_single = run(1, seed=1)
+
+    # -- fleet: warm-up run compiles the M-machine program, second run is
+    # the steady-state rate a long-lived fleet builder sustains -------------
+    run(machines, seed=2)
+    t_fleet = run(machines, seed=3)
+
+    fleet_rate = machines * 3600.0 / t_fleet
+    single_rate = 3600.0 / t_single
+    result = {
+        "metric": "machines_trained_per_hour",
+        "value": round(fleet_rate, 1),
+        "unit": f"machines/hour ({jax.devices()[0].platform}, {machines} "
+        f"machines x {rows}x{tags}, {epochs} epochs, {n_splits}-fold CV)",
+        "vs_baseline": round(fleet_rate / single_rate, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+"""The train entry point: config → data → model → CV → fit → artifact.
+
+Reference parity: ``gordo_components/builder/build_model.py`` [UNVERIFIED] —
+``build_model(name, model_config, data_config, metadata)`` assembles the
+dataset, materializes the pipeline, cross-validates, fits, and returns
+(model, metadata); ``provide_saved_model`` adds the md5-config-hash
+idempotency cache over a disk registry so orchestrator retries never
+rebuild a finished model (SURVEY.md §4.1 — the hot path of the system).
+
+TPU note: this is the *single-machine* path. The fleet engine
+(:mod:`gordo_components_tpu.parallel`) trains many machines inside one
+compiled program and reuses exactly this module's metadata/caching
+contract per machine.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import __version__
+from ..dataset import GordoBaseDataset
+from ..models.anomaly.base import AnomalyDetectorBase
+from ..models.metrics import METRICS
+from ..models.pipeline import clone_pipeline
+from ..serializer import dump, pipeline_from_definition, pipeline_into_definition
+from ..utils import disk_registry
+
+logger = logging.getLogger(__name__)
+
+
+def _dataset_from_config(data_config: Dict[str, Any]) -> GordoBaseDataset:
+    config = dict(data_config)
+    config.setdefault(
+        "type", "gordo_components_tpu.dataset.dataset.TimeSeriesDataset"
+    )
+    return GordoBaseDataset.from_dict(config)
+
+
+def _generic_cross_validate(
+    model, X: np.ndarray, y: np.ndarray, n_splits: int = 3
+) -> Dict[str, Any]:
+    """TimeSeriesSplit CV for plain pipelines (anomaly detectors carry their
+    own richer ``cross_validate`` that also fits the error scaler)."""
+    from sklearn.model_selection import TimeSeriesSplit
+
+    splits = []
+    for fold, (train_idx, test_idx) in enumerate(
+        TimeSeriesSplit(n_splits=n_splits).split(X)
+    ):
+        started = time.perf_counter()
+        fold_model = clone_pipeline(model)
+        fold_model.fit(X[train_idx], y[train_idx])
+        pred = np.asarray(fold_model.predict(X[test_idx]))
+        y_test = y[test_idx][len(y[test_idx]) - len(pred) :]
+        splits.append(
+            {
+                "fold": fold,
+                "n_train": int(len(train_idx)),
+                "n_test": int(len(test_idx)),
+                "scores": {name: fn(y_test, pred) for name, fn in METRICS.items()},
+                "duration_s": time.perf_counter() - started,
+            }
+        )
+    return {
+        "n_splits": n_splits,
+        "splits": splits,
+        "scores": {
+            name: float(np.mean([s["scores"][name] for s in splits]))
+            for name in METRICS
+        },
+    }
+
+
+def build_model(
+    name: str,
+    model_config: Dict[str, Any],
+    data_config: Dict[str, Any],
+    metadata: Optional[Dict[str, Any]] = None,
+    evaluation_config: Optional[Dict[str, Any]] = None,
+) -> Tuple[Any, Dict[str, Any]]:
+    """Build one machine's model; returns ``(fitted_model, metadata)``.
+
+    ``evaluation_config``: ``{"cv_mode": "full_build" | "cross_val_only" |
+    "build_only", "n_splits": int}`` (reference semantics: cross_val_only
+    skips the final fit; build_only skips CV).
+    """
+    evaluation_config = dict(evaluation_config or {})
+    cv_mode = evaluation_config.get("cv_mode", "full_build")
+    if cv_mode not in ("full_build", "cross_val_only", "build_only"):
+        raise ValueError(f"Unknown cv_mode {cv_mode!r}")
+    n_splits = int(evaluation_config.get("n_splits", 3))
+
+    build_started = time.perf_counter()
+    dataset = _dataset_from_config(data_config)
+    X, y = dataset.get_data()
+
+    model = pipeline_from_definition(model_config)
+
+    cv_metadata: Dict[str, Any] = {}
+    if cv_mode != "build_only":
+        cv_started = time.perf_counter()
+        if isinstance(model, AnomalyDetectorBase):
+            cv_metadata = model.cross_validate(X, y, n_splits=n_splits)
+        else:
+            X_arr = np.asarray(getattr(X, "values", X), dtype=np.float32)
+            y_arr = np.asarray(getattr(y, "values", y), dtype=np.float32)
+            cv_metadata = _generic_cross_validate(model, X_arr, y_arr, n_splits)
+        cv_metadata["cv_duration_s"] = time.perf_counter() - cv_started
+
+    fit_duration = None
+    if cv_mode != "cross_val_only":
+        fit_started = time.perf_counter()
+        model.fit(X, y)
+        fit_duration = time.perf_counter() - fit_started
+
+    build_metadata: Dict[str, Any] = {
+        "name": name,
+        "gordo_components_tpu_version": __version__,
+        "model": {
+            "model_config": pipeline_into_definition(model),
+            "model_builder_metadata": (
+                model.get_metadata() if hasattr(model, "get_metadata") else {}
+            ),
+            "cross_validation": cv_metadata,
+            "model_training_duration_s": fit_duration,
+            "model_creation_date": time.strftime("%Y-%m-%d %H:%M:%S%z"),
+        },
+        "dataset": dataset.get_metadata(),
+        "build_duration_s": time.perf_counter() - build_started,
+        "user_defined": dict(metadata or {}),
+    }
+    return model, build_metadata
+
+
+def calculate_model_key(
+    name: str,
+    model_config: Dict[str, Any],
+    data_config: Dict[str, Any],
+    gordo_version: Optional[str] = None,
+    evaluation_config: Optional[Dict[str, Any]] = None,
+) -> str:
+    """md5 over (name, model config, data config, evaluation config,
+    framework version) — the cache identity. Any change in any config or the
+    framework version produces a new key; identical configs always hash
+    identically (sorted-key JSON). ``evaluation_config`` participates so a
+    cached build_only artifact is never returned for a full_build request."""
+    payload = json.dumps(
+        {
+            "name": name,
+            "model_config": model_config,
+            "data_config": data_config,
+            "evaluation_config": evaluation_config or {},
+            "gordo_version": gordo_version or __version__,
+        },
+        sort_keys=True,
+        default=str,
+    )
+    return hashlib.md5(payload.encode()).hexdigest()
+
+
+def provide_saved_model(
+    name: str,
+    model_config: Dict[str, Any],
+    data_config: Dict[str, Any],
+    output_dir: str,
+    metadata: Optional[Dict[str, Any]] = None,
+    model_register_dir: Optional[str] = None,
+    replace_cache: bool = False,
+    evaluation_config: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Idempotent build: returns the model dir, reusing a cached build when
+    the config hash is registered and the artifact still exists."""
+    import os
+
+    cache_key = calculate_model_key(
+        name, model_config, data_config, evaluation_config=evaluation_config
+    )
+    if model_register_dir and not replace_cache:
+        cached = disk_registry.get_value(model_register_dir, cache_key)
+        if cached and os.path.isdir(cached):
+            logger.info(
+                "Model %r cache hit (key %s) -> %s", name, cache_key, cached
+            )
+            return cached
+        if cached:
+            logger.warning(
+                "Registry entry for %r points at missing dir %r; rebuilding",
+                name,
+                cached,
+            )
+    if model_register_dir and replace_cache:
+        disk_registry.delete_key(model_register_dir, cache_key)
+
+    model, build_metadata = build_model(
+        name, model_config, data_config, metadata, evaluation_config
+    )
+    build_metadata["model"]["cache_key"] = cache_key
+    dump(model, output_dir, metadata=build_metadata)
+    if model_register_dir:
+        disk_registry.write_key(model_register_dir, cache_key, output_dir)
+    return output_dir

@@ -1,0 +1,35 @@
+"""Fleet-scale parallelism: the TPU-native replacement for the reference's
+orchestration-level fan-out.
+
+The reference trains N machines as N Argo/Kubernetes pods with zero
+inter-pod communication (SURVEY.md §2.2 — "embarrassingly-parallel fleet
+fan-out", its only parallelism). Here that entire layer moves inside the
+compiler: machines with the same architecture are stacked on a leading
+``fleet`` axis, the single-machine train program is ``vmap``-ed over that
+axis, and the axis is sharded across a ``jax.sharding.Mesh`` so XLA
+partitions the fleet over chips (ICI-linked on real TPU topologies). One
+compiled program trains the whole fleet; host Python never loops over
+machines.
+"""
+
+from .mesh import fleet_mesh, fleet_sharding
+from .fleet import (
+    FleetSpec,
+    MachineBatch,
+    FleetResult,
+    make_machine_program,
+    train_fleet_arrays,
+)
+from .build_fleet import build_fleet, FleetMachineConfig
+
+__all__ = [
+    "fleet_mesh",
+    "fleet_sharding",
+    "FleetSpec",
+    "MachineBatch",
+    "FleetResult",
+    "make_machine_program",
+    "train_fleet_arrays",
+    "build_fleet",
+    "FleetMachineConfig",
+]

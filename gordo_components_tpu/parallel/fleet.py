@@ -1,0 +1,336 @@
+"""The compiled fleet-training program.
+
+One machine's ENTIRE build — input/target scaler fit, windowing,
+TimeSeriesSplit-style cross-validation, error-scaler fit on out-of-fold
+residuals, final fit — is a single pure function of
+``(X, y, w, key) → MachineResult``. :func:`train_fleet_arrays` ``vmap``s it
+over a stacked machine axis and shards that axis over a mesh: the
+reference's N Argo pods become one XLA program (SURVEY.md §2.2, §4.1).
+
+Static-shape strategy (the "hard part" SURVEY.md §8 calls out):
+
+- machines in a bucket share (rows N, features F, targets T, architecture);
+  shorter machines are padded with zero-weight rows, and the bucket's
+  machine count is padded to a multiple of the mesh size with zero-weight
+  machines — masks make padding exact, not approximate;
+- CV folds are *weight masks* over the padded row axis, not array slices,
+  so one compilation serves every machine regardless of its true row count
+  (fold boundaries follow sklearn TimeSeriesSplit on the padded index);
+- the per-fold fits reuse the single-machine jittable fit program
+  (:func:`gordo_components_tpu.models.train.make_fit_fn`) unchanged — the
+  fleet engine is a transform over the single path, not a fork of it.
+
+Residual semantics: the model trains in scaled space; predictions are
+inverse-transformed and residuals computed in RAW target units, matching the
+reference's canonical ``DiffBasedAnomalyDetector(TransformedTargetRegressor
+(Pipeline([scaler, model])), MinMaxScaler())`` configuration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.train import make_fit_fn, make_predict_fn
+from ..ops.scaling import ScalerParams
+from .mesh import fleet_sharding, pad_to_multiple
+
+_EPS = 1e-12
+
+
+class FleetSpec(NamedTuple):
+    """Static (compile-time) description of one bucket's machines."""
+
+    module: Any  # flax module — shared architecture
+    optimizer: Any  # optax transform
+    loss: str
+    lookahead: Optional[int]  # None=flat, 0=reconstruction, 1=forecast
+    lookback_window: int
+    scaler: str  # "minmax" | "standard" | "none"
+    feature_range: Tuple[float, float]
+    batch_size: int
+    epochs: int
+    n_splits: int  # 0 disables CV (error scaler fits on train residuals)
+    use_dropout: bool = False
+    # True ⇔ the config wraps the model in a TransformedTargetRegressor:
+    # targets train scaled and predictions are inverse-transformed. False
+    # (plain Pipeline / bare estimator) ⇔ targets stay raw, matching the
+    # single-machine path where Pipeline.fit passes y through untransformed.
+    scale_targets: bool = True
+    # ("standard" only) (with_mean, with_std)
+    scaler_options: Tuple[bool, bool] = (True, True)
+
+
+class MachineBatch(NamedTuple):
+    """Stacked per-machine data: X (M,N,F) raw, y (M,N,T) raw, w (M,N) row
+    weights (0 on padding), keys (M,2) uint32 PRNG keys."""
+
+    X: jnp.ndarray
+    y: jnp.ndarray
+    w: jnp.ndarray
+    keys: jnp.ndarray
+
+
+class MachineResult(NamedTuple):
+    params: Any  # model params (stacked under vmap)
+    input_scaler: ScalerParams  # (F,)
+    target_scaler: ScalerParams  # (T,)
+    error_scaler: ScalerParams  # (T,) minmax over |raw residuals|
+    loss_history: jnp.ndarray  # (epochs,)
+    cv_scores: jnp.ndarray  # (n_splits,) masked explained variance (or (0,))
+    tag_thresholds: jnp.ndarray  # (T,) 99th pct of scaled residuals
+    total_threshold: jnp.ndarray  # () 99th pct of residual L2 norms
+
+
+FleetResult = MachineResult  # stacked variant returned by train_fleet_arrays
+
+
+def _masked_minmax(x, w, feature_range) -> ScalerParams:
+    lo, hi = feature_range
+    mask = (w > 0)[:, None]
+    xmin = jnp.min(jnp.where(mask, x, jnp.inf), axis=0)
+    xmax = jnp.max(jnp.where(mask, x, -jnp.inf), axis=0)
+    # all-padding safety: no real rows → identity scaler
+    xmin = jnp.where(jnp.isfinite(xmin), xmin, 0.0)
+    xmax = jnp.where(jnp.isfinite(xmax), xmax, 1.0)
+    span = xmax - xmin
+    scale = (hi - lo) / jnp.where(span < _EPS, 1.0, span)
+    return ScalerParams(scale=scale, offset=lo - xmin * scale)
+
+
+def _masked_standard(x, w, with_mean: bool = True, with_std: bool = True) -> ScalerParams:
+    wsum = jnp.maximum(jnp.sum(w), 1.0)
+    mean = jnp.sum(x * w[:, None], axis=0) / wsum
+    var = jnp.sum((x - mean) ** 2 * w[:, None], axis=0) / wsum
+    std = jnp.sqrt(var)
+    scale = (
+        1.0 / jnp.where(std < _EPS, 1.0, std)
+        if with_std
+        else jnp.ones_like(std)
+    )
+    offset = -mean * scale if with_mean else jnp.zeros_like(mean)
+    return ScalerParams(scale=scale, offset=offset)
+
+
+def _fit_scaler(spec: "FleetSpec", x, w) -> ScalerParams:
+    if spec.scaler == "minmax":
+        return _masked_minmax(x, w, spec.feature_range)
+    if spec.scaler == "standard":
+        with_mean, with_std = spec.scaler_options
+        return _masked_standard(x, w, with_mean, with_std)
+    if spec.scaler == "none":
+        n = x.shape[1]
+        return ScalerParams(scale=jnp.ones(n), offset=jnp.zeros(n))
+    raise ValueError(f"Unknown scaler kind {spec.scaler!r}")
+
+
+def _masked_explained_variance(y, pred, w) -> jnp.ndarray:
+    """Weighted explained variance; NaN when the fold has no real rows (so
+    empty folds report as missing, never as a fake perfect score)."""
+    w_total = jnp.sum(w)
+    wsum = jnp.maximum(w_total, 1.0)
+    wcol = w[:, None]
+    diff = y - pred
+    dmean = jnp.sum(diff * wcol, axis=0) / wsum
+    dvar = jnp.sum((diff - dmean) ** 2 * wcol, axis=0) / wsum
+    ymean = jnp.sum(y * wcol, axis=0) / wsum
+    yvar = jnp.sum((y - ymean) ** 2 * wcol, axis=0) / wsum
+    ev = 1.0 - dvar / jnp.where(yvar < _EPS, 1.0, yvar)
+    score = jnp.mean(jnp.where(yvar < _EPS, jnp.where(dvar < _EPS, 1.0, 0.0), ev))
+    return jnp.where(w_total > 0, score, jnp.nan)
+
+
+def make_machine_program(
+    spec: FleetSpec, n_rows: int, n_features: int, n_targets: int
+) -> Callable:
+    """Pure fn ``(X (N,F), y (N,T), w (N,), key) → MachineResult`` — the
+    whole per-machine build as one traceable program."""
+
+    apply_fn = spec.module.apply
+    fit_fn = make_fit_fn(
+        apply_fn,
+        spec.optimizer,
+        loss=spec.loss,
+        batch_size=spec.batch_size,
+        epochs=spec.epochs,
+        use_dropout=spec.use_dropout,
+    )
+    predict_fn = make_predict_fn(apply_fn)
+
+    L = spec.lookback_window
+    la = spec.lookahead
+    if la is None:
+        n_samples = n_rows
+    else:
+        n_samples = n_rows - L + 1 - la
+        if n_samples < spec.batch_size:
+            raise ValueError(
+                f"Bucket rows {n_rows} give {n_samples} windows "
+                f"(< batch_size {spec.batch_size})"
+            )
+    padded = pad_to_multiple(n_samples, spec.batch_size)
+
+    def prepare(Xs, ys, w):
+        """Scaled rows → (inputs, targets, sample weights) padded to a whole
+        number of batches.
+
+        Row padding may sit ANYWHERE in the row axis (build_fleet right-
+        aligns short machines so CV test folds still cover their real data):
+        a window's weight is the MIN of its rows' weights times its target
+        row's weight, so any window touching padding is masked out exactly.
+        """
+        if la is None:
+            inputs, targets, wt = Xs, ys, w
+        else:
+            idx = np.arange(n_samples)[:, None] + np.arange(L)[None, :]
+            inputs = Xs[idx]  # (n_samples, L, F) static gather
+            offset = L - 1 + la
+            targets = ys[offset : offset + n_samples]
+            wt = jnp.min(w[idx], axis=1) * w[offset : offset + n_samples]
+        pad = padded - inputs.shape[0]
+        if pad:
+            inputs = jnp.pad(inputs, ((0, pad),) + ((0, 0),) * (inputs.ndim - 1))
+            targets = jnp.pad(targets, ((0, pad), (0, 0)))
+            wt = jnp.pad(wt, (0, pad))
+        return inputs, targets, wt
+
+    # static CV fold masks over the padded sample axis (TimeSeriesSplit
+    # boundaries on the padded index; weights make them exact per machine)
+    fold_masks = []
+    for k in range(1, spec.n_splits + 1):
+        b0 = padded * k // (spec.n_splits + 1)
+        b1 = padded * (k + 1) // (spec.n_splits + 1)
+        arange = np.arange(padded)
+        fold_masks.append(
+            (
+                jnp.asarray((arange < b0).astype(np.float32)),
+                jnp.asarray(((arange >= b0) & (arange < b1)).astype(np.float32)),
+            )
+        )
+
+    sample_shape = (1, n_features) if la is None else (1, L, n_features)
+
+    def program(X, y, w, key) -> MachineResult:
+        sx = _fit_scaler(spec, X, w)
+        if spec.scale_targets:
+            sy = _fit_scaler(spec, y, w)
+        else:
+            # no TransformedTargetRegressor in the config: the model trains
+            # against raw targets (Pipeline.fit passes y through untouched)
+            sy = ScalerParams(
+                scale=jnp.ones(n_targets), offset=jnp.zeros(n_targets)
+            )
+        Xs = X * sx.scale + sx.offset
+        ys = y * sy.scale + sy.offset
+        inputs, targets, wt = prepare(Xs, ys, w)
+        raw_targets = (targets - sy.offset) / sy.scale
+
+        keys = jax.random.split(key, spec.n_splits + 2)
+        init_key, fit_key, fold_keys = keys[0], keys[1], keys[2:]
+        params0 = spec.module.init(
+            init_key, jnp.zeros(sample_shape, jnp.float32), deterministic=True
+        )["params"]
+
+        emin = jnp.full((n_targets,), jnp.inf)
+        emax = jnp.full((n_targets,), -jnp.inf)
+        cv_scores = []
+        fold_errors = []
+        fold_test_masks = []
+        for k, (train_mask, test_mask) in enumerate(fold_masks):
+            res = fit_fn(params0, inputs, targets, wt * train_mask, fold_keys[k])
+            pred = predict_fn(res.params, inputs)
+            pred_raw = (pred - sy.offset) / sy.scale
+            err = jnp.abs(raw_targets - pred_raw)
+            wtest = wt * test_mask
+            mask = (wtest > 0)[:, None]
+            emin = jnp.minimum(emin, jnp.min(jnp.where(mask, err, jnp.inf), axis=0))
+            emax = jnp.maximum(emax, jnp.max(jnp.where(mask, err, -jnp.inf), axis=0))
+            cv_scores.append(
+                _masked_explained_variance(raw_targets, pred_raw, wtest)
+            )
+            fold_errors.append(err)
+            fold_test_masks.append(wtest)
+
+        final = fit_fn(params0, inputs, targets, wt, fit_key)
+
+        if spec.n_splits == 0:
+            # no CV: error scaler from final-model residuals on all real rows
+            pred = predict_fn(final.params, inputs)
+            pred_raw = (pred - sy.offset) / sy.scale
+            err = jnp.abs(raw_targets - pred_raw)
+            mask = (wt > 0)[:, None]
+            emin = jnp.min(jnp.where(mask, err, jnp.inf), axis=0)
+            emax = jnp.max(jnp.where(mask, err, -jnp.inf), axis=0)
+            fold_errors = [err]
+            fold_test_masks = [wt]
+
+        emin = jnp.where(jnp.isfinite(emin), emin, 0.0)
+        emax = jnp.where(jnp.isfinite(emax), emax, 1.0)
+        span = emax - emin
+        e_scale = 1.0 / jnp.where(span < _EPS, 1.0, span)
+        error_scaler = ScalerParams(scale=e_scale, offset=-emin * e_scale)
+
+        # thresholds: 99th percentile of scaled out-of-fold residuals
+        errs = jnp.stack(fold_errors)  # (K, P, T)
+        masks = jnp.stack(fold_test_masks)  # (K, P)
+        scaled = errs * error_scaler.scale + error_scaler.offset
+        scaled = jnp.where((masks > 0)[:, :, None], scaled, jnp.nan)
+        tag_thresholds = jnp.nan_to_num(
+            jnp.nanpercentile(scaled.reshape(-1, n_targets), 99, axis=0)
+        )
+        norms = jnp.linalg.norm(
+            jnp.nan_to_num(scaled), axis=-1
+        ) + jnp.where(masks > 0, 0.0, jnp.nan)
+        total_threshold = jnp.nan_to_num(jnp.nanpercentile(norms, 99))
+
+        return MachineResult(
+            params=final.params,
+            input_scaler=sx,
+            target_scaler=sy,
+            error_scaler=error_scaler,
+            loss_history=final.loss_history,
+            cv_scores=(
+                jnp.stack(cv_scores) if cv_scores else jnp.zeros((0,))
+            ),
+            tag_thresholds=tag_thresholds,
+            total_threshold=total_threshold,
+        )
+
+    return program
+
+
+def train_fleet_arrays(
+    spec: FleetSpec,
+    batch: MachineBatch,
+    mesh=None,
+) -> MachineResult:
+    """Train a stacked bucket of machines; returns stacked results.
+
+    With ``mesh``, the machine axis is sharded over it (machine count must
+    be a multiple of the mesh size — pad with zero-weight machines) and XLA
+    partitions the whole program; without, the vmapped program runs on the
+    default device.
+    """
+    n_machines, n_rows, n_features = batch.X.shape
+    n_targets = batch.y.shape[2]
+    program = jax.vmap(
+        make_machine_program(spec, n_rows, n_features, n_targets)
+    )
+    if mesh is None:
+        return jax.jit(program)(batch.X, batch.y, batch.w, batch.keys)
+    if n_machines % mesh.size != 0:
+        raise ValueError(
+            f"Machine count {n_machines} must divide evenly over mesh size "
+            f"{mesh.size}; pad with zero-weight machines "
+            "(build_fleet does this automatically)"
+        )
+    shard = fleet_sharding(mesh)
+    jitted = jax.jit(
+        program,
+        in_shardings=(shard, shard, shard, shard),
+        out_shardings=shard,
+    )
+    return jitted(batch.X, batch.y, batch.w, batch.keys)

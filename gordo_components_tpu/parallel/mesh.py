@@ -1,0 +1,45 @@
+"""Device-mesh helpers.
+
+Single place that decides how the fleet axis maps onto hardware. On a TPU
+pod slice the mesh covers all chips (ICI-connected); on CPU test runs it
+covers the virtual devices created by
+``--xla_force_host_platform_device_count``. Everything downstream only sees
+``Mesh`` + ``NamedSharding`` — the same code compiles for 1 chip, 8 virtual
+CPUs, or a v5e-16.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+FLEET_AXIS = "fleet"
+
+
+def fleet_mesh(n_devices: Optional[int] = None, axis_name: str = FLEET_AXIS) -> Mesh:
+    """1-D mesh over (up to) ``n_devices`` available devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"Requested {n_devices} devices but only {len(devices)} exist"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def fleet_sharding(mesh: Mesh, axis_name: str = FLEET_AXIS) -> NamedSharding:
+    """Shard the leading (machine) axis over the mesh; trailing dims are
+    implicitly replicated, so one spec serves arrays of any rank."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` ≥ ``n`` (machine-axis padding so the
+    fleet divides evenly across mesh devices)."""
+    if multiple <= 0:
+        raise ValueError(f"multiple must be positive, got {multiple}")
+    return ((n + multiple - 1) // multiple) * multiple

@@ -1,0 +1,54 @@
+"""Tiny file-per-key registry mapping config hashes → built-model dirs.
+
+Reference parity: ``gordo_components/util/disk_registry.py`` [UNVERIFIED] —
+``write_key`` / ``get_value`` / ``delete_key``, one file per key under a
+registry dir. This is what makes fleet builds idempotent: an orchestrator
+retry finds the key and skips the rebuild (SURVEY.md §6.3).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_KEY_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+def _key_path(registry_dir: str, key: str) -> str:
+    if not _KEY_RE.match(key):
+        raise ValueError(
+            f"Registry key {key!r} must match {_KEY_RE.pattern} "
+            "(it is used as a filename)"
+        )
+    return os.path.join(registry_dir, f"{key}.md5")
+
+
+def write_key(registry_dir: str, key: str, value: str) -> None:
+    os.makedirs(registry_dir, exist_ok=True)
+    path = _key_path(registry_dir, key)
+    # atomic-ish: write sidecar then rename, so readers never see partials
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(value)
+    os.replace(tmp, path)
+    logger.debug("Registry write %s -> %s", key, value)
+
+
+def get_value(registry_dir: str, key: str) -> Optional[str]:
+    path = _key_path(registry_dir, key)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return fh.read()
+
+
+def delete_key(registry_dir: str, key: str) -> bool:
+    path = _key_path(registry_dir, key)
+    if os.path.exists(path):
+        os.remove(path)
+        return True
+    return False

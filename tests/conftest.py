@@ -4,10 +4,18 @@ partitioning without TPU hardware (SURVEY.md §5 rebuild implication)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the 8-virtual-device CPU platform. A pytest plugin imports jax
+# before this conftest runs, so mutating JAX_PLATFORMS in os.environ is too
+# late — update jax.config instead (valid until first backend init), and set
+# XLA_FLAGS (read at backend init, which has not happened yet).
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

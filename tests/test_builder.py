@@ -1,0 +1,153 @@
+"""Builder integration tests (SURVEY.md §5: RandomDataset + tiny epochs →
+metadata shape, CV scores present, cache hit on second provide_saved_model)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu.builder import (
+    build_model,
+    calculate_model_key,
+    provide_saved_model,
+)
+from gordo_components_tpu.models.anomaly import DiffBasedAnomalyDetector
+from gordo_components_tpu.serializer import load, load_metadata
+from gordo_components_tpu.utils import disk_registry
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-04T00:00:00+00:00",
+    "tag_list": ["tag-a", "tag-b", "tag-c"],
+}
+
+MODEL_CONFIG = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_hourglass", "epochs": 2,
+                                  "batch_size": 32}},
+        ]
+    }
+}
+
+ANOMALY_CONFIG = {
+    "DiffBasedAnomalyDetector": {
+        "base_estimator": MODEL_CONFIG,
+    }
+}
+
+
+def test_build_model_metadata_contract():
+    model, meta = build_model("machine-1", MODEL_CONFIG, DATA_CONFIG,
+                              metadata={"owner": "team-x"})
+    assert meta["name"] == "machine-1"
+    assert meta["user_defined"] == {"owner": "team-x"}
+    assert meta["dataset"]["x_shape"][1] == 3
+    cv = meta["model"]["cross_validation"]
+    assert cv["n_splits"] == 3
+    assert "explained_variance_score" in cv["scores"]
+    assert meta["model"]["model_training_duration_s"] > 0
+    assert meta["build_duration_s"] > 0
+    json.dumps(meta, default=str)  # must serialize for metadata.json
+    assert model.predict(np.zeros((5, 3), np.float32)).shape == (5, 3)
+
+
+def test_build_model_anomaly_detector_cv():
+    model, meta = build_model("machine-2", ANOMALY_CONFIG, DATA_CONFIG)
+    assert isinstance(model, DiffBasedAnomalyDetector)
+    # anomaly CV also fits the error scaler
+    assert model.scaler.params_ is not None
+    assert meta["model"]["cross_validation"]["n_splits"] == 3
+
+
+def test_build_model_cv_modes():
+    _, meta = build_model("m", MODEL_CONFIG, DATA_CONFIG,
+                          evaluation_config={"cv_mode": "build_only"})
+    assert meta["model"]["cross_validation"] == {}
+    assert meta["model"]["model_training_duration_s"] > 0
+
+    model, meta = build_model("m", MODEL_CONFIG, DATA_CONFIG,
+                              evaluation_config={"cv_mode": "cross_val_only",
+                                                 "n_splits": 2})
+    assert meta["model"]["cross_validation"]["n_splits"] == 2
+    assert meta["model"]["model_training_duration_s"] is None
+
+    with pytest.raises(ValueError, match="cv_mode"):
+        build_model("m", MODEL_CONFIG, DATA_CONFIG,
+                    evaluation_config={"cv_mode": "bogus"})
+
+
+def test_model_key_stability():
+    k1 = calculate_model_key("m", MODEL_CONFIG, DATA_CONFIG)
+    k2 = calculate_model_key("m", json.loads(json.dumps(MODEL_CONFIG)), DATA_CONFIG)
+    assert k1 == k2  # identical configs hash identically
+    assert calculate_model_key("other", MODEL_CONFIG, DATA_CONFIG) != k1
+    changed = {**DATA_CONFIG, "tag_list": ["tag-a"]}
+    assert calculate_model_key("m", MODEL_CONFIG, changed) != k1
+
+
+def test_provide_saved_model_cache(tmp_path):
+    out1 = str(tmp_path / "model1")
+    registry = str(tmp_path / "registry")
+    result1 = provide_saved_model(
+        "machine-1", MODEL_CONFIG, DATA_CONFIG, out1,
+        model_register_dir=registry,
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    assert result1 == out1
+    meta = load_metadata(out1)
+    assert meta["model"]["cache_key"] == calculate_model_key(
+        "machine-1", MODEL_CONFIG, DATA_CONFIG,
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    # second call: cache hit — returns the FIRST dir even with a new output_dir
+    out2 = str(tmp_path / "model2")
+    result2 = provide_saved_model(
+        "machine-1", MODEL_CONFIG, DATA_CONFIG, out2,
+        model_register_dir=registry,
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    assert result2 == out1
+    assert not os.path.exists(out2)
+    # loaded artifact predicts
+    model = load(result2)
+    assert model.predict(np.zeros((4, 3), np.float32)).shape == (4, 3)
+    # replace_cache forces a rebuild into the new dir
+    result3 = provide_saved_model(
+        "machine-1", MODEL_CONFIG, DATA_CONFIG, out2,
+        model_register_dir=registry, replace_cache=True,
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    assert result3 == out2
+
+
+def test_provide_saved_model_stale_registry(tmp_path):
+    """Registry pointing at a deleted dir must rebuild, not return garbage."""
+    registry = str(tmp_path / "registry")
+    key = calculate_model_key(
+        "machine-1", MODEL_CONFIG, DATA_CONFIG,
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    disk_registry.write_key(registry, key, str(tmp_path / "gone"))
+    out = str(tmp_path / "fresh")
+    result = provide_saved_model(
+        "machine-1", MODEL_CONFIG, DATA_CONFIG, out,
+        model_register_dir=registry,
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    assert result == out
+    assert disk_registry.get_value(registry, key) == out
+
+
+def test_disk_registry_basics(tmp_path):
+    d = str(tmp_path)
+    assert disk_registry.get_value(d, "abc123") is None
+    disk_registry.write_key(d, "abc123", "/some/dir")
+    assert disk_registry.get_value(d, "abc123") == "/some/dir"
+    assert disk_registry.delete_key(d, "abc123")
+    assert not disk_registry.delete_key(d, "abc123")
+    with pytest.raises(ValueError, match="filename"):
+        disk_registry.write_key(d, "../escape", "x")

@@ -1,0 +1,319 @@
+"""Fleet-engine tests on the 8-virtual-device CPU mesh (conftest forces
+--xla_force_host_platform_device_count=8): stacked training correctness,
+mesh sharding, padding masks, artifact parity with the single-machine path,
+and idempotent resume."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from gordo_components_tpu.models.anomaly import DiffBasedAnomalyDetector
+from gordo_components_tpu.parallel import (
+    FleetMachineConfig,
+    MachineBatch,
+    build_fleet,
+    fleet_mesh,
+    train_fleet_arrays,
+)
+from gordo_components_tpu.parallel.build_fleet import _analyze_model, _spec_for
+from gordo_components_tpu.serializer import load, load_metadata, pipeline_from_definition
+
+MODEL_CONFIG = {
+    "DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "TransformedTargetRegressor": {
+                "regressor": {
+                    "Pipeline": {
+                        "steps": [
+                            "MinMaxScaler",
+                            {"DenseAutoEncoder": {"kind": "feedforward_hourglass",
+                                                  "epochs": 4, "batch_size": 32}},
+                        ]
+                    }
+                },
+                "transformer": "MinMaxScaler",
+            }
+        }
+    }
+}
+
+
+def _data_config(tags):
+    return {
+        "type": "RandomDataset",
+        "train_start_date": "2023-01-01T00:00:00+00:00",
+        "train_end_date": "2023-01-04T00:00:00+00:00",
+        "tag_list": list(tags),
+    }
+
+
+def _make_spec_and_batch(n_machines, n_rows=256, n_features=3, seed=0,
+                         model_config=MODEL_CONFIG, n_splits=2):
+    rng = np.random.default_rng(seed)
+    probe = pipeline_from_definition(model_config)
+    spec = _spec_for(_analyze_model(probe), n_features, n_features, n_splits)
+    X = rng.normal(size=(n_machines, n_rows, n_features)).astype(np.float32)
+    X += np.sin(np.linspace(0, 12, n_rows))[None, :, None] * 2
+    batch = MachineBatch(
+        X=X,
+        y=X.copy(),
+        w=np.ones((n_machines, n_rows), np.float32),
+        keys=jax.random.split(jax.random.PRNGKey(0), n_machines),
+    )
+    return spec, batch
+
+
+def test_devices_available():
+    assert jax.device_count() == 8, "conftest must provide 8 virtual devices"
+
+
+def test_fleet_trains_stacked_machines():
+    spec, batch = _make_spec_and_batch(4)
+    result = train_fleet_arrays(spec, batch)
+    # stacked shapes: leading machine axis everywhere
+    assert result.loss_history.shape == (4, spec.epochs)
+    assert result.cv_scores.shape == (4, 2)
+    assert result.input_scaler.scale.shape == (4, 3)
+    assert result.error_scaler.scale.shape == (4, 3)
+    leaves = jax.tree_util.tree_leaves(result.params)
+    assert all(leaf.shape[0] == 4 for leaf in leaves)
+    hist = np.asarray(result.loss_history)
+    assert np.isfinite(hist).all()
+    # every machine's loss decreased
+    assert (hist[:, -1] < hist[:, 0]).all()
+    # different data -> different trained params
+    k0 = np.asarray(leaves[0][0])
+    k1 = np.asarray(leaves[0][1])
+    assert not np.allclose(k0, k1)
+
+
+def test_fleet_on_mesh_sharded():
+    mesh = fleet_mesh()
+    assert mesh.size == 8
+    spec, batch = _make_spec_and_batch(8)
+    result = train_fleet_arrays(spec, batch, mesh=mesh)
+    hist = np.asarray(result.loss_history)
+    assert hist.shape[0] == 8
+    assert np.isfinite(hist).all()
+    # sharded run must agree with unsharded run (same program, same keys)
+    plain = train_fleet_arrays(spec, batch)
+    np.testing.assert_allclose(
+        hist, np.asarray(plain.loss_history), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_fleet_mesh_divisibility_enforced():
+    mesh = fleet_mesh()
+    spec, batch = _make_spec_and_batch(3)
+    with pytest.raises(ValueError, match="divide evenly"):
+        train_fleet_arrays(spec, batch, mesh=mesh)
+
+
+def test_zero_weight_padding_machine_is_finite():
+    """A fully-padded (weight-0) machine must not poison the bucket with
+    NaNs — this is what makes machine-axis padding safe."""
+    spec, batch = _make_spec_and_batch(2)
+    w = batch.w.copy()
+    w[1] = 0.0
+    result = train_fleet_arrays(spec, batch._replace(w=w))
+    assert np.isfinite(np.asarray(result.loss_history)).all()
+    assert np.isfinite(np.asarray(result.input_scaler.scale)).all()
+    assert np.isfinite(np.asarray(result.error_scaler.scale)).all()
+
+
+def test_row_padding_masks():
+    """Machines with fewer real rows than the bucket width train correctly:
+    the scaler must reflect only real rows."""
+    spec, batch = _make_spec_and_batch(2, n_rows=256)
+    X = batch.X.copy()
+    w = batch.w.copy()
+    # machine 1: only 200 real rows; padding is huge garbage that masks
+    # must exclude
+    X[1, 200:] = 1e9
+    w[1, 200:] = 0.0
+    result = train_fleet_arrays(spec, batch._replace(X=X, y=X.copy(), w=w))
+    scale = np.asarray(result.input_scaler.scale[1])
+    # minmax scale over real rows only: 1/(max-min) of N(0,1)+2sin data,
+    # nowhere near 1/1e9
+    assert (scale > 1e-3).all()
+    assert np.isfinite(np.asarray(result.loss_history)).all()
+
+
+def test_lstm_fleet_bucket():
+    lstm_config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "TransformedTargetRegressor": {
+                    "regressor": {
+                        "Pipeline": {
+                            "steps": [
+                                "MinMaxScaler",
+                                {"LSTMAutoEncoder": {"kind": "lstm_symmetric",
+                                                     "lookback_window": 6,
+                                                     "dims": [8],
+                                                     "epochs": 1,
+                                                     "batch_size": 32}},
+                            ]
+                        }
+                    },
+                    "transformer": "MinMaxScaler",
+                }
+            }
+        }
+    }
+    spec, batch = _make_spec_and_batch(2, n_rows=128,
+                                       model_config=lstm_config, n_splits=2)
+    assert spec.lookahead == 0 and spec.lookback_window == 6
+    result = train_fleet_arrays(spec, batch)
+    assert np.isfinite(np.asarray(result.loss_history)).all()
+
+
+def test_build_fleet_end_to_end(tmp_path):
+    mesh = fleet_mesh()
+    machines = [
+        FleetMachineConfig(
+            name=f"machine-{i}",
+            model_config=MODEL_CONFIG,
+            data_config=_data_config([f"m{i}-a", f"m{i}-b", f"m{i}-c"]),
+            metadata={"idx": i},
+        )
+        for i in range(3)
+    ]
+    out = str(tmp_path / "fleet")
+    registry = str(tmp_path / "registry")
+    dirs = build_fleet(machines, out, model_register_dir=registry, mesh=mesh,
+                       n_splits=2)
+    assert set(dirs) == {"machine-0", "machine-1", "machine-2"}
+
+    # each artifact is a fully-functional anomaly model, same format as the
+    # single-machine builder's
+    for i, (name, model_dir) in enumerate(sorted(dirs.items())):
+        model = load(model_dir)
+        assert isinstance(model, DiffBasedAnomalyDetector)
+        X = np.random.default_rng(i).normal(size=(40, 3)).astype(np.float32)
+        frame = model.anomaly(X)
+        assert len(frame) == 40
+        assert np.isfinite(
+            np.ravel(frame["total-anomaly-score"].values)
+        ).all()
+        meta = load_metadata(model_dir)
+        assert meta["name"] == name
+        assert meta["model"]["fleet"]["bucket_size"] == 3
+        assert meta["model"]["model_builder_metadata"]["cross_validation"][
+            "n_splits"
+        ] == 2
+
+    # resume: second call is pure cache hits (no rebuild -> same dirs)
+    dirs2 = build_fleet(machines, str(tmp_path / "other"),
+                        model_register_dir=registry, mesh=mesh, n_splits=2)
+    assert dirs2 == dirs
+
+
+def test_fleet_pipeline_shape_predicts_raw_space(tmp_path):
+    """Config WITHOUT TransformedTargetRegressor: the fleet must train
+    against raw targets (Pipeline.fit passes y through untransformed), so
+    the served artifact predicts in raw units."""
+    config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "Pipeline": {
+                    "steps": [
+                        "MinMaxScaler",
+                        {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                              "dims": [8], "epochs": 6,
+                                              "batch_size": 32}},
+                    ]
+                }
+            }
+        }
+    }
+    probe = pipeline_from_definition(config)
+    spec = _spec_for(_analyze_model(probe), 3, 3, 2)
+    assert spec.scale_targets is False
+    _, batch = _make_spec_and_batch(2, model_config=config)
+    result = train_fleet_arrays(spec, batch)
+    # no TTR -> target scaler is exactly identity: the model trains against
+    # raw targets and the error scaler sees true raw residuals
+    np.testing.assert_array_equal(np.asarray(result.target_scaler.scale), 1.0)
+    np.testing.assert_array_equal(np.asarray(result.target_scaler.offset), 0.0)
+
+    # and the artifact built from it serves without a target transform
+    machines = [FleetMachineConfig("raw-m", config,
+                                   _data_config(["r-a", "r-b", "r-c"]))]
+    dirs = build_fleet(machines, str(tmp_path / "out"), n_splits=2)
+    model = load(dirs["raw-m"])
+    X = np.random.default_rng(0).normal(size=(60, 3)).astype(np.float32)
+    frame = model.anomaly(X)
+    assert np.isfinite(np.ravel(frame["total-anomaly-score"].values)).all()
+
+
+def test_fleet_short_machine_gets_real_thresholds():
+    """A machine much shorter than the bucket must still get finite nonzero
+    thresholds and honest (non-fake) CV scores — right-aligned padding puts
+    its data in the late CV folds."""
+    spec, batch = _make_spec_and_batch(2, n_rows=256, n_splits=3)
+    X = batch.X.copy()
+    w = batch.w.copy()
+    # machine 1: only 64 real rows, RIGHT-aligned (leading padding)
+    X[1, :192] = 0.0
+    w[1, :192] = 0.0
+    result = train_fleet_arrays(spec, batch._replace(X=X, y=X.copy(), w=w))
+    thresholds = np.asarray(result.tag_thresholds[1])
+    assert np.isfinite(thresholds).all()
+    assert (thresholds > 0).any(), "short machine must get usable thresholds"
+    cv = np.asarray(result.cv_scores[1])
+    # early folds may be empty (NaN) but never reported as fake scores, and
+    # at least the last fold must cover real data
+    assert np.isfinite(cv[-1])
+
+
+def test_fleet_cache_key_includes_eval_config():
+    from gordo_components_tpu.builder import calculate_model_key
+
+    base = calculate_model_key("m", MODEL_CONFIG, _data_config(["a"]))
+    fleet = calculate_model_key(
+        "m", MODEL_CONFIG, _data_config(["a"]),
+        evaluation_config={"n_splits": 2, "cv_mode": "fleet"},
+    )
+    assert base != fleet
+
+
+def test_fleet_standard_scaler_options_honored():
+    config = {
+        "Pipeline": {
+            "steps": [
+                {"StandardScaler": {"with_mean": False}},
+                {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                      "dims": [4], "epochs": 1,
+                                      "batch_size": 32}},
+            ]
+        }
+    }
+    probe = pipeline_from_definition(config)
+    spec = _spec_for(_analyze_model(probe), 3, 3, 0)
+    assert spec.scaler == "standard"
+    assert spec.scaler_options == (False, True)
+    assert spec.scale_targets is False
+    _, batch = _make_spec_and_batch(2)
+    result = train_fleet_arrays(spec, batch)
+    # with_mean=False -> offsets are exactly zero
+    np.testing.assert_array_equal(
+        np.asarray(result.input_scaler.offset), 0.0
+    )
+
+
+def test_fleet_heterogeneous_buckets(tmp_path):
+    """Machines with different tag counts land in different buckets but one
+    build_fleet call handles all of them."""
+    machines = [
+        FleetMachineConfig("narrow", MODEL_CONFIG, _data_config(["a", "b"])),
+        FleetMachineConfig("wide", MODEL_CONFIG,
+                           _data_config(["a", "b", "c", "d"])),
+    ]
+    dirs = build_fleet(machines, str(tmp_path / "out"), n_splits=0)
+    narrow = load(dirs["narrow"])
+    wide = load(dirs["wide"])
+    assert narrow.predict(np.zeros((4, 2), np.float32)).shape == (4, 2)
+    assert wide.predict(np.zeros((4, 4), np.float32)).shape == (4, 4)
