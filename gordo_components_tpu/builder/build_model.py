@@ -178,6 +178,11 @@ def provide_saved_model(
     the config hash is registered and the artifact still exists."""
     import os
 
+    if (evaluation_config or {}).get("cv_mode") == "cross_val_only":
+        raise ValueError(
+            "cv_mode='cross_val_only' skips the final fit and produces no "
+            "servable artifact; use build_model() directly for evaluation runs"
+        )
     cache_key = calculate_model_key(
         name, model_config, data_config, evaluation_config=evaluation_config
     )

@@ -1,0 +1,3 @@
+from .cli import gordo
+
+__all__ = ["gordo"]

@@ -1,0 +1,4 @@
+from .cli import gordo
+
+if __name__ == "__main__":
+    gordo()

@@ -1,0 +1,283 @@
+"""Command-line interface — the container entrypoints.
+
+Reference parity: ``gordo_components/cli/cli.py`` [UNVERIFIED] — click group
+``gordo`` with ``build`` (env-var backed: MODEL_CONFIG, DATA_CONFIG,
+OUTPUT_DIR, MODEL_REGISTER_DIR — Argo injects these), ``run-server``,
+``workflow generate``, ``client predict``; distinct exit codes so the
+orchestrator can tell retryable data failures from permanent config errors.
+
+TPU additions: ``fleet-build`` (the whole fleet in one process — what the
+generated TPU Job runs) and ``run-watchman``.
+
+Exit codes: 0 ok · 64 bad config (permanent) · 66 data unavailable/short
+(retryable) · 1 unexpected.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Optional
+
+import click
+import yaml
+
+EXIT_CONFIG = 64
+EXIT_DATA = 66
+
+logger = logging.getLogger(__name__)
+
+
+def _load_config(value: Optional[str], kind: str) -> dict:
+    """Accept inline YAML/JSON or a path to a YAML file."""
+    if not value:
+        raise click.UsageError(f"Missing {kind} (flag or env var)")
+    import os
+
+    if os.path.exists(value):
+        with open(value) as fh:
+            return yaml.safe_load(fh)
+    parsed = yaml.safe_load(value)
+    if not isinstance(parsed, dict):
+        raise click.UsageError(f"{kind} must parse to a mapping")
+    return parsed
+
+
+@click.group("gordo")
+@click.option("--log-level", default="INFO", envvar="GORDO_LOG_LEVEL",
+              show_default=True)
+def gordo(log_level: str):
+    """gordo-components-tpu: fleet-scale TPU anomaly-model factory."""
+    logging.basicConfig(
+        level=log_level.upper(),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+
+@gordo.command("build")
+@click.argument("name")
+@click.option("--model-config", envvar="MODEL_CONFIG",
+              help="YAML/JSON string or file path")
+@click.option("--data-config", envvar="DATA_CONFIG",
+              help="YAML/JSON string or file path")
+@click.option("--output-dir", envvar="OUTPUT_DIR", required=True)
+@click.option("--model-register-dir", envvar="MODEL_REGISTER_DIR", default=None)
+@click.option("--metadata", envvar="METADATA", default=None,
+              help="extra user metadata (YAML/JSON string)")
+@click.option("--cv-mode", default="full_build", show_default=True,
+              type=click.Choice(["full_build", "cross_val_only", "build_only"]))
+@click.option("--n-splits", default=3, show_default=True)
+@click.option("--print-cv-scores", is_flag=True, default=False)
+def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
+              metadata, cv_mode, n_splits, print_cv_scores):
+    """Build one machine's model (idempotent via the config-hash cache)."""
+    from ..builder import provide_saved_model
+    from ..dataset.dataset import InsufficientDataError
+    from ..serializer import load_metadata
+
+    try:
+        model_cfg = _load_config(model_config, "MODEL_CONFIG")
+        data_cfg = _load_config(data_config, "DATA_CONFIG")
+        user_meta = yaml.safe_load(metadata) if metadata else {}
+        model_dir = provide_saved_model(
+            name,
+            model_cfg,
+            data_cfg,
+            output_dir,
+            metadata=user_meta,
+            model_register_dir=model_register_dir,
+            evaluation_config={"cv_mode": cv_mode, "n_splits": n_splits},
+        )
+    except InsufficientDataError as exc:
+        logger.error("Data error building %r: %s", name, exc)
+        sys.exit(EXIT_DATA)
+    except (ValueError, click.UsageError) as exc:
+        logger.error("Config error building %r: %s", name, exc)
+        sys.exit(EXIT_CONFIG)
+    click.echo(model_dir)
+    if print_cv_scores:
+        meta = load_metadata(model_dir)
+        scores = meta.get("model", {}).get("cross_validation", {}).get("scores", {})
+        click.echo(json.dumps(scores))
+
+
+@gordo.command("fleet-build")
+@click.option("--machine-config", required=True,
+              help="fleet YAML (machines + globals) file path or string")
+@click.option("--output-dir", envvar="OUTPUT_DIR", required=True)
+@click.option("--model-register-dir", envvar="MODEL_REGISTER_DIR", default=None)
+@click.option("--n-devices", default=None, type=int,
+              help="mesh size (default: all available devices)")
+@click.option("--n-splits", default=3, show_default=True)
+@click.option("--seed", default=0, show_default=True)
+def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
+                    n_splits, seed):
+    """Build an entire fleet in one process: machines are bucketed and
+    trained as vmapped programs sharded over the device mesh."""
+    from ..dataset.dataset import InsufficientDataError
+    from ..parallel import FleetMachineConfig, build_fleet, fleet_mesh
+    from ..workflow import NormalizedConfig
+
+    try:
+        config = NormalizedConfig(_load_config(machine_config, "machine-config"))
+        machines = [
+            FleetMachineConfig(
+                name=machine.name,
+                model_config=machine.model,
+                data_config=machine.dataset,
+                metadata=machine.metadata,
+            )
+            for machine in config.machines
+        ]
+        mesh = fleet_mesh(n_devices)
+        results = build_fleet(
+            machines,
+            output_dir,
+            model_register_dir=model_register_dir,
+            mesh=mesh,
+            seed=seed,
+            n_splits=n_splits,
+        )
+    except InsufficientDataError as exc:
+        logger.error("Data error in fleet build: %s", exc)
+        sys.exit(EXIT_DATA)
+    except ValueError as exc:
+        logger.error("Config error in fleet build: %s", exc)
+        sys.exit(EXIT_CONFIG)
+    click.echo(json.dumps(results, indent=2))
+
+
+@gordo.command("run-server")
+@click.option("--model-dir", "model_dirs", multiple=True,
+              envvar="MODEL_LOCATION",
+              help="model dir; repeat for multi-model serving")
+@click.option("--models-dir", default=None,
+              help="directory whose immediate subdirs are model dirs")
+@click.option("--host", default="0.0.0.0", show_default=True)
+@click.option("--port", default=5555, show_default=True)
+@click.option("--project", default="project", show_default=True)
+def run_server_cmd(model_dirs, models_dir, host, port, project):
+    """Serve built model(s) over REST."""
+    import os
+
+    from ..serializer import load_metadata
+    from ..server import run_server
+
+    resolved: dict = {}
+    for model_dir in model_dirs:
+        name = load_metadata(model_dir).get("name") or os.path.basename(
+            model_dir.rstrip("/")
+        )
+        resolved[name] = model_dir
+    if models_dir:
+        for entry in sorted(os.listdir(models_dir)):
+            path = os.path.join(models_dir, entry)
+            if os.path.isdir(path):
+                resolved.setdefault(entry, path)
+    if not resolved:
+        raise click.UsageError(
+            "Provide --model-dir (or MODEL_LOCATION) or --models-dir"
+        )
+    if len(resolved) == 1:
+        run_server(next(iter(resolved.values())), host=host, port=port,
+                   project=project)
+    else:
+        run_server(resolved, host=host, port=port, project=project)
+
+
+@gordo.command("run-watchman")
+@click.option("--project", required=True)
+@click.option("--machine", "machines", multiple=True, required=True)
+@click.option("--target-url", required=True)
+@click.option("--host", default="0.0.0.0", show_default=True)
+@click.option("--port", default=5556, show_default=True)
+def run_watchman_cmd(project, machines, target_url, host, port):
+    """Serve the fleet-health aggregator."""
+    from ..watchman import run_watchman
+
+    run_watchman(project, list(machines), target_url, host=host, port=port)
+
+
+@gordo.group("workflow")
+def workflow_group():
+    """Fleet-workflow manifest generation."""
+
+
+@workflow_group.command("generate")
+@click.option("--machine-config", required=True)
+@click.option("--output-file", default=None)
+@click.option("--image", default="gordo-components-tpu:latest", show_default=True)
+@click.option("--parallelism", default=10, show_default=True)
+@click.option("--tpu", "tpu_mode", is_flag=True, default=False,
+              help="emit the single-Job TPU fleet spec instead of "
+                   "pod-per-machine Argo")
+@click.option("--tpu-chips", default=16, show_default=True)
+def workflow_generate_cmd(machine_config, output_file, image, parallelism,
+                          tpu_mode, tpu_chips):
+    """Fleet YAML -> Argo Workflow (reference-compatible) or TPU Job spec."""
+    from ..workflow import generate_argo_workflow, generate_tpu_job
+    from ..workflow.workflow_generator import validate_generated
+
+    try:
+        config = _load_config(machine_config, "machine-config")
+        if tpu_mode:
+            manifest = generate_tpu_job(config, image=image, tpu_chips=tpu_chips)
+        else:
+            manifest = generate_argo_workflow(
+                config, image=image, parallelism=parallelism
+            )
+        validate_generated(manifest)
+    except ValueError as exc:
+        logger.error("Config error generating workflow: %s", exc)
+        sys.exit(EXIT_CONFIG)
+    if output_file:
+        with open(output_file, "w") as fh:
+            fh.write(manifest)
+        click.echo(output_file)
+    else:
+        click.echo(manifest)
+
+
+@gordo.group("client")
+def client_group():
+    """Bulk prediction against running servers."""
+
+
+@client_group.command("predict")
+@click.argument("start")
+@click.argument("end")
+@click.option("--base-url", required=True, help="model-server base URL")
+@click.option("--project", default="project", show_default=True)
+@click.option("--machine", "machines", multiple=True,
+              help="subset of machines (default: discover via /models)")
+@click.option("--max-interval", default="1D", show_default=True)
+@click.option("--parallelism", default=10, show_default=True)
+@click.option("--output-dir", default=None,
+              help="write per-machine score CSVs here")
+def client_predict_cmd(start, end, base_url, project, machines, max_interval,
+                       parallelism, output_dir):
+    """Score [START, END) for every machine and print row counts."""
+    from ..client import Client, ClientError, CsvForwarder
+
+    forwarders = [CsvForwarder(output_dir)] if output_dir else []
+    client = Client(
+        base_url,
+        project=project,
+        machines=list(machines) or None,
+        max_interval=max_interval,
+        parallelism=parallelism,
+        forwarders=forwarders,
+    )
+    try:
+        frames = client.predict(start, end)
+    except ClientError as exc:
+        logger.error("Prediction failed: %s", exc)
+        sys.exit(1)
+    click.echo(
+        json.dumps({machine: len(frame) for machine, frame in frames.items()})
+    )
+
+
+if __name__ == "__main__":
+    gordo()

@@ -1,0 +1,16 @@
+from .client import Client, ClientError
+from .forwarders import (
+    CsvForwarder,
+    ForwardPredictionsIntoInflux,
+    PredictionForwarder,
+)
+from .utils import make_date_ranges
+
+__all__ = [
+    "Client",
+    "ClientError",
+    "PredictionForwarder",
+    "CsvForwarder",
+    "ForwardPredictionsIntoInflux",
+    "make_date_ranges",
+]

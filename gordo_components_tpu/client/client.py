@@ -1,0 +1,163 @@
+"""Bulk prediction client.
+
+Reference parity: ``gordo_components/client/client.py`` [UNVERIFIED] —
+``Client.predict(start, end)`` resolves machine endpoints, splits the range
+into chunks (:func:`make_date_ranges`), fires concurrent HTTP requests with
+retry/backoff (aiohttp), assembles per-machine score DataFrames, and hands
+them to forwarders. The server does the data fetch + TPU-batched scoring
+per chunk (``?start&end`` path — SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from datetime import datetime
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+from .forwarders import PredictionForwarder
+from .utils import make_date_ranges
+
+logger = logging.getLogger(__name__)
+
+
+class ClientError(RuntimeError):
+    """A request failed permanently (4xx, or retries exhausted)."""
+
+
+class Client:
+    def __init__(
+        self,
+        base_url: str,
+        project: str = "project",
+        machines: Optional[Sequence[str]] = None,
+        max_interval: str = "1D",
+        parallelism: int = 10,
+        retries: int = 3,
+        retry_backoff: float = 0.5,
+        timeout: float = 60.0,
+        forwarders: Optional[List[PredictionForwarder]] = None,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.project = project
+        self.machines = list(machines) if machines else None
+        self.max_interval = max_interval
+        self.parallelism = parallelism
+        self.retries = retries
+        self.retry_backoff = retry_backoff
+        self.timeout = timeout
+        self.forwarders = forwarders or []
+
+    # -- endpoint resolution -------------------------------------------------
+    def resolve_machines(self) -> List[str]:
+        """Explicit machine list, or discovery via the server's /models
+        listing (the role watchman's endpoint registry plays upstream)."""
+        if self.machines is not None:
+            return self.machines
+        import requests
+
+        response = requests.get(f"{self.base_url}/models", timeout=self.timeout)
+        response.raise_for_status()
+        return response.json()["models"]
+
+    # -- async core ----------------------------------------------------------
+    async def _fetch_chunk(
+        self, session, semaphore, machine: str, start, end
+    ) -> Dict[str, Any]:
+        url = (
+            f"{self.base_url}/gordo/v0/{self.project}/{machine}"
+            f"/anomaly/prediction"
+        )
+        params = {"start": start.isoformat(), "end": end.isoformat()}
+        last_error: Optional[str] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                await asyncio.sleep(self.retry_backoff * 2 ** (attempt - 1))
+            try:
+                async with semaphore:
+                    async with session.post(url, params=params) as response:
+                        if 400 <= response.status < 500:
+                            body = await response.text()
+                            raise ClientError(
+                                f"{machine} [{start}, {end}): "
+                                f"HTTP {response.status}: {body[:500]}"
+                            )
+                        if response.status >= 500:
+                            last_error = f"HTTP {response.status}"
+                            continue
+                        return await response.json()
+            except ClientError:
+                raise
+            except Exception as exc:  # connection errors -> retry
+                last_error = repr(exc)
+        raise ClientError(
+            f"{machine} [{start}, {end}): retries exhausted ({last_error})"
+        )
+
+    async def _predict_async(
+        self, machines: List[str], ranges
+    ) -> Dict[str, pd.DataFrame]:
+        import aiohttp
+
+        semaphore = asyncio.Semaphore(self.parallelism)
+        timeout = aiohttp.ClientTimeout(total=self.timeout)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            tasks = {
+                (machine, i): asyncio.ensure_future(
+                    self._fetch_chunk(session, semaphore, machine, start, end)
+                )
+                for machine in machines
+                for i, (start, end) in enumerate(ranges)
+            }
+            # return_exceptions: let every chunk finish, then surface the
+            # first failure via task.result() below (avoids orphan tasks)
+            await asyncio.gather(*tasks.values(), return_exceptions=True)
+        frames: Dict[str, pd.DataFrame] = {}
+        for machine in machines:
+            chunks = [
+                self._chunk_frame(tasks[(machine, i)].result())
+                for i in range(len(ranges))
+            ]
+            chunks = [c for c in chunks if c is not None]
+            frames[machine] = (
+                pd.concat(chunks).sort_index() if chunks else pd.DataFrame()
+            )
+        return frames
+
+    @staticmethod
+    def _chunk_frame(payload: Dict[str, Any]) -> Optional[pd.DataFrame]:
+        data = payload.get("data", {})
+        total = data.get("total-anomaly-score")
+        if not total:
+            return None
+        scores = np.asarray(data["tag-anomaly-scores"], dtype=np.float64)
+        columns = {
+            f"tag-anomaly-score-{i}": scores[:, i] for i in range(scores.shape[1])
+        }
+        columns["total-anomaly-score"] = np.asarray(total, dtype=np.float64)
+        index = pd.to_datetime(data["timestamps"]) if "timestamps" in data else None
+        return pd.DataFrame(columns, index=index)
+
+    # -- public API ----------------------------------------------------------
+    def predict(
+        self,
+        start: Union[str, datetime],
+        end: Union[str, datetime],
+        machine_names: Optional[Sequence[str]] = None,
+    ) -> Dict[str, pd.DataFrame]:
+        """Score ``[start, end)`` for every machine; returns
+        ``{machine: DataFrame}`` (timestamp-indexed per-tag + total scores)
+        and pushes each frame through the configured forwarders."""
+        machines = list(machine_names) if machine_names else self.resolve_machines()
+        ranges = make_date_ranges(start, end, self.max_interval)
+        logger.info(
+            "Client.predict: %d machines x %d chunks", len(machines), len(ranges)
+        )
+        frames = asyncio.run(self._predict_async(machines, ranges))
+        for forwarder in self.forwarders:
+            for machine, frame in frames.items():
+                forwarder.forward(machine, frame)
+        return frames

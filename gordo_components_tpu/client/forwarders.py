@@ -1,0 +1,59 @@
+"""Prediction forwarders.
+
+Reference parity: ``gordo_components/client/forwarders.py`` [UNVERIFIED] —
+``PredictionForwarder`` + ``ForwardPredictionsIntoInflux``. The Influx
+forwarder is gated on the optional ``influxdb`` package (absent in this
+image); ``CsvForwarder`` provides a dependency-free sink for backfills.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import os
+
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+
+class PredictionForwarder(abc.ABC):
+    @abc.abstractmethod
+    def forward(self, machine: str, predictions: pd.DataFrame) -> None:
+        """Deliver one machine's score frame to the sink."""
+
+
+class CsvForwarder(PredictionForwarder):
+    """One CSV per machine under ``output_dir`` (append on repeat calls)."""
+
+    def __init__(self, output_dir: str):
+        self.output_dir = output_dir
+
+    def forward(self, machine: str, predictions: pd.DataFrame) -> None:
+        os.makedirs(self.output_dir, exist_ok=True)
+        path = os.path.join(self.output_dir, f"{machine}.csv")
+        predictions.to_csv(
+            path, mode="a", header=not os.path.exists(path), index=True
+        )
+        logger.info("Forwarded %d rows for %s -> %s", len(predictions), machine, path)
+
+
+class ForwardPredictionsIntoInflux(PredictionForwarder):
+    """Write scores into InfluxDB (measurement per machine). Requires the
+    optional ``influxdb`` client package."""
+
+    def __init__(self, measurement: str = "anomaly", **influx_config):
+        try:
+            import influxdb  # type: ignore
+        except ImportError as exc:
+            raise RuntimeError(
+                "ForwardPredictionsIntoInflux requires the optional "
+                "'influxdb' package, which is not installed."
+            ) from exc
+        self.measurement = measurement
+        self._client = influxdb.DataFrameClient(**influx_config)
+
+    def forward(self, machine: str, predictions: pd.DataFrame) -> None:
+        self._client.write_points(
+            predictions, self.measurement, tags={"machine": machine}
+        )

@@ -1,0 +1,42 @@
+"""Client-side helpers.
+
+Reference parity: ``gordo_components/client/utils.py`` [UNVERIFIED] —
+``make_date_ranges`` splits a prediction range into chunks so bulk
+backfills stream as many small requests instead of one giant one.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import List, Tuple, Union
+
+import pandas as pd
+
+
+def _parse(value: Union[str, datetime]) -> pd.Timestamp:
+    ts = pd.Timestamp(value)
+    if ts.tz is None:
+        ts = ts.tz_localize("UTC")
+    return ts
+
+
+def make_date_ranges(
+    start: Union[str, datetime],
+    end: Union[str, datetime],
+    max_interval: str = "1D",
+) -> List[Tuple[pd.Timestamp, pd.Timestamp]]:
+    """Split ``[start, end)`` into consecutive chunks of at most
+    ``max_interval`` (pandas offset string)."""
+    start_ts, end_ts = _parse(start), _parse(end)
+    if end_ts <= start_ts:
+        raise ValueError(f"end ({end_ts}) must be after start ({start_ts})")
+    delta = pd.Timedelta(max_interval)
+    if delta <= pd.Timedelta(0):
+        raise ValueError(f"max_interval must be positive, got {max_interval!r}")
+    ranges = []
+    cursor = start_ts
+    while cursor < end_ts:
+        nxt = min(cursor + delta, end_ts)
+        ranges.append((cursor, nxt))
+        cursor = nxt
+    return ranges

@@ -240,9 +240,15 @@ class TimeSeriesDataset(GordoBaseDataset):
             "rows_filtered": filtered_count,
             "x_shape": list(X.shape),
             "y_shape": list(y.shape),
+            "tag_list": [t.name for t in self.tag_list],
+            "target_tag_list": [t.name for t in self.target_tag_list],
             "resolution": self.resolution,
             "train_start_date": self.train_start_date.isoformat(),
             "train_end_date": self.train_end_date.isoformat(),
+            # full re-creatable config: the server's ?start&end fetch path
+            # rebuilds the dataset from this (reference: server-side data
+            # fetch via the dataset config embedded in build metadata)
+            "dataset_config": self.to_dict(),
         }
         return X, y
 

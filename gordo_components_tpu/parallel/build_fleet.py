@@ -94,14 +94,22 @@ def _analyze_model(model: Any) -> _Analyzed:
 
 
 def _scaler_kind(
-    analyzed: _Analyzed,
+    scaler: Optional[Any],
 ) -> Tuple[str, Tuple[float, float], Tuple[bool, bool]]:
-    scaler = analyzed.input_scaler
     if scaler is None:
         return "none", (0.0, 1.0), (True, True)
     if isinstance(scaler, MinMaxScaler):
         return "minmax", tuple(scaler.feature_range), (True, True)
-    return "standard", (0.0, 1.0), (bool(scaler.with_mean), bool(scaler.with_std))
+    if isinstance(scaler, StandardScaler):
+        return (
+            "standard",
+            (0.0, 1.0),
+            (bool(scaler.with_mean), bool(scaler.with_std)),
+        )
+    raise ValueError(
+        f"Fleet building supports MinMaxScaler/StandardScaler steps; got "
+        f"{type(scaler).__name__}"
+    )
 
 
 def _spec_for(
@@ -112,7 +120,18 @@ def _spec_for(
 ) -> FleetSpec:
     est = analyzed.estimator
     model_spec = est._make_spec(n_features, n_targets)
-    kind, feature_range, scaler_options = _scaler_kind(analyzed)
+    kind, feature_range, scaler_options = _scaler_kind(analyzed.input_scaler)
+    t_kind, t_range, t_options = _scaler_kind(analyzed.target_scaler)
+    if analyzed.detector is not None and not isinstance(
+        analyzed.detector.scaler, MinMaxScaler
+    ):
+        # the compiled program computes minmax error-scaler params; writing
+        # them into a different scaler class would silently change scoring
+        raise ValueError(
+            "Fleet building supports a MinMaxScaler anomaly error scaler; "
+            f"got {type(analyzed.detector.scaler).__name__} — use the "
+            "single-machine builder for this config"
+        )
     dropout = float(model_spec.config.get("dropout", 0.0) or 0.0)
     return FleetSpec(
         module=model_spec.module,
@@ -128,6 +147,9 @@ def _spec_for(
         use_dropout=dropout > 0.0,
         scale_targets=analyzed.target_scaler is not None,
         scaler_options=scaler_options,
+        target_scaler=t_kind,
+        target_feature_range=t_range,
+        target_scaler_options=t_options,
     )
 
 
@@ -228,34 +250,36 @@ def build_fleet(
                 continue
         pending.append((machine, cache_key))
 
-    # ---- host data fetch (the reference's per-pod data-lake reads) --------
-    fetched = []
+    # ---- bucket by (model config, feature/target width) BEFORE fetching:
+    # widths come from the dataset's declared columns, so peak host memory
+    # is one bucket's data, not the whole fleet's ---------------------------
+    buckets: Dict[str, List[dict]] = {}
     for machine, cache_key in pending:
         dataset = _dataset_from_config(machine.data_config)
-        X, y = dataset.get_data()
-        fetched.append(
-            {
-                "machine": machine,
-                "cache_key": cache_key,
-                "X": np.asarray(getattr(X, "values", X), np.float32),
-                "y": np.asarray(getattr(y, "values", y), np.float32),
-                "dataset_metadata": dataset.get_metadata(),
-            }
-        )
-
-    # ---- bucket by (model config, feature/target width) -------------------
-    buckets: Dict[str, List[dict]] = {}
-    for item in fetched:
+        if hasattr(dataset, "_columns_for"):
+            n_features = len(dataset._columns_for(dataset.tag_list))
+            n_targets = len(dataset._columns_for(dataset.target_tag_list))
+        else:  # non-TimeSeriesDataset: widths require a fetch
+            X_probe, y_probe = dataset.get_data()
+            n_features, n_targets = X_probe.shape[1], y_probe.shape[1]
         sig = json.dumps(
             {
-                "model_config": item["machine"].model_config,
-                "F": item["X"].shape[1],
-                "T": item["y"].shape[1],
+                "model_config": machine.model_config,
+                "F": n_features,
+                "T": n_targets,
             },
             sort_keys=True,
             default=str,
         )
-        buckets.setdefault(sig, []).append(item)
+        buckets.setdefault(sig, []).append(
+            {
+                "machine": machine,
+                "cache_key": cache_key,
+                "dataset": dataset,
+                "F": n_features,
+                "T": n_targets,
+            }
+        )
 
     master_key = jax.random.PRNGKey(seed)
     for b, (sig, items) in enumerate(sorted(buckets.items())):
@@ -263,9 +287,17 @@ def build_fleet(
         model_config = items[0]["machine"].model_config
         probe = pipeline_from_definition(model_config)
         analyzed = _analyze_model(probe)
-        n_features = items[0]["X"].shape[1]
-        n_targets = items[0]["y"].shape[1]
+        n_features = items[0]["F"]
+        n_targets = items[0]["T"]
         spec = _spec_for(analyzed, n_features, n_targets, n_splits)
+
+        # ---- host data fetch, this bucket only (the reference's per-pod
+        # data-lake reads) --------------------------------------------------
+        for item in items:
+            X_frame, y_frame = item["dataset"].get_data()
+            item["X"] = np.asarray(getattr(X_frame, "values", X_frame), np.float32)
+            item["y"] = np.asarray(getattr(y_frame, "values", y_frame), np.float32)
+            item["dataset_metadata"] = item["dataset"].get_metadata()
 
         n_rows = max(len(item["X"]) for item in items)
         n_real = len(items)
@@ -337,6 +369,9 @@ def build_fleet(
                     model_register_dir, item["cache_key"], model_dir
                 )
             results[machine.name] = model_dir
+        for item in items:  # free this bucket's host data before the next
+            item.pop("X", None)
+            item.pop("y", None)
 
     logger.info(
         "Fleet build: %d machines in %.1fs (%d cached)",

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.train import make_fit_fn, make_predict_fn
+from ..ops import windowing
 from ..ops.scaling import ScalerParams
 from .mesh import fleet_sharding, pad_to_multiple
 
@@ -62,6 +63,11 @@ class FleetSpec(NamedTuple):
     scale_targets: bool = True
     # ("standard" only) (with_mean, with_std)
     scaler_options: Tuple[bool, bool] = (True, True)
+    # the TransformedTargetRegressor's own transformer — independent of the
+    # input scaler (a config may scale targets but not inputs or vice versa)
+    target_scaler: str = "minmax"
+    target_feature_range: Tuple[float, float] = (0.0, 1.0)
+    target_scaler_options: Tuple[bool, bool] = (True, True)
 
 
 class MachineBatch(NamedTuple):
@@ -115,16 +121,16 @@ def _masked_standard(x, w, with_mean: bool = True, with_std: bool = True) -> Sca
     return ScalerParams(scale=scale, offset=offset)
 
 
-def _fit_scaler(spec: "FleetSpec", x, w) -> ScalerParams:
-    if spec.scaler == "minmax":
-        return _masked_minmax(x, w, spec.feature_range)
-    if spec.scaler == "standard":
-        with_mean, with_std = spec.scaler_options
+def _fit_scaler(kind: str, options, feature_range, x, w) -> ScalerParams:
+    if kind == "minmax":
+        return _masked_minmax(x, w, feature_range)
+    if kind == "standard":
+        with_mean, with_std = options
         return _masked_standard(x, w, with_mean, with_std)
-    if spec.scaler == "none":
+    if kind == "none":
         n = x.shape[1]
         return ScalerParams(scale=jnp.ones(n), offset=jnp.zeros(n))
-    raise ValueError(f"Unknown scaler kind {spec.scaler!r}")
+    raise ValueError(f"Unknown scaler kind {kind!r}")
 
 
 def _masked_explained_variance(y, pred, w) -> jnp.ndarray:
@@ -175,7 +181,9 @@ def make_machine_program(
 
     def prepare(Xs, ys, w):
         """Scaled rows → (inputs, targets, sample weights) padded to a whole
-        number of batches.
+        number of batches. Windowing/targets delegate to
+        :mod:`gordo_components_tpu.ops.windowing` — the off-by-one contract
+        lives there, pinned by its golden tests, not re-derived here.
 
         Row padding may sit ANYWHERE in the row axis (build_fleet right-
         aligns short machines so CV test folds still cover their real data):
@@ -185,11 +193,15 @@ def make_machine_program(
         if la is None:
             inputs, targets, wt = Xs, ys, w
         else:
-            idx = np.arange(n_samples)[:, None] + np.arange(L)[None, :]
-            inputs = Xs[idx]  # (n_samples, L, F) static gather
-            offset = L - 1 + la
-            targets = ys[offset : offset + n_samples]
-            wt = jnp.min(w[idx], axis=1) * w[offset : offset + n_samples]
+            inputs = windowing.sliding_windows(Xs, L, la)
+            targets = (
+                windowing.reconstruction_targets(ys, L)
+                if la == 0
+                else windowing.forecast_targets(ys, L)
+            )
+            target_idx = windowing.window_output_index(n_rows, L, la)
+            window_w = windowing.sliding_windows(w[:, None], L, la)[:, :, 0]
+            wt = jnp.min(window_w, axis=1) * w[target_idx]
         pad = padded - inputs.shape[0]
         if pad:
             inputs = jnp.pad(inputs, ((0, pad),) + ((0, 0),) * (inputs.ndim - 1))
@@ -214,9 +226,17 @@ def make_machine_program(
     sample_shape = (1, n_features) if la is None else (1, L, n_features)
 
     def program(X, y, w, key) -> MachineResult:
-        sx = _fit_scaler(spec, X, w)
+        sx = _fit_scaler(spec.scaler, spec.scaler_options, spec.feature_range, X, w)
         if spec.scale_targets:
-            sy = _fit_scaler(spec, y, w)
+            # the TransformedTargetRegressor's transformer — its own kind,
+            # independent of the input scaler
+            sy = _fit_scaler(
+                spec.target_scaler,
+                spec.target_scaler_options,
+                spec.target_feature_range,
+                y,
+                w,
+            )
         else:
             # no TransformedTargetRegressor in the config: the model trains
             # against raw targets (Pipeline.fit passes y through untouched)
@@ -244,7 +264,11 @@ def make_machine_program(
             pred = predict_fn(res.params, inputs)
             pred_raw = (pred - sy.offset) / sy.scale
             err = jnp.abs(raw_targets - pred_raw)
-            wtest = wt * test_mask
+            # a fold whose TRAIN region holds none of this machine's real
+            # rows fit nothing — its residuals come from an untrained
+            # network and must not feed the error scaler or CV scores
+            trained = (jnp.sum(wt * train_mask) > 0).astype(jnp.float32)
+            wtest = wt * test_mask * trained
             mask = (wtest > 0)[:, None]
             emin = jnp.minimum(emin, jnp.min(jnp.where(mask, err, jnp.inf), axis=0))
             emax = jnp.maximum(emax, jnp.max(jnp.where(mask, err, -jnp.inf), axis=0))
@@ -256,26 +280,33 @@ def make_machine_program(
 
         final = fit_fn(params0, inputs, targets, wt, fit_key)
 
-        if spec.n_splits == 0:
-            # no CV: error scaler from final-model residuals on all real rows
-            pred = predict_fn(final.params, inputs)
-            pred_raw = (pred - sy.offset) / sy.scale
-            err = jnp.abs(raw_targets - pred_raw)
-            mask = (wt > 0)[:, None]
-            emin = jnp.min(jnp.where(mask, err, jnp.inf), axis=0)
-            emax = jnp.max(jnp.where(mask, err, -jnp.inf), axis=0)
-            fold_errors = [err]
-            fold_test_masks = [wt]
+        # final-model residuals over all real rows: the error-scaler source
+        # when CV is off, and the per-machine fallback when no CV fold
+        # covered this machine's data (short machine in a tall bucket)
+        pred_final = predict_fn(final.params, inputs)
+        pred_final_raw = (pred_final - sy.offset) / sy.scale
+        err_final = jnp.abs(raw_targets - pred_final_raw)
+        mask_final = (wt > 0)[:, None]
+        fmin = jnp.min(jnp.where(mask_final, err_final, jnp.inf), axis=0)
+        fmax = jnp.max(jnp.where(mask_final, err_final, -jnp.inf), axis=0)
 
+        if spec.n_splits == 0:
+            use_cv = jnp.asarray(False)
+        else:
+            use_cv = jnp.sum(jnp.stack(fold_test_masks)) > 0
+        emin = jnp.where(use_cv, emin, fmin)
+        emax = jnp.where(use_cv, emax, fmax)
         emin = jnp.where(jnp.isfinite(emin), emin, 0.0)
         emax = jnp.where(jnp.isfinite(emax), emax, 1.0)
         span = emax - emin
         e_scale = 1.0 / jnp.where(span < _EPS, 1.0, span)
         error_scaler = ScalerParams(scale=e_scale, offset=-emin * e_scale)
 
-        # thresholds: 99th percentile of scaled out-of-fold residuals
-        errs = jnp.stack(fold_errors)  # (K, P, T)
-        masks = jnp.stack(fold_test_masks)  # (K, P)
+        # thresholds: 99th percentile of scaled residuals — out-of-fold when
+        # CV covered this machine, final-model residuals otherwise
+        errs = jnp.stack(fold_errors + [err_final])  # (K+1, P, T)
+        fallback_mask = wt * jnp.where(use_cv, 0.0, 1.0)
+        masks = jnp.stack(fold_test_masks + [fallback_mask])  # (K+1, P)
         scaled = errs * error_scaler.scale + error_scaler.offset
         scaled = jnp.where((masks > 0)[:, :, None], scaled, jnp.nan)
         tag_thresholds = jnp.nan_to_num(

@@ -1,0 +1,3 @@
+from .server import ModelServer, build_app, run_server
+
+__all__ = ["ModelServer", "build_app", "run_server"]

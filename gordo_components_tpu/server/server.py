@@ -1,0 +1,350 @@
+"""REST model server.
+
+Reference parity: ``gordo_components/server/server.py`` + ``views/``
+[UNVERIFIED] — the per-model Flask app exposing:
+
+- ``GET  /healthz``
+- ``GET  /metadata``
+- ``POST /prediction``
+- ``POST /anomaly/prediction`` (anomaly models only; supports ``?start&end``
+  server-side data fetch via the dataset config in build metadata)
+- ``GET  /download-model`` (serialized model bytes)
+
+plus the ingress path shape ``/gordo/v0/<project>/<machine>/<endpoint>``.
+
+TPU redesign: where the reference runs ONE Flask app per model in its own
+pod, this server hosts MANY machines' models in one process — models are
+pure params + jitted apply fns, so a single TPU serves a whole fleet and
+dispatch is just a dict lookup on the machine segment. Bare paths
+(``/prediction``) work in single-model mode for drop-in parity. Flask is
+replaced by a dependency-light werkzeug WSGI app (flask is not in this
+image; werkzeug is its routing/WSGI core anyway). ``GET /metrics`` adds
+the per-endpoint latency counters the reference lacked (SURVEY.md §6.5).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+from werkzeug.exceptions import HTTPException, NotFound
+from werkzeug.routing import Map, Rule
+from werkzeug.wrappers import Request, Response
+
+from ..models.anomaly.base import AnomalyDetectorBase
+from ..serializer import dumps as serializer_dumps
+from ..serializer import load, load_metadata
+
+logger = logging.getLogger(__name__)
+
+_URL_MAP = Map(
+    [
+        Rule("/healthz", endpoint="healthz"),
+        Rule("/metadata", endpoint="metadata"),
+        Rule("/metrics", endpoint="metrics"),
+        Rule("/models", endpoint="models"),
+        Rule("/prediction", endpoint="prediction"),
+        Rule("/anomaly/prediction", endpoint="anomaly"),
+        Rule("/download-model", endpoint="download-model"),
+        Rule("/gordo/v0/<project>/<machine>/healthz", endpoint="healthz"),
+        Rule("/gordo/v0/<project>/<machine>/metadata", endpoint="metadata"),
+        Rule("/gordo/v0/<project>/<machine>/prediction", endpoint="prediction"),
+        Rule(
+            "/gordo/v0/<project>/<machine>/anomaly/prediction",
+            endpoint="anomaly",
+        ),
+        Rule(
+            "/gordo/v0/<project>/<machine>/download-model",
+            endpoint="download-model",
+        ),
+    ]
+)
+
+
+class _Latency:
+    """Rolling per-endpoint latency stats for GET /metrics."""
+
+    def __init__(self, keep: int = 1000):
+        self.keep = keep
+        self.samples: Dict[str, List[float]] = {}
+        self.counts: Dict[str, int] = {}
+
+    def record(self, endpoint: str, seconds: float) -> None:
+        samples = self.samples.setdefault(endpoint, [])
+        samples.append(seconds)
+        if len(samples) > self.keep:
+            del samples[: -self.keep]
+        self.counts[endpoint] = self.counts.get(endpoint, 0) + 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        out = {}
+        for endpoint, samples in self.samples.items():
+            arr = np.asarray(samples)
+            out[endpoint] = {
+                "count": self.counts[endpoint],
+                "p50_ms": float(np.percentile(arr, 50) * 1000),
+                "p99_ms": float(np.percentile(arr, 99) * 1000),
+                "mean_ms": float(arr.mean() * 1000),
+            }
+        return out
+
+
+class _Machine:
+    def __init__(self, name: str, model_dir: str):
+        self.name = name
+        self.model_dir = model_dir
+        self.model = load(model_dir)
+        self.metadata = load_metadata(model_dir)
+
+    @property
+    def tag_list(self) -> Optional[List[str]]:
+        return self.metadata.get("dataset", {}).get("tag_list")
+
+
+class ModelServer:
+    """WSGI app serving one or many built model dirs.
+
+    ``model_dirs``: either a single dir (single-model mode: bare endpoint
+    paths serve it) or ``{machine_name: dir}``.
+    """
+
+    def __init__(
+        self,
+        model_dirs: Union[str, Dict[str, str]],
+        project: str = "project",
+    ):
+        if isinstance(model_dirs, str):
+            machine = _Machine("default", model_dirs)
+            machine.name = machine.metadata.get("name", "default")
+            self.machines = {machine.name: machine}
+            self._single: Optional[_Machine] = machine
+        else:
+            self.machines = {
+                name: _Machine(name, path) for name, path in model_dirs.items()
+            }
+            self._single = (
+                next(iter(self.machines.values()))
+                if len(self.machines) == 1
+                else None
+            )
+        self.project = project
+        self.latency = _Latency()
+        logger.info(
+            "ModelServer serving %d model(s): %s",
+            len(self.machines),
+            sorted(self.machines),
+        )
+
+    # -- dispatch ------------------------------------------------------------
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        started = time.perf_counter()
+        adapter = _URL_MAP.bind_to_environ(environ)
+        try:
+            endpoint, args = adapter.match()
+            response = self._dispatch(request, endpoint, args)
+        except HTTPException as exc:
+            if exc.response is not None:
+                response = exc.response
+            else:
+                response = Response(
+                    json.dumps({"error": exc.description}),
+                    status=exc.code or 500,
+                    mimetype="application/json",
+                )
+            endpoint = "error"
+        self.latency.record(endpoint, time.perf_counter() - started)
+        return response(environ, start_response)
+
+    def _machine_for(self, args: Dict[str, Any]) -> _Machine:
+        name = args.get("machine")
+        if name is None:
+            if self._single is not None:
+                return self._single
+            raise NotFound(
+                "Multiple models served; use "
+                "/gordo/v0/<project>/<machine>/<endpoint>"
+            )
+        if args.get("project") not in (self.project, None):
+            raise NotFound(f"Unknown project {args.get('project')!r}")
+        try:
+            return self.machines[name]
+        except KeyError:
+            raise NotFound(f"Unknown machine {name!r}") from None
+
+    def _dispatch(self, request: Request, endpoint: str, args) -> Response:
+        if endpoint == "healthz":
+            if args.get("machine") is not None:
+                self._machine_for(args)  # machine-scoped health: 404 if absent
+            return _json({"ok": True})
+        if endpoint == "metrics":
+            return _json({"latency": self.latency.snapshot()})
+        if endpoint == "models":
+            return _json({"project": self.project, "models": sorted(self.machines)})
+        machine = self._machine_for(args)
+        if endpoint == "metadata":
+            return _json({"name": machine.name, "metadata": machine.metadata})
+        if endpoint == "download-model":
+            return Response(
+                serializer_dumps(machine.model),
+                mimetype="application/octet-stream",
+            )
+        if endpoint == "prediction":
+            return self._predict(request, machine)
+        if endpoint == "anomaly":
+            return self._anomaly(request, machine)
+        raise NotFound(endpoint)
+
+    # -- payload handling ----------------------------------------------------
+    def _parse_X(self, request: Request, machine: _Machine) -> np.ndarray:
+        if request.method != "POST":
+            raise HTTPException(
+                response=Response(
+                    json.dumps({"error": "POST required"}),
+                    status=405,
+                    mimetype="application/json",
+                )
+            )
+        try:
+            payload = json.loads(request.get_data(as_text=True) or "{}")
+        except json.JSONDecodeError:
+            _abort(400, "Request body is not valid JSON")
+        X = payload.get("X")
+        if X is None:
+            _abort(400, 'Payload must contain "X"')
+        if isinstance(X, list) and X and isinstance(X[0], dict):
+            # list-of-records: column order from the build's tag list
+            tags = machine.tag_list or sorted(X[0])
+            try:
+                X = [[row[tag] for tag in tags] for row in X]
+            except KeyError as exc:
+                _abort(400, f"Record missing tag {exc.args[0]!r}")
+        try:
+            arr = np.asarray(X, dtype=np.float32)
+        except (ValueError, TypeError):
+            _abort(400, '"X" must be a rectangular numeric array')
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if arr.ndim != 2:
+            _abort(400, f'"X" must be 2-D, got shape {list(arr.shape)}')
+        return arr
+
+    def _predict(self, request: Request, machine: _Machine) -> Response:
+        X = self._parse_X(request, machine)
+        try:
+            output = machine.model.predict(X)
+        except ValueError as exc:
+            _abort(400, f"Prediction failed: {exc}")
+        return _json(
+            {
+                "data": {
+                    "model-input": X.tolist(),
+                    "model-output": np.asarray(output).tolist(),
+                }
+            }
+        )
+
+    def _anomaly(self, request: Request, machine: _Machine) -> Response:
+        model = machine.model
+        if not isinstance(model, AnomalyDetectorBase):
+            _abort(
+                422,
+                f"Model for machine {machine.name!r} is not an anomaly "
+                "detector; use /prediction",
+            )
+        start = request.args.get("start")
+        end = request.args.get("end")
+        timestamps: Optional[List[str]] = None
+        if start or end:
+            X_frame = self._fetch_range(machine, start, end)
+            timestamps_all = [ts.isoformat() for ts in X_frame.index]
+            frame = model.anomaly(X_frame)
+            timestamps = timestamps_all[len(timestamps_all) - len(frame) :]
+        else:
+            X = self._parse_X(request, machine)
+            try:
+                frame = model.anomaly(X)
+            except ValueError as exc:
+                _abort(400, f"Anomaly scoring failed: {exc}")
+        data = {
+            "model-input": frame["model-input"].values.tolist(),
+            "model-output": frame["model-output"].values.tolist(),
+            "tag-anomaly-scores": frame["tag-anomaly-scores"].values.tolist(),
+            "total-anomaly-score": np.ravel(
+                frame["total-anomaly-score"].values
+            ).tolist(),
+        }
+        if timestamps is not None:
+            data["timestamps"] = timestamps
+        thresholds = {}
+        if getattr(model, "tag_thresholds_", None) is not None:
+            thresholds = {
+                "tag-thresholds": [float(v) for v in model.tag_thresholds_],
+                "total-threshold": model.total_threshold_,
+            }
+        return _json({"data": data, **thresholds})
+
+    def _fetch_range(self, machine: _Machine, start, end):
+        """?start&end server-side fetch: rebuild the dataset from the config
+        embedded in build metadata with overridden dates."""
+        from ..dataset import GordoBaseDataset
+
+        config = machine.metadata.get("dataset", {}).get("dataset_config")
+        if not config:
+            _abort(
+                422,
+                "Build metadata carries no dataset_config; "
+                "POST data explicitly instead of using ?start&end",
+            )
+        if not (start and end):
+            _abort(400, "Both ?start and ?end are required")
+        config = dict(config)
+        config["train_start_date"] = start
+        config["train_end_date"] = end
+        try:
+            dataset = GordoBaseDataset.from_dict(config)
+            X, _ = dataset.get_data()
+        except Exception as exc:  # provider/parse errors → client error
+            _abort(400, f"Data fetch failed: {exc}")
+        return X
+
+
+def _json(payload: Dict[str, Any], status: int = 200) -> Response:
+    return Response(
+        json.dumps(payload, default=str),
+        status=status,
+        mimetype="application/json",
+    )
+
+
+def _abort(code: int, message: str) -> None:
+    raise HTTPException(
+        response=Response(
+            json.dumps({"error": message}), status=code, mimetype="application/json"
+        )
+    )
+
+
+def build_app(
+    model_dirs: Union[str, Dict[str, str]], project: str = "project"
+) -> ModelServer:
+    """App factory (reference: ``server.build_app``)."""
+    return ModelServer(model_dirs, project=project)
+
+
+def run_server(
+    model_dirs: Union[str, Dict[str, str]],
+    host: str = "0.0.0.0",
+    port: int = 5555,
+    project: str = "project",
+) -> None:
+    """Serve with werkzeug's multithreaded dev server (reference used
+    gunicorn, absent from this image; threads suffice because inference is
+    released-GIL jax compute)."""
+    from werkzeug.serving import run_simple
+
+    app = build_app(model_dirs, project=project)
+    run_simple(host, port, app, threaded=True)

@@ -1,0 +1,3 @@
+from .server import WatchmanServer, build_watchman_app, run_watchman
+
+__all__ = ["WatchmanServer", "build_watchman_app", "run_watchman"]

@@ -1,0 +1,110 @@
+"""Watchman: fleet health aggregator.
+
+Reference parity: ``gordo_components/watchman/server.py`` [UNVERIFIED] — a
+small service configured with the project name and machine list; ``GET /``
+polls every model endpoint's ``/healthz`` and reports which are up.
+
+Here the fleet usually lives behind ONE multi-model server process (TPU
+serving consolidation), so watchman polls
+``{target}/gordo/v0/<project>/<machine>/healthz`` per machine — but the
+machine list may also point at several hosts (``{machine: base_url}``),
+matching the reference's one-deployment-per-model layout.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+from werkzeug.wrappers import Request, Response
+
+logger = logging.getLogger(__name__)
+
+
+class WatchmanServer:
+    def __init__(
+        self,
+        project: str,
+        machines: Union[Sequence[str], Dict[str, str]],
+        target_url: Optional[str] = None,
+        timeout: float = 5.0,
+    ):
+        """``machines``: list of names served at ``target_url``, or an
+        explicit ``{machine: base_url}`` map."""
+        if isinstance(machines, dict):
+            self.machine_urls = dict(machines)
+        else:
+            if target_url is None:
+                raise ValueError(
+                    "target_url is required when machines is a name list"
+                )
+            self.machine_urls = {name: target_url for name in machines}
+        self.project = project
+        self.timeout = timeout
+
+    def _check(self, machine: str, base_url: str) -> Dict:
+        import requests
+
+        url = (
+            f"{base_url.rstrip('/')}/gordo/v0/{self.project}/{machine}/healthz"
+        )
+        started = time.perf_counter()
+        try:
+            response = requests.get(url, timeout=self.timeout)
+            healthy = response.status_code == 200
+        except requests.RequestException as exc:
+            logger.warning("Watchman: %s unreachable: %r", machine, exc)
+            healthy = False
+        return {
+            "endpoint": url,
+            "target": machine,
+            "healthy": healthy,
+            "latency_ms": (time.perf_counter() - started) * 1000,
+        }
+
+    def status(self) -> Dict:
+        endpoints: List[Dict] = [
+            self._check(machine, url)
+            for machine, url in sorted(self.machine_urls.items())
+        ]
+        return {
+            "project-name": self.project,
+            "ok": all(e["healthy"] for e in endpoints),
+            "endpoints": endpoints,
+        }
+
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        if request.path in ("/", ""):
+            body = self.status()
+            status = 200
+        elif request.path == "/healthz":
+            body, status = {"ok": True}, 200
+        else:
+            body, status = {"error": "not found"}, 404
+        response = Response(
+            json.dumps(body), status=status, mimetype="application/json"
+        )
+        return response(environ, start_response)
+
+
+def build_watchman_app(
+    project: str,
+    machines: Union[Sequence[str], Dict[str, str]],
+    target_url: Optional[str] = None,
+) -> WatchmanServer:
+    return WatchmanServer(project, machines, target_url)
+
+
+def run_watchman(
+    project: str,
+    machines: Union[Sequence[str], Dict[str, str]],
+    target_url: Optional[str] = None,
+    host: str = "0.0.0.0",
+    port: int = 5556,
+) -> None:
+    from werkzeug.serving import run_simple
+
+    run_simple(host, port, build_watchman_app(project, machines, target_url))

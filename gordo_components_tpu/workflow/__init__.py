@@ -1,0 +1,9 @@
+from .config_elements import Machine, NormalizedConfig
+from .workflow_generator import generate_argo_workflow, generate_tpu_job
+
+__all__ = [
+    "Machine",
+    "NormalizedConfig",
+    "generate_argo_workflow",
+    "generate_tpu_job",
+]

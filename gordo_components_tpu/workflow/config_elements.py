@@ -1,0 +1,103 @@
+"""Fleet-config normalization.
+
+Reference parity: ``gordo_components/workflow/config_elements/
+normalized_config.py`` + ``machine.py`` [UNVERIFIED] — the fleet YAML lists
+``machines`` and a ``globals`` section of defaults; ``NormalizedConfig``
+merges per-machine config over the globals (machine wins, dict-deep for
+dataset/metadata), yielding one fully-specified :class:`Machine` per entry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+import yaml
+
+
+@dataclass
+class Machine:
+    name: str
+    model: Dict[str, Any]
+    dataset: Dict[str, Any]
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    evaluation: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("Machine requires a non-empty name")
+        if not self.model:
+            raise ValueError(f"Machine {self.name!r} has no model config "
+                             "(neither per-machine nor in globals)")
+        if not self.dataset:
+            raise ValueError(f"Machine {self.name!r} has no dataset config")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "model": self.model,
+            "dataset": self.dataset,
+            "metadata": self.metadata,
+            "evaluation": self.evaluation,
+        }
+
+
+def _merged(defaults: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(defaults)
+    out.update(override or {})
+    return out
+
+
+class NormalizedConfig:
+    """``yaml/dict`` fleet config → normalized machines.
+
+    Expected shape::
+
+        project-name: my-project
+        machines:
+          - name: m1
+            dataset: {tag_list: [...], ...}
+            model: {...}           # optional if globals.model given
+            metadata: {...}
+            evaluation: {...}
+        globals:
+          model: {...}
+          dataset: {resolution: 10min, ...}
+          evaluation: {n_splits: 3}
+    """
+
+    def __init__(self, config: Union[str, Dict[str, Any]]):
+        if isinstance(config, str):
+            config = yaml.safe_load(config)
+        if not isinstance(config, dict):
+            raise ValueError(f"Fleet config must be a mapping, got {type(config)}")
+        self.project_name: str = config.get("project-name") or config.get(
+            "project_name", "project"
+        )
+        raw_machines: Optional[List[Dict[str, Any]]] = config.get("machines")
+        if not raw_machines:
+            raise ValueError("Fleet config has no 'machines' list")
+        defaults = config.get("globals", {}) or {}
+        default_model = defaults.get("model", {}) or {}
+        default_dataset = defaults.get("dataset", {}) or {}
+        default_metadata = defaults.get("metadata", {}) or {}
+        default_evaluation = defaults.get("evaluation", {}) or {}
+
+        seen: set = set()
+        self.machines: List[Machine] = []
+        for entry in raw_machines:
+            name = entry.get("name")
+            if name in seen:
+                raise ValueError(f"Duplicate machine name {name!r}")
+            seen.add(name)
+            self.machines.append(
+                Machine(
+                    name=name,
+                    model=entry.get("model") or default_model,
+                    dataset=_merged(default_dataset, entry.get("dataset", {})),
+                    metadata=_merged(default_metadata, entry.get("metadata", {})),
+                    evaluation=_merged(
+                        default_evaluation, entry.get("evaluation", {})
+                    ),
+                )
+            )

@@ -1,0 +1,177 @@
+"""CLI tests via click's CliRunner (SURVEY.md §5)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from gordo_components_tpu.cli import gordo
+from gordo_components_tpu.serializer import load, load_metadata
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-03T00:00:00+00:00",
+    "tag_list": ["cli-a", "cli-b"],
+}
+
+MODEL_CONFIG = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric", "dims": [4],
+                                  "epochs": 1, "batch_size": 32}},
+        ]
+    }
+}
+
+FLEET_YAML = {
+    "project-name": "cli-fleet",
+    "machines": [
+        {"name": "fm-1", "dataset": {"tag_list": ["f1-a", "f1-b"]}},
+        {"name": "fm-2", "dataset": {"tag_list": ["f2-a", "f2-b"]}},
+    ],
+    "globals": {
+        "model": {
+            "DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "TransformedTargetRegressor": {
+                        "regressor": {
+                            "Pipeline": {
+                                "steps": [
+                                    "MinMaxScaler",
+                                    {"DenseAutoEncoder": {
+                                        "kind": "feedforward_symmetric",
+                                        "dims": [4], "epochs": 1,
+                                        "batch_size": 32}},
+                                ]
+                            }
+                        },
+                        "transformer": "MinMaxScaler",
+                    }
+                }
+            }
+        },
+        "dataset": {
+            "type": "RandomDataset",
+            "train_start_date": "2023-01-01T00:00:00+00:00",
+            "train_end_date": "2023-01-03T00:00:00+00:00",
+        },
+    },
+}
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def test_cli_help(runner):
+    result = runner.invoke(gordo, ["--help"])
+    assert result.exit_code == 0
+    for command in ("build", "fleet-build", "run-server", "workflow", "client"):
+        assert command in result.output
+
+
+def test_cli_build_env_vars(runner, tmp_path):
+    """Argo-style invocation: configs via env vars."""
+    out = str(tmp_path / "model")
+    result = runner.invoke(
+        gordo,
+        ["build", "cli-machine", "--cv-mode", "build_only"],
+        env={
+            "MODEL_CONFIG": json.dumps(MODEL_CONFIG),
+            "DATA_CONFIG": json.dumps(DATA_CONFIG),
+            "OUTPUT_DIR": out,
+            "MODEL_REGISTER_DIR": str(tmp_path / "reg"),
+        },
+    )
+    assert result.exit_code == 0, result.output
+    assert out in result.output
+    model = load(out)
+    assert model.predict(np.zeros((3, 2), np.float32)).shape == (3, 2)
+    assert load_metadata(out)["name"] == "cli-machine"
+
+
+def test_cli_build_exit_codes(runner, tmp_path):
+    # bad model config -> 64 (permanent config error)
+    result = runner.invoke(
+        gordo,
+        ["build", "m", "--model-config", json.dumps({"NoSuchModel": {}}),
+         "--data-config", json.dumps(DATA_CONFIG),
+         "--output-dir", str(tmp_path / "x")],
+    )
+    assert result.exit_code == 64
+    # insufficient data -> 66 (retryable)
+    short_data = {**DATA_CONFIG, "row_threshold": 10_000_000}
+    result = runner.invoke(
+        gordo,
+        ["build", "m", "--model-config", json.dumps(MODEL_CONFIG),
+         "--data-config", json.dumps(short_data),
+         "--output-dir", str(tmp_path / "y")],
+    )
+    assert result.exit_code == 66
+    # missing config entirely -> 64
+    result = runner.invoke(
+        gordo, ["build", "m", "--output-dir", str(tmp_path / "z")], env={}
+    )
+    assert result.exit_code in (64, 2)
+
+
+def test_cli_fleet_build(runner, tmp_path):
+    config_file = tmp_path / "fleet.yaml"
+    config_file.write_text(yaml.safe_dump(FLEET_YAML))
+    out = str(tmp_path / "models")
+    result = runner.invoke(
+        gordo,
+        ["fleet-build", "--machine-config", str(config_file),
+         "--output-dir", out, "--n-splits", "0", "--n-devices", "2"],
+    )
+    assert result.exit_code == 0, result.output
+    dirs = json.loads(result.output)
+    assert set(dirs) == {"fm-1", "fm-2"}
+    for model_dir in dirs.values():
+        assert os.path.isdir(model_dir)
+        load(model_dir)
+
+
+def test_cli_workflow_generate(runner, tmp_path):
+    config_file = tmp_path / "fleet.yaml"
+    config_file.write_text(yaml.safe_dump(FLEET_YAML))
+    result = runner.invoke(
+        gordo, ["workflow", "generate", "--machine-config", str(config_file)]
+    )
+    assert result.exit_code == 0, result.output
+    documents = [d for d in yaml.safe_load_all(result.output) if d]
+    assert documents[0]["kind"] == "Workflow"
+
+    out_file = str(tmp_path / "manifest.yaml")
+    result = runner.invoke(
+        gordo,
+        ["workflow", "generate", "--machine-config", str(config_file),
+         "--tpu", "--output-file", out_file],
+    )
+    assert result.exit_code == 0, result.output
+    with open(out_file) as fh:
+        documents = [d for d in yaml.safe_load_all(fh) if d]
+    assert [d["kind"] for d in documents] == ["Job", "Deployment"]
+
+
+def test_cli_module_entrypoint():
+    """python -m gordo_components_tpu.cli --help must work (container
+    command shape in the generated manifests)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "gordo_components_tpu.cli", "--help"],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "fleet-build" in proc.stdout

@@ -1,0 +1,134 @@
+"""Client + watchman integration tests against a REAL in-process HTTP
+server (werkzeug make_server in a thread) — the rebuild's equivalent of the
+reference's docker-Influx client tests: actual sockets, retries, chunking."""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+from werkzeug.serving import make_server
+
+from gordo_components_tpu.builder import provide_saved_model
+from gordo_components_tpu.client import Client, ClientError, CsvForwarder
+from gordo_components_tpu.client.utils import make_date_ranges
+from gordo_components_tpu.server import build_app
+from gordo_components_tpu.watchman import build_watchman_app
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-04T00:00:00+00:00",
+    "tag_list": ["c-a", "c-b"],
+}
+
+MODEL_CONFIG = {
+    "DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "TransformedTargetRegressor": {
+                "regressor": {
+                    "Pipeline": {
+                        "steps": [
+                            "MinMaxScaler",
+                            {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                                  "dims": [6], "epochs": 2,
+                                                  "batch_size": 32}},
+                        ]
+                    }
+                },
+                "transformer": "MinMaxScaler",
+            }
+        }
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    root = tmp_path_factory.mktemp("client_models")
+    dirs = {}
+    for name in ("mach-1", "mach-2"):
+        dirs[name] = provide_saved_model(
+            name, MODEL_CONFIG, DATA_CONFIG, str(root / name),
+            evaluation_config={"n_splits": 2},
+        )
+    app = build_app(dirs, project="proj")
+    server = make_server("127.0.0.1", 0, app, threaded=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_make_date_ranges():
+    ranges = make_date_ranges("2023-01-01", "2023-01-03T12:00:00", "1D")
+    assert len(ranges) == 3
+    assert ranges[0][0] == pd.Timestamp("2023-01-01", tz="UTC")
+    assert ranges[-1][1] == pd.Timestamp("2023-01-03T12:00:00", tz="UTC")
+    # chunks tile the range exactly
+    for (_, e1), (s2, _) in zip(ranges, ranges[1:]):
+        assert e1 == s2
+    with pytest.raises(ValueError):
+        make_date_ranges("2023-01-02", "2023-01-01")
+
+
+def test_client_predict_end_to_end(served, tmp_path):
+    forwarder = CsvForwarder(str(tmp_path / "fwd"))
+    client = Client(served, project="proj", max_interval="12h",
+                    forwarders=[forwarder])
+    frames = client.predict("2023-02-01T00:00:00+00:00",
+                            "2023-02-02T00:00:00+00:00")
+    assert set(frames) == {"mach-1", "mach-2"}
+    for machine, frame in frames.items():
+        assert len(frame) > 0
+        assert "total-anomaly-score" in frame.columns
+        assert frame.index.is_monotonic_increasing
+        assert np.isfinite(frame["total-anomaly-score"].values).all()
+        # forwarder wrote a CSV per machine
+        assert (tmp_path / "fwd" / f"{machine}.csv").exists()
+
+
+def test_client_machine_discovery(served):
+    client = Client(served, project="proj")
+    assert client.resolve_machines() == ["mach-1", "mach-2"]
+
+
+def test_client_explicit_machine_subset(served):
+    client = Client(served, project="proj")
+    frames = client.predict("2023-02-01", "2023-02-01T06:00:00",
+                            machine_names=["mach-2"])
+    assert set(frames) == {"mach-2"}
+
+
+def test_client_4xx_is_permanent_error(served):
+    client = Client(served, project="proj", retries=1)
+    with pytest.raises(ClientError, match="HTTP 4"):
+        client.predict("2023-02-01", "2023-02-02", machine_names=["no-such"])
+
+
+def test_client_retries_exhausted_on_dead_server():
+    client = Client("http://127.0.0.1:9", project="proj", retries=1,
+                    retry_backoff=0.01, timeout=2)
+    with pytest.raises(ClientError, match="retries exhausted"):
+        client.predict("2023-02-01", "2023-02-01T01:00:00",
+                       machine_names=["m"])
+
+
+def test_watchman_aggregates_health(served):
+    from werkzeug.test import Client as TestClient
+
+    app = build_watchman_app("proj", ["mach-1", "mach-2", "ghost"],
+                             target_url=served)
+    watchman = TestClient(app)
+    body = watchman.get("/").get_json()
+    assert body["project-name"] == "proj"
+    by_name = {e["target"]: e for e in body["endpoints"]}
+    assert by_name["mach-1"]["healthy"] is True
+    assert by_name["mach-2"]["healthy"] is True
+    # machine-scoped healthz 404s for unknown machines
+    assert by_name["ghost"]["healthy"] is False
+    assert body["ok"] is False
+    assert watchman.get("/healthz").get_json() == {"ok": True}
+    assert watchman.get("/nope").status_code == 404

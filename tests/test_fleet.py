@@ -256,17 +256,19 @@ def test_fleet_short_machine_gets_real_thresholds():
     spec, batch = _make_spec_and_batch(2, n_rows=256, n_splits=3)
     X = batch.X.copy()
     w = batch.w.copy()
-    # machine 1: only 64 real rows, RIGHT-aligned (leading padding)
-    X[1, :192] = 0.0
-    w[1, :192] = 0.0
+    # machine 1: 128 real rows, RIGHT-aligned (leading padding) — the last
+    # fold (train [0,192), test [192,256)) covers real data on both sides
+    X[1, :128] = 0.0
+    w[1, :128] = 0.0
     result = train_fleet_arrays(spec, batch._replace(X=X, y=X.copy(), w=w))
     thresholds = np.asarray(result.tag_thresholds[1])
     assert np.isfinite(thresholds).all()
     assert (thresholds > 0).any(), "short machine must get usable thresholds"
     cv = np.asarray(result.cv_scores[1])
-    # early folds may be empty (NaN) but never reported as fake scores, and
-    # at least the last fold must cover real data
+    # early folds are empty for this machine (NaN, never fake scores); the
+    # last fold genuinely trains and tests on its real data
     assert np.isfinite(cv[-1])
+    assert not np.isfinite(cv[0])
 
 
 def test_fleet_cache_key_includes_eval_config():
@@ -302,6 +304,76 @@ def test_fleet_standard_scaler_options_honored():
     np.testing.assert_array_equal(
         np.asarray(result.input_scaler.offset), 0.0
     )
+
+
+def test_fleet_target_scaler_independent_of_input_scaler():
+    """TTR transformer with NO input scaler: targets must still be
+    minmax-scaled (the target scaler kind comes from the transformer, not
+    the pipeline's input scaler)."""
+    config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "TransformedTargetRegressor": {
+                    "regressor": {"DenseAutoEncoder": {
+                        "kind": "feedforward_symmetric", "dims": [4],
+                        "epochs": 1, "batch_size": 32}},
+                    "transformer": "MinMaxScaler",
+                }
+            }
+        }
+    }
+    probe = pipeline_from_definition(config)
+    spec = _spec_for(_analyze_model(probe), 3, 3, 0)
+    assert spec.scaler == "none"
+    assert spec.scale_targets is True
+    assert spec.target_scaler == "minmax"
+    _, batch = _make_spec_and_batch(2)
+    result = train_fleet_arrays(spec, batch)
+    # target scaler actually fitted (real minmax, not identity)
+    assert not np.allclose(np.asarray(result.target_scaler.scale), 1.0)
+
+
+def test_fleet_rejects_non_minmax_error_scaler():
+    config = {
+        "DiffBasedAnomalyDetector": {
+            "scaler": "StandardScaler",
+            "base_estimator": {"DenseAutoEncoder": {"epochs": 1}},
+        }
+    }
+    probe = pipeline_from_definition(config)
+    with pytest.raises(ValueError, match="error scaler"):
+        _spec_for(_analyze_model(probe), 3, 3, 0)
+
+
+def test_fleet_untrainable_folds_fall_back_to_final_residuals():
+    """A machine so short that NO fold's train region covers its data must
+    get thresholds from final-model residuals, not an untrained network."""
+    spec, batch = _make_spec_and_batch(2, n_rows=256, n_splits=3)
+    X = batch.X.copy()
+    w = batch.w.copy()
+    # machine 1: real data only in the LAST 48 rows -> every fold's train
+    # region [0, b0) holds zero real rows for fold boundaries at 64/128/192
+    X[1, :208] = 0.0
+    w[1, :208] = 0.0
+    result = train_fleet_arrays(spec, batch._replace(X=X, y=X.copy(), w=w))
+    thresholds = np.asarray(result.tag_thresholds[1])
+    assert np.isfinite(thresholds).all()
+    assert (thresholds > 0).any()
+    assert float(result.total_threshold[1]) > 0
+    # CV scores for that machine are all-NaN (no honest folds), not fake
+    assert not np.isfinite(np.asarray(result.cv_scores[1])).any()
+    # the normal machine still gets real CV scores
+    assert np.isfinite(np.asarray(result.cv_scores[0])).all()
+
+
+def test_provide_saved_model_rejects_cross_val_only(tmp_path):
+    from gordo_components_tpu.builder import provide_saved_model
+
+    with pytest.raises(ValueError, match="cross_val_only"):
+        provide_saved_model(
+            "m", MODEL_CONFIG, _data_config(["a"]), str(tmp_path / "x"),
+            evaluation_config={"cv_mode": "cross_val_only"},
+        )
 
 
 def test_fleet_heterogeneous_buckets(tmp_path):

@@ -1,0 +1,186 @@
+"""Server integration tests (SURVEY.md §5: in-proc test client against a
+fixture-built model dir — all routes, bad payloads → 4xx, response schema)."""
+
+import json
+
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from gordo_components_tpu.builder import provide_saved_model
+from gordo_components_tpu.serializer import loads
+from gordo_components_tpu.server import build_app
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-04T00:00:00+00:00",
+    "tag_list": ["tag-a", "tag-b", "tag-c"],
+}
+
+ANOMALY_MODEL = {
+    "DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "TransformedTargetRegressor": {
+                "regressor": {
+                    "Pipeline": {
+                        "steps": [
+                            "MinMaxScaler",
+                            {"DenseAutoEncoder": {"kind": "feedforward_hourglass",
+                                                  "epochs": 2, "batch_size": 32}},
+                        ]
+                    }
+                },
+                "transformer": "MinMaxScaler",
+            }
+        }
+    }
+}
+
+PLAIN_MODEL = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric", "dims": [6],
+                                  "epochs": 1, "batch_size": 32}},
+        ]
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("served")
+    anomaly_dir = provide_saved_model(
+        "machine-a", ANOMALY_MODEL, DATA_CONFIG, str(root / "anomaly"),
+        evaluation_config={"n_splits": 2},
+    )
+    plain_dir = provide_saved_model(
+        "machine-p", PLAIN_MODEL, DATA_CONFIG, str(root / "plain"),
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    return {"machine-a": anomaly_dir, "machine-p": plain_dir}
+
+
+@pytest.fixture(scope="module")
+def client(model_dirs):
+    return Client(build_app(model_dirs, project="proj"))
+
+
+@pytest.fixture(scope="module")
+def single_client(model_dirs):
+    return Client(build_app(model_dirs["machine-a"]))
+
+
+def _post(client, path, payload):
+    return client.post(path, data=json.dumps(payload),
+                       content_type="application/json")
+
+
+def test_healthz(client):
+    response = client.get("/healthz")
+    assert response.status_code == 200
+    assert response.get_json() == {"ok": True}
+
+
+def test_models_listing(client):
+    body = client.get("/models").get_json()
+    assert body == {"project": "proj", "models": ["machine-a", "machine-p"]}
+
+
+def test_metadata_route(client):
+    body = client.get("/gordo/v0/proj/machine-a/metadata").get_json()
+    assert body["name"] == "machine-a"
+    assert body["metadata"]["model"]["cross_validation"]["n_splits"] == 2
+    assert body["metadata"]["dataset"]["tag_list"] == ["tag-a", "tag-b", "tag-c"]
+
+
+def test_prediction_array_payload(client):
+    X = np.zeros((5, 3)).tolist()
+    body = _post(client, "/gordo/v0/proj/machine-p/prediction", {"X": X}).get_json()
+    assert len(body["data"]["model-input"]) == 5
+    assert len(body["data"]["model-output"]) == 5
+    assert len(body["data"]["model-output"][0]) == 3
+
+
+def test_prediction_records_payload(client):
+    records = [{"tag-a": 0.1, "tag-b": 0.2, "tag-c": 0.3}] * 4
+    body = _post(client, "/gordo/v0/proj/machine-a/prediction",
+                 {"X": records}).get_json()
+    assert len(body["data"]["model-output"]) == 4
+
+
+def test_anomaly_prediction(client):
+    X = np.random.default_rng(0).normal(size=(10, 3)).tolist()
+    response = _post(client, "/gordo/v0/proj/machine-a/anomaly/prediction",
+                     {"X": X})
+    assert response.status_code == 200
+    data = response.get_json()["data"]
+    assert set(data) == {"model-input", "model-output", "tag-anomaly-scores",
+                         "total-anomaly-score"}
+    assert len(data["total-anomaly-score"]) == 10
+    body = response.get_json()
+    assert len(body["tag-thresholds"]) == 3
+    assert isinstance(body["total-threshold"], float)
+
+
+def test_anomaly_with_server_side_fetch(client):
+    response = client.post(
+        "/gordo/v0/proj/machine-a/anomaly/prediction"
+        "?start=2023-02-01T00:00:00%2B00:00&end=2023-02-02T00:00:00%2B00:00"
+    )
+    assert response.status_code == 200
+    data = response.get_json()["data"]
+    assert len(data["timestamps"]) == len(data["total-anomaly-score"]) > 0
+
+
+def test_anomaly_on_plain_model_422(client):
+    response = _post(client, "/gordo/v0/proj/machine-p/anomaly/prediction",
+                     {"X": [[0, 0, 0]]})
+    assert response.status_code == 422
+
+
+def test_bad_payloads_4xx(client):
+    path = "/gordo/v0/proj/machine-p/prediction"
+    assert _post(client, path, {}).status_code == 400
+    assert _post(client, path, {"X": "nope"}).status_code == 400
+    assert _post(client, path, {"X": [[1], [1, 2]]}).status_code == 400
+    response = client.post(path, data="{not json", content_type="application/json")
+    assert response.status_code == 400
+    records = [{"tag-a": 1.0}]  # missing tags
+    assert _post(client, path, {"X": records}).status_code == 400
+
+
+def test_unknown_machine_404(client):
+    assert client.get("/gordo/v0/proj/nope/metadata").status_code == 404
+    assert client.get("/gordo/v0/wrongproj/machine-a/metadata").status_code == 404
+    assert client.get("/no/such/route").status_code == 404
+
+
+def test_download_model_round_trips(client):
+    response = client.get("/gordo/v0/proj/machine-a/download-model")
+    assert response.status_code == 200
+    model = loads(response.get_data())
+    X = np.zeros((3, 3), np.float32)
+    assert model.anomaly(X).shape[0] == 3
+
+
+def test_single_model_mode_bare_paths(single_client):
+    assert single_client.get("/healthz").status_code == 200
+    assert single_client.get("/metadata").get_json()["name"] == "machine-a"
+    X = np.zeros((4, 3)).tolist()
+    response = _post(single_client, "/anomaly/prediction", {"X": X})
+    assert response.status_code == 200
+
+
+def test_bare_paths_rejected_in_multi_mode(client):
+    response = _post(client, "/prediction", {"X": [[0, 0, 0]]})
+    assert response.status_code == 404
+
+
+def test_metrics_endpoint(client):
+    client.get("/healthz")
+    body = client.get("/metrics").get_json()
+    assert "healthz" in body["latency"]
+    assert body["latency"]["healthz"]["count"] >= 1
+    assert body["latency"]["healthz"]["p50_ms"] >= 0

@@ -1,0 +1,108 @@
+"""Workflow-generator tests (SURVEY.md §5: generate manifests from sample
+configs → yaml-parse + golden assertions, never submitted)."""
+
+import yaml
+import pytest
+
+from gordo_components_tpu.workflow import (
+    NormalizedConfig,
+    generate_argo_workflow,
+    generate_tpu_job,
+)
+from gordo_components_tpu.workflow.workflow_generator import validate_generated
+
+FLEET_YAML = """
+project-name: plant-x
+machines:
+  - name: compressor-1
+    dataset:
+      tag_list: [c1-a, c1-b]
+  - name: compressor-2
+    dataset:
+      tag_list: [c2-a, c2-b, c2-c]
+      resolution: 1h
+    model:
+      DiffBasedAnomalyDetector:
+        base_estimator:
+          Pipeline:
+            steps: [MinMaxScaler, {DenseAutoEncoder: {epochs: 5}}]
+    metadata:
+      owner: team-2
+globals:
+  model:
+    DiffBasedAnomalyDetector:
+      base_estimator:
+        Pipeline:
+          steps: [MinMaxScaler, {DenseAutoEncoder: {epochs: 10}}]
+  dataset:
+    train_start_date: "2023-01-01T00:00:00+00:00"
+    train_end_date: "2023-02-01T00:00:00+00:00"
+    resolution: 10min
+  metadata:
+    owner: team-default
+"""
+
+
+def test_normalized_config_merges_globals():
+    config = NormalizedConfig(FLEET_YAML)
+    assert config.project_name == "plant-x"
+    assert len(config.machines) == 2
+    m1, m2 = config.machines
+    # machine 1: everything from globals except its own tags
+    assert m1.dataset["tag_list"] == ["c1-a", "c1-b"]
+    assert m1.dataset["resolution"] == "10min"
+    assert m1.dataset["train_start_date"] == "2023-01-01T00:00:00+00:00"
+    assert "DiffBasedAnomalyDetector" in m1.model
+    assert m1.metadata == {"owner": "team-default"}
+    # machine 2: overrides win
+    assert m2.dataset["resolution"] == "1h"
+    assert m2.metadata == {"owner": "team-2"}
+    steps = m2.model["DiffBasedAnomalyDetector"]["base_estimator"]["Pipeline"]["steps"]
+    assert steps[1]["DenseAutoEncoder"]["epochs"] == 5
+
+
+def test_normalized_config_validation():
+    with pytest.raises(ValueError, match="machines"):
+        NormalizedConfig({"project-name": "x"})
+    with pytest.raises(ValueError, match="Duplicate"):
+        NormalizedConfig(
+            {"machines": [{"name": "a", "dataset": {"x": 1}, "model": {"m": {}}},
+                          {"name": "a", "dataset": {"x": 1}, "model": {"m": {}}}]}
+        )
+    with pytest.raises(ValueError, match="no model"):
+        NormalizedConfig({"machines": [{"name": "a", "dataset": {"x": 1}}]})
+
+
+def test_argo_workflow_golden():
+    manifest = generate_argo_workflow(FLEET_YAML, parallelism=7)
+    validate_generated(manifest)
+    documents = [d for d in yaml.safe_load_all(manifest) if d]
+    kinds = [d["kind"] for d in documents]
+    # 1 Workflow + 2x(Deployment+Service) + 1 watchman Deployment
+    assert kinds.count("Workflow") == 1
+    assert kinds.count("Deployment") == 3
+    assert kinds.count("Service") == 2
+    workflow = documents[0]
+    assert workflow["spec"]["parallelism"] == 7
+    tasks = workflow["spec"]["templates"][0]["dag"]["tasks"]
+    assert {t["name"] for t in tasks} == {"build-compressor-1",
+                                          "build-compressor-2"}
+    # builder env carries the per-machine configs the reference injects
+    builder = workflow["spec"]["templates"][1]
+    env_names = {e["name"] for e in builder["container"]["env"]}
+    assert {"MODEL_CONFIG", "DATA_CONFIG", "OUTPUT_DIR",
+            "MODEL_REGISTER_DIR"} <= env_names
+
+
+def test_tpu_job_golden():
+    manifest = generate_tpu_job(FLEET_YAML, tpu_chips=16)
+    validate_generated(manifest)
+    documents = [d for d in yaml.safe_load_all(manifest) if d]
+    kinds = [d["kind"] for d in documents]
+    # the whole fleet collapses to ONE Job + ONE server Deployment
+    assert kinds == ["Job", "Deployment"]
+    job = documents[0]
+    args = job["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "fleet-build" in args
+    limits = job["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    assert limits["google.com/tpu"] == 16
