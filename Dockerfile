@@ -1,0 +1,24 @@
+# One parameterized image for the three runtime roles (the reference ships
+# Dockerfile-ModelBuilder / -ModelServer / -Watchman; here a single image +
+# ROLE build-arg keeps them byte-identical below the entrypoint, which is
+# what the generated workflow manifests assume).
+#
+# Build:  docker build -t gordo-tpu-<role> --build-arg ROLE=<role> .
+# Roles:  builder  -> `gordo-tpu build` (Argo injects env vars)
+#         server   -> `gordo-tpu run-server`
+#         watchman -> `gordo-tpu run-watchman`
+
+FROM python:3.12-slim
+
+ARG ROLE=builder
+ENV GORDO_ROLE=${ROLE} \
+    PYTHONUNBUFFERED=1
+
+WORKDIR /opt/gordo
+COPY pyproject.toml README.md ./
+COPY gordo_components_tpu ./gordo_components_tpu
+
+# TPU runtime: swap `jax` for `jax[tpu]` when building for TPU VMs
+RUN pip install --no-cache-dir .
+
+ENTRYPOINT ["python", "-m", "gordo_components_tpu.cli"]

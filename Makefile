@@ -1,0 +1,24 @@
+# Developer entry points (reference parity: the reference ships a Makefile
+# driving tests and its four docker images).
+
+.PHONY: test testfast bench images builder-image server-image watchman-image
+
+test:
+	python -m pytest tests/ -q
+
+testfast:
+	python -m pytest tests/ -q -x -m "not slow"
+
+bench:
+	python bench.py
+
+images: builder-image server-image watchman-image
+
+builder-image:
+	docker build -t gordo-tpu-builder --build-arg ROLE=builder -f Dockerfile .
+
+server-image:
+	docker build -t gordo-tpu-server --build-arg ROLE=server -f Dockerfile .
+
+watchman-image:
+	docker build -t gordo-tpu-watchman --build-arg ROLE=watchman -f Dockerfile .

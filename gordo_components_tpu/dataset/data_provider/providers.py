@@ -222,6 +222,61 @@ class InfluxDataProvider(GordoBaseDataProvider):
             yield series
 
 
+class FlakyDataProvider(GordoBaseDataProvider):
+    """Fault-injection wrapper: delegates to ``provider`` but raises after
+    ``fail_after`` successfully yielded series, for ``fail_times`` calls.
+
+    Test-only (SURVEY.md §6.3 rebuild implication: "fault injection as a
+    test-only provider that raises mid-stream") — exercises the builder's
+    retry exit codes and the fleet's idempotent-resume path without real
+    infrastructure failures.
+    """
+
+    def __init__(
+        self,
+        provider: Any = None,
+        fail_after: int = 1,
+        fail_times: int = 1,
+        **provider_kwargs: Any,
+    ):
+        if provider is None:
+            provider = RandomDataProvider(**provider_kwargs)
+        elif isinstance(provider, dict):
+            provider = GordoBaseDataProvider.from_dict(provider)
+        self.provider = provider
+        self.fail_after = fail_after
+        self.fail_times = fail_times
+        self._failures = 0
+        self._init_kwargs = {
+            "provider": provider.to_dict(),
+            "fail_after": fail_after,
+            "fail_times": fail_times,
+        }
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return self.provider.can_handle_tag(tag)
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        yielded = 0
+        for series in self.provider.load_series(
+            train_start_date, train_end_date, tag_list, dry_run=dry_run
+        ):
+            if self._failures < self.fail_times and yielded >= self.fail_after:
+                self._failures += 1
+                raise IOError(
+                    f"Injected provider failure after {yielded} series "
+                    f"(failure {self._failures}/{self.fail_times})"
+                )
+            yielded += 1
+            yield series
+
+
 class CompositeDataProvider(GordoBaseDataProvider):
     """Dispatch each tag to the first sub-provider that can handle it —
     the shape of the reference's DataLakeProvider delegating to

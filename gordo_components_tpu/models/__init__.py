@@ -20,13 +20,15 @@ from .models import (
     DenseAutoEncoder,
     LSTMAutoEncoder,
     LSTMForecast,
+    PatchTSTAutoEncoder,
+    PatchTSTForecast,
     KerasAutoEncoder,
     KerasLSTMAutoEncoder,
     KerasLSTMForecast,
 )
 
 # import for the registration side effects — every factory registers its kind
-from .factories import feedforward, lstm  # noqa: F401
+from .factories import feedforward, lstm, transformer  # noqa: F401
 
 __all__ = [
     "GordoBase",
@@ -37,6 +39,8 @@ __all__ = [
     "DenseAutoEncoder",
     "LSTMAutoEncoder",
     "LSTMForecast",
+    "PatchTSTAutoEncoder",
+    "PatchTSTForecast",
     "KerasAutoEncoder",
     "KerasLSTMAutoEncoder",
     "KerasLSTMForecast",

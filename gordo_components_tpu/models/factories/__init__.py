@@ -1,4 +1,4 @@
 from .spec import ModelSpec, make_optimizer
-from . import feedforward, lstm  # noqa: F401 — registration side effects
+from . import feedforward, lstm, transformer  # noqa: F401 — registration side effects
 
-__all__ = ["ModelSpec", "make_optimizer", "feedforward", "lstm"]
+__all__ = ["ModelSpec", "make_optimizer", "feedforward", "lstm", "transformer"]

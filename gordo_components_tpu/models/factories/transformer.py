@@ -1,0 +1,175 @@
+"""PatchTST transformer factory — the rebuild's new model kind.
+
+No reference counterpart (the reference zoo stops at LSTM); this covers
+BASELINE.md config 5 ("Transformer/PatchTST anomaly head on a 10k-tag
+plant"). Architecture follows PatchTST (Nie et al., ICLR 2023, public):
+channel-independent patching — each tag's lookback window is split into
+patches, embedded, and run through a shared transformer encoder; a linear
+head per channel emits the reconstruction/forecast. TPU notes: patching is
+a static gather; attention over ≤dozens of patches lowers to MXU matmuls
+that XLA flash-fuses; for very long windows the sequence axis can shard
+over a mesh with :func:`gordo_components_tpu.ops.attention.ring_attention`.
+
+The ``patchtst`` kind plugs into the standard window estimators
+(``input_kind="window"``), so ``PatchTSTAutoEncoder`` / ``PatchTSTForecast``
+inherit the exact windowing contracts — and the fleet engine buckets
+transformer machines like any other kind.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..modules import activation, resolve_dtype
+from ..register import register_model_factory
+from .feedforward import _reject_unknown
+from .spec import ModelSpec, make_optimizer
+
+
+class TransformerEncoderLayer(nn.Module):
+    d_model: int
+    n_heads: int
+    ff_dim: int
+    dropout: float
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        dtype = resolve_dtype(self.compute_dtype)
+        h = nn.LayerNorm(dtype=dtype)(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.n_heads,
+            qkv_features=self.d_model,
+            dropout_rate=self.dropout,
+            dtype=dtype,
+        )(h, h, deterministic=deterministic)
+        x = x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        h = nn.LayerNorm(dtype=dtype)(x)
+        h = nn.Dense(self.ff_dim, dtype=dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, dtype=dtype)(h)
+        return x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
+
+
+class PatchTSTModule(nn.Module):
+    """``(batch, L, F) → (batch, F_out)`` channel-independent PatchTST."""
+
+    n_features_out: int
+    patch_length: int
+    stride: int
+    d_model: int
+    n_heads: int
+    n_layers: int
+    ff_dim: int
+    dropout: float = 0.0
+    out_func: str = "linear"
+    compute_dtype: Any = "float32"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        batch, window, n_features = x.shape
+        if window < self.patch_length:
+            raise ValueError(
+                f"PatchTST input window ({window}) is shorter than "
+                f"patch_length ({self.patch_length}); set the estimator's "
+                "lookback_window >= patch_length"
+            )
+        dtype = resolve_dtype(self.compute_dtype)
+        channels = jnp.swapaxes(x.astype(dtype), 1, 2)  # (B, F, L)
+        starts = np.arange(0, window - self.patch_length + 1, self.stride)
+        idx = starts[:, None] + np.arange(self.patch_length)[None, :]
+        patches = channels[:, :, idx]  # (B, F, P, patch_len) static gather
+        n_patches = len(starts)
+        h = patches.reshape(batch * n_features, n_patches, self.patch_length)
+        h = nn.Dense(self.d_model, dtype=dtype)(h)
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(0.02),
+            (n_patches, self.d_model),
+        )
+        h = h + pos.astype(dtype)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        for _ in range(self.n_layers):
+            h = TransformerEncoderLayer(
+                d_model=self.d_model,
+                n_heads=self.n_heads,
+                ff_dim=self.ff_dim,
+                dropout=self.dropout,
+                compute_dtype=self.compute_dtype,
+            )(h, deterministic=deterministic)
+        h = nn.LayerNorm(dtype=dtype)(h)
+        flat = h.reshape(batch, n_features, n_patches * self.d_model)
+        out = nn.Dense(1, dtype=dtype)(flat)[..., 0]  # per-channel head (B, F)
+        if self.n_features_out != n_features:
+            out = nn.Dense(self.n_features_out, dtype=dtype)(out)
+        return activation(self.out_func)(out).astype(jnp.float32)
+
+
+@register_model_factory("patchtst")
+def patchtst(
+    n_features: int,
+    n_features_out: Optional[int] = None,
+    lookback_window: int = 32,
+    patch_length: int = 8,
+    stride: Optional[int] = None,
+    d_model: int = 64,
+    n_heads: int = 4,
+    n_layers: int = 2,
+    ff_dim: Optional[int] = None,
+    dropout: float = 0.0,
+    out_func: str = "linear",
+    optimizer: str = "Adam",
+    optimizer_kwargs: Optional[Dict[str, Any]] = None,
+    loss: str = "mse",
+    compute_dtype: str = "float32",
+    **unknown: Any,
+) -> ModelSpec:
+    _reject_unknown("patchtst", unknown)
+    if lookback_window < patch_length:
+        raise ValueError(
+            f"lookback_window ({lookback_window}) must be >= patch_length "
+            f"({patch_length})"
+        )
+    stride = stride or max(1, patch_length // 2)
+    ff_dim = ff_dim or 2 * d_model
+    n_features_out = n_features_out or n_features
+    module = PatchTSTModule(
+        n_features_out=n_features_out,
+        patch_length=patch_length,
+        stride=stride,
+        d_model=d_model,
+        n_heads=n_heads,
+        n_layers=n_layers,
+        ff_dim=ff_dim,
+        dropout=dropout,
+        out_func=out_func,
+        compute_dtype=compute_dtype,
+    )
+    config = {
+        "n_features": n_features,
+        "n_features_out": n_features_out,
+        "lookback_window": lookback_window,
+        "patch_length": patch_length,
+        "stride": stride,
+        "d_model": d_model,
+        "n_heads": n_heads,
+        "n_layers": n_layers,
+        "ff_dim": ff_dim,
+        "dropout": dropout,
+        "out_func": out_func,
+        "optimizer": optimizer,
+        "optimizer_kwargs": dict(optimizer_kwargs or {}),
+        "loss": loss,
+        "compute_dtype": compute_dtype,
+    }
+    return ModelSpec(
+        module=module,
+        optimizer=make_optimizer(optimizer, optimizer_kwargs),
+        loss=loss,
+        input_kind="window",
+        config=config,
+    )

@@ -290,6 +290,26 @@ class LSTMForecast(BaseFlaxEstimator):
         super().__init__(kind, **kwargs)
 
 
+class PatchTSTAutoEncoder(LSTMAutoEncoder):
+    """Window → window's own last row via the PatchTST transformer kind —
+    the rebuild's new model family (BASELINE.md config 5); same windowing
+    contract as :class:`LSTMAutoEncoder`."""
+
+    def __init__(self, kind: str = "patchtst", **kwargs: Any):
+        # the estimator's windowing must match the factory's default, or an
+        # unspecified lookback_window would window rows of length 1
+        kwargs.setdefault("lookback_window", 32)
+        super().__init__(kind, **kwargs)
+
+
+class PatchTSTForecast(LSTMForecast):
+    """Window → next row via the PatchTST transformer kind."""
+
+    def __init__(self, kind: str = "patchtst", **kwargs: Any):
+        kwargs.setdefault("lookback_window", 32)
+        super().__init__(kind, **kwargs)
+
+
 # Aliases so ported reference configs resolve (the serializer rewrites
 # `gordo_components.model.models.X` → this module).
 KerasAutoEncoder = DenseAutoEncoder

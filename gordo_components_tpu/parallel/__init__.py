@@ -13,6 +13,7 @@ machines.
 """
 
 from .mesh import fleet_mesh, fleet_sharding
+from .distributed import global_fleet_mesh, initialize_multihost
 from .fleet import (
     FleetSpec,
     MachineBatch,
@@ -25,6 +26,8 @@ from .build_fleet import build_fleet, FleetMachineConfig
 __all__ = [
     "fleet_mesh",
     "fleet_sharding",
+    "global_fleet_mesh",
+    "initialize_multihost",
     "FleetSpec",
     "MachineBatch",
     "FleetResult",

@@ -222,15 +222,20 @@ def build_fleet(
     mesh=None,
     seed: int = 0,
     n_splits: int = 3,
+    profile_dir: Optional[str] = None,
 ) -> Dict[str, str]:
     """Build every machine; returns ``{name: model_dir}``.
 
     Machines whose config hash is already registered are skipped (idempotent
     resume). Remaining machines are bucketed by (model config, data shape)
     and each bucket trains as one compiled program, sharded over ``mesh``.
+    ``profile_dir`` wraps the device work in a ``jax.profiler`` trace.
     """
     import os
 
+    from ..utils.profiling import PhaseTimer, device_trace
+
+    timer = PhaseTimer()
     started = time.perf_counter()
     results: Dict[str, str] = {}
     pending: List[Tuple[FleetMachineConfig, str]] = []
@@ -256,12 +261,22 @@ def build_fleet(
     buckets: Dict[str, List[dict]] = {}
     for machine, cache_key in pending:
         dataset = _dataset_from_config(machine.data_config)
+        item: dict = {
+            "machine": machine,
+            "cache_key": cache_key,
+            "dataset": dataset,
+        }
         if hasattr(dataset, "_columns_for"):
             n_features = len(dataset._columns_for(dataset.tag_list))
             n_targets = len(dataset._columns_for(dataset.target_tag_list))
-        else:  # non-TimeSeriesDataset: widths require a fetch
+        else:  # non-TimeSeriesDataset: widths require a fetch — keep the
+            # probe's data so the fetch phase doesn't read it twice
             X_probe, y_probe = dataset.get_data()
             n_features, n_targets = X_probe.shape[1], y_probe.shape[1]
+            item["X"] = np.asarray(getattr(X_probe, "values", X_probe), np.float32)
+            item["y"] = np.asarray(getattr(y_probe, "values", y_probe), np.float32)
+            item["dataset_metadata"] = dataset.get_metadata()
+        item["F"], item["T"] = n_features, n_targets
         sig = json.dumps(
             {
                 "model_config": machine.model_config,
@@ -271,15 +286,7 @@ def build_fleet(
             sort_keys=True,
             default=str,
         )
-        buckets.setdefault(sig, []).append(
-            {
-                "machine": machine,
-                "cache_key": cache_key,
-                "dataset": dataset,
-                "F": n_features,
-                "T": n_targets,
-            }
-        )
+        buckets.setdefault(sig, []).append(item)
 
     master_key = jax.random.PRNGKey(seed)
     for b, (sig, items) in enumerate(sorted(buckets.items())):
@@ -293,11 +300,18 @@ def build_fleet(
 
         # ---- host data fetch, this bucket only (the reference's per-pod
         # data-lake reads) --------------------------------------------------
-        for item in items:
-            X_frame, y_frame = item["dataset"].get_data()
-            item["X"] = np.asarray(getattr(X_frame, "values", X_frame), np.float32)
-            item["y"] = np.asarray(getattr(y_frame, "values", y_frame), np.float32)
-            item["dataset_metadata"] = item["dataset"].get_metadata()
+        with timer.phase("data_fetch"):
+            for item in items:
+                if "X" in item:  # width probe already fetched it
+                    continue
+                X_frame, y_frame = item["dataset"].get_data()
+                item["X"] = np.asarray(
+                    getattr(X_frame, "values", X_frame), np.float32
+                )
+                item["y"] = np.asarray(
+                    getattr(y_frame, "values", y_frame), np.float32
+                )
+                item["dataset_metadata"] = item["dataset"].get_metadata()
 
         n_rows = max(len(item["X"]) for item in items)
         n_real = len(items)
@@ -325,10 +339,11 @@ def build_fleet(
             n_rows,
             n_features,
         )
-        result = train_fleet_arrays(
-            spec, MachineBatch(X=X, y=y, w=w, keys=keys), mesh=mesh
-        )
-        result = jax.device_get(result)
+        with timer.phase("train"), device_trace(profile_dir):
+            result = train_fleet_arrays(
+                spec, MachineBatch(X=X, y=y, w=w, keys=keys), mesh=mesh
+            )
+            result = jax.device_get(result)
         bucket_duration = time.perf_counter() - bucket_started
 
         # ---- per-machine artifacts (same format as the single path) -------
@@ -374,9 +389,10 @@ def build_fleet(
             item.pop("y", None)
 
     logger.info(
-        "Fleet build: %d machines in %.1fs (%d cached)",
+        "Fleet build: %d machines in %.1fs (%d cached); phases: %s",
         len(machines),
         time.perf_counter() - started,
         len(machines) - len(pending),
+        timer.report(),
     )
     return results

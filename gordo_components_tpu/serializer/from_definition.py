@@ -70,6 +70,8 @@ _SHORT_NAMES: Dict[str, str] = {
         "DenseAutoEncoder",
         "LSTMAutoEncoder",
         "LSTMForecast",
+        "PatchTSTAutoEncoder",
+        "PatchTSTForecast",
         "KerasAutoEncoder",
         "KerasLSTMAutoEncoder",
         "KerasLSTMForecast",

@@ -1,0 +1,61 @@
+"""Phase timing + device profiling.
+
+The reference records only coarse durations in build metadata (SURVEY.md
+§6.1: no tracing/profiling integration). Rebuild implication implemented
+here: a ``PhaseTimer`` whose records drop straight into build metadata, and
+a ``device_trace`` context manager wrapping ``jax.profiler`` so any build
+or serving phase can emit a TensorBoard-loadable trace
+(``xprof``/perfetto) without code changes at the call sites.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Any, Dict, Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class PhaseTimer:
+    """Accumulates named phase durations; ``report()`` is JSON-able and is
+    merged into build metadata."""
+
+    def __init__(self) -> None:
+        self.durations: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        started = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - started
+            self.durations[name] = self.durations.get(name, 0.0) + elapsed
+            self.counts[name] = self.counts.get(name, 0) + 1
+            logger.debug("phase %s: %.3fs", name, elapsed)
+
+    def report(self) -> Dict[str, Any]:
+        return {
+            name: {"total_s": total, "count": self.counts[name]}
+            for name, total in sorted(self.durations.items())
+        }
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Wrap a block in a ``jax.profiler`` trace when ``log_dir`` is set
+    (no-op otherwise, so call sites never branch)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("Device trace written to %s", log_dir)
