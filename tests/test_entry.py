@@ -1,0 +1,54 @@
+"""Driver entry-point coverage (VERDICT r1 #1: ``__graft_entry__`` shipped
+untested and the multichip dryrun was red).
+
+``entry()`` must jit + execute single-device; ``dryrun_multichip`` must work
+both in-process (enough devices — the conftest provisions 8 virtual CPUs)
+and via its self-provisioning subprocess path (more devices requested than
+this process has)."""
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft_entry  # noqa: E402
+
+
+def test_entry_jits_and_executes():
+    fn, example_args = graft_entry.entry()
+    recon, err, total = jax.jit(fn)(*example_args)
+    jax.block_until_ready(total)
+    x = example_args[1]
+    assert recon.shape == x.shape
+    assert err.shape == x.shape
+    assert total.shape == (x.shape[0],)
+    assert bool(jnp.isfinite(total).all())
+
+
+def test_entry_scoring_semantics():
+    """Scoring must respond to scale/offset independently of the model:
+    zero scale+offset kills the score, doubling the scale doubles it."""
+    fn, (params, x, scale, offset) = graft_entry.entry()
+    _, _, total_zero = fn(params, x, jnp.zeros_like(scale), jnp.zeros_like(offset))
+    assert jnp.allclose(total_zero, 0.0, atol=1e-6)
+    _, err1, total1 = fn(params, x, scale, jnp.zeros_like(offset))
+    _, err2, total2 = fn(params, x, 2.0 * scale, jnp.zeros_like(offset))
+    assert jnp.allclose(err2, 2.0 * err1, atol=1e-5)
+    assert jnp.allclose(total2, 2.0 * total1, atol=1e-4)
+
+
+def test_dryrun_multichip_in_process():
+    assert jax.device_count() >= 8, "conftest must provision 8 virtual devices"
+    graft_entry.dryrun_multichip(8)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_subprocess_self_provisions():
+    """Request more devices than this process has → the subprocess path
+    (the exact path the single-TPU driver host exercises)."""
+    n = jax.device_count() * 2
+    graft_entry.dryrun_multichip(n)
