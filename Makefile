@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: test testfast bench images builder-image server-image watchman-image
+.PHONY: test testfast bench bench-serving images builder-image server-image watchman-image
 
 test:
 	python -m pytest tests/ -q
@@ -11,6 +11,9 @@ testfast:
 
 bench:
 	python bench.py
+
+bench-serving:
+	python bench_serving.py
 
 images: builder-image server-image watchman-image
 

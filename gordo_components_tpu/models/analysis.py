@@ -1,0 +1,60 @@
+"""Model-graph analysis: extract the fleet/serving-relevant skeleton from a
+materialized config graph.
+
+The reference's canonical anomaly config (SURVEY.md §3 anomaly row
+[UNVERIFIED]) nests ``DiffBasedAnomalyDetector(TransformedTargetRegressor(
+Pipeline([scaler, estimator])))``. Both the fleet trainer
+(:mod:`gordo_components_tpu.parallel.build_fleet`) and the stacked serving
+engine (:mod:`gordo_components_tpu.server.engine`) need the same
+decomposition — estimator core, input scaler, target scaler, detector — so
+it lives here, below both.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .anomaly.diff import DiffBasedAnomalyDetector
+from .models import BaseFlaxEstimator
+from .pipeline import Pipeline, TransformedTargetRegressor
+from .transformers import MinMaxScaler, StandardScaler
+
+
+@dataclass
+class Analyzed:
+    """The fleet-relevant skeleton of a materialized model config."""
+
+    estimator: BaseFlaxEstimator
+    input_scaler: Optional[Any]
+    target_scaler: Optional[Any]
+    detector: Optional[DiffBasedAnomalyDetector]
+
+
+def analyze_model(model: Any) -> Analyzed:
+    """Decompose a supported config graph; raises ``ValueError`` for shapes
+    the compiled paths can't lift (callers fall back to the host path)."""
+    detector = model if isinstance(model, DiffBasedAnomalyDetector) else None
+    core = detector.base_estimator if detector else model
+    target_scaler = None
+    if isinstance(core, TransformedTargetRegressor):
+        target_scaler = core.transformer
+        core = core.regressor
+    input_scaler = None
+    if isinstance(core, Pipeline):
+        steps = [step for _, step in core.steps]
+        if len(steps) == 2 and isinstance(steps[0], (MinMaxScaler, StandardScaler)):
+            input_scaler, core = steps[0], steps[1]
+        elif len(steps) == 1:
+            core = steps[0]
+        else:
+            raise ValueError(
+                "Compiled paths support Pipeline([scaler, estimator]) or "
+                f"Pipeline([estimator]); got {len(steps)} steps"
+            )
+    if not isinstance(core, BaseFlaxEstimator):
+        raise ValueError(
+            f"Compiled paths require a zoo estimator at the core; got "
+            f"{type(core).__name__}"
+        )
+    return Analyzed(core, input_scaler, target_scaler, detector)

@@ -35,9 +35,8 @@ import numpy as np
 
 from .. import __version__
 from ..builder.build_model import _dataset_from_config, calculate_model_key
-from ..models.anomaly.diff import DiffBasedAnomalyDetector
-from ..models.models import BaseFlaxEstimator
-from ..models.pipeline import Pipeline, TransformedTargetRegressor
+from ..models.analysis import Analyzed as _Analyzed
+from ..models.analysis import analyze_model as _analyze_model
 from ..models.transformers import MinMaxScaler, StandardScaler
 from ..ops.scaling import ScalerParams
 from ..serializer import dump, pipeline_from_definition
@@ -54,43 +53,6 @@ class FleetMachineConfig:
     model_config: Dict[str, Any]
     data_config: Dict[str, Any]
     metadata: Dict[str, Any] = field(default_factory=dict)
-
-
-@dataclass
-class _Analyzed:
-    """The fleet-relevant skeleton of a materialized model config."""
-
-    estimator: BaseFlaxEstimator
-    input_scaler: Optional[Any]
-    target_scaler: Optional[Any]
-    detector: Optional[DiffBasedAnomalyDetector]
-
-
-def _analyze_model(model: Any) -> _Analyzed:
-    detector = model if isinstance(model, DiffBasedAnomalyDetector) else None
-    core = detector.base_estimator if detector else model
-    target_scaler = None
-    if isinstance(core, TransformedTargetRegressor):
-        target_scaler = core.transformer
-        core = core.regressor
-    input_scaler = None
-    if isinstance(core, Pipeline):
-        steps = [step for _, step in core.steps]
-        if len(steps) == 2 and isinstance(steps[0], (MinMaxScaler, StandardScaler)):
-            input_scaler, core = steps[0], steps[1]
-        elif len(steps) == 1:
-            core = steps[0]
-        else:
-            raise ValueError(
-                "Fleet building supports Pipeline([scaler, estimator]) or "
-                f"Pipeline([estimator]); got {len(steps)} steps"
-            )
-    if not isinstance(core, BaseFlaxEstimator):
-        raise ValueError(
-            f"Fleet building requires a zoo estimator at the core; got "
-            f"{type(core).__name__}"
-        )
-    return _Analyzed(core, input_scaler, target_scaler, detector)
 
 
 def _scaler_kind(

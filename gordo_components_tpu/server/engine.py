@@ -1,0 +1,391 @@
+"""Stacked multi-model TPU serving engine.
+
+The reference serves ONE model per Flask pod and scores per request in
+numpy/keras host code (``gordo_components/server/views/anomaly.py``
+[UNVERIFIED]). This engine is the SURVEY.md §4.2 "TPU translation" of that
+path: every machine sharing an architecture is stacked into one
+device-resident pytree (params + input/target/error scaler affines), and
+scoring — scale → predict → inverse-scale → residual → error-scale → L2 —
+runs as ONE jitted program with machine-id dispatch. A server hosting 1000
+machines compiles O(architectures × row-buckets) XLA programs instead of
+O(machines), and request latency is a single device dispatch.
+
+Concurrent requests are opportunistically micro-batched: whichever handler
+thread reaches a bucket first becomes the leader, drains whatever queued
+while the device was busy, and scores up to ``max_batch`` requests in one
+vmapped dispatch. No artificial wait is added, so an idle server's p50 is
+the single-request dispatch time.
+
+Machines the engine can't lift (non-zoo cores, distinct target tags) are
+skipped; callers fall back to the host path (``model.anomaly``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.analysis import analyze_model
+from ..models.transformers import MinMaxScaler, StandardScaler
+from ..ops import windowing
+from ..ops.scaling import ScalerParams
+
+logger = logging.getLogger(__name__)
+
+
+def _round_up_pow2(n: int, minimum: int = 1) -> int:
+    bucket = minimum
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+class ScoreResult(NamedTuple):
+    """Tail-aligned scoring arrays — the anomaly payload's field names."""
+
+    model_input: np.ndarray  # (m, F) raw input rows the outputs align to
+    model_output: np.ndarray  # (m, T) predictions in raw units
+    tag_anomaly_scores: np.ndarray  # (m, T) error-scaled |residuals|
+    total_anomaly_score: np.ndarray  # (m,) L2 norm across tags
+
+
+def _identity(width: int) -> ScalerParams:
+    return ScalerParams(
+        scale=np.ones((width,), np.float32),
+        offset=np.zeros((width,), np.float32),
+    )
+
+
+def _affine(scaler: Optional[Any], width: int) -> ScalerParams:
+    """A FITTED affine scaler's (scale, offset); identity when the step is
+    absent. Non-affine or unfitted scalers raise so the machine falls back
+    to the host path (which applies/raises correctly) instead of the engine
+    silently serving wrong numbers."""
+    if scaler is None:
+        return _identity(width)
+    if not isinstance(scaler, (MinMaxScaler, StandardScaler)):
+        raise ValueError(
+            f"engine lifts affine scalers only; got {type(scaler).__name__}"
+        )
+    if scaler.params_ is None:
+        raise ValueError(f"{type(scaler).__name__} is not fitted")
+    return ScalerParams(
+        scale=np.asarray(scaler.params_.scale, np.float32),
+        offset=np.asarray(scaler.params_.offset, np.float32),
+    )
+
+
+@dataclass
+class _MachineEntry:
+    name: str
+    params: Any
+    sx: ScalerParams
+    sy: ScalerParams
+    es: ScalerParams
+    has_detector: bool
+
+
+class _Item:
+    __slots__ = ("idx", "x", "m_valid", "done", "result", "error")
+
+    def __init__(self, idx: int, x: np.ndarray, m_valid: int):
+        self.idx = idx
+        self.x = x
+        self.m_valid = m_valid
+        self.done = threading.Event()
+        self.result: Optional[ScoreResult] = None
+        self.error: Optional[BaseException] = None
+
+
+class _Bucket:
+    """One architecture's stacked machines + compiled score programs."""
+
+    def __init__(
+        self,
+        apply_fn,
+        lookback: int,
+        lookahead: Optional[int],
+        entries: List[_MachineEntry],
+        max_batch: int,
+    ):
+        self.apply_fn = apply_fn
+        self.lookback = lookback
+        self.lookahead = lookahead
+        self.max_batch = max_batch
+        self.names = [e.name for e in entries]
+        self.stacked = jax.device_put(
+            {
+                "params": jax.tree_util.tree_map(
+                    lambda *leaves: jnp.stack(leaves), *[e.params for e in entries]
+                ),
+                "sx": ScalerParams(
+                    scale=jnp.stack([e.sx.scale for e in entries]),
+                    offset=jnp.stack([e.sx.offset for e in entries]),
+                ),
+                "sy": ScalerParams(
+                    scale=jnp.stack([e.sy.scale for e in entries]),
+                    offset=jnp.stack([e.sy.offset for e in entries]),
+                ),
+                "es": ScalerParams(
+                    scale=jnp.stack([e.es.scale for e in entries]),
+                    offset=jnp.stack([e.es.offset for e in entries]),
+                ),
+            }
+        )
+        self._programs: Dict[Tuple[int, int], Any] = {}
+        self._cond = threading.Condition()
+        self._busy = False
+        self._pending: Dict[int, List[_Item]] = {}
+        # bounded dispatch stats (a long-lived server must not accumulate
+        # per-dispatch history — cf. _Latency's keep cap)
+        self.dispatch_count = 0
+        self.request_count = 0
+        self.max_batch_seen = 0
+
+    # -- compiled programs ---------------------------------------------------
+    def _program(self, rows: int, k: int):
+        key = (rows, k)
+        program = self._programs.get(key)
+        if program is not None:
+            return program
+        L, la, apply_fn = self.lookback, self.lookahead, self.apply_fn
+
+        def score_one(stacked, idx, x):
+            machine = jax.tree_util.tree_map(lambda a: a[idx], stacked)
+            xs = x * machine["sx"].scale + machine["sx"].offset
+            if la is None:
+                inputs = xs
+            else:
+                inputs = windowing.sliding_windows(xs, L, la)
+            pred = apply_fn(
+                {"params": machine["params"]}, inputs, deterministic=True
+            )
+            pred_raw = (pred - machine["sy"].offset) / machine["sy"].scale
+            x_tail = x[x.shape[0] - pred_raw.shape[0] :]
+            err = jnp.abs(x_tail - pred_raw)
+            scaled = err * machine["es"].scale + machine["es"].offset
+            total = jnp.linalg.norm(scaled, axis=-1)
+            return x_tail, pred_raw, scaled, total
+
+        program = jax.jit(jax.vmap(score_one, in_axes=(None, 0, 0)))
+        self._programs[key] = program
+        return program
+
+    # -- request path --------------------------------------------------------
+    def submit(self, idx: int, x: np.ndarray, m_valid: int) -> ScoreResult:
+        """Score one request; coalesces with concurrent requests of the same
+        padded row count. One thread at a time is the leader: it drains the
+        whole queue (including followers that piled up while the device was
+        busy) in micro-batched dispatches; followers sleep on the condition
+        until their item completes."""
+        item = _Item(idx, x, m_valid)
+        rows = x.shape[0]
+        is_leader = False
+        with self._cond:
+            self._pending.setdefault(rows, []).append(item)
+            while self._busy and not item.done.is_set():
+                self._cond.wait(timeout=1.0)  # predicate-looped; timeout is
+                # only a hang guard should a notify ever be missed
+            if not item.done.is_set():
+                self._busy = True
+                is_leader = True
+        if is_leader:
+            try:
+                while not item.done.is_set():
+                    with self._cond:
+                        pending, self._pending = self._pending, {}
+                    if not pending:
+                        break
+                    for batch_rows, items in pending.items():
+                        for start in range(0, len(items), self.max_batch):
+                            self._process(
+                                batch_rows, items[start : start + self.max_batch]
+                            )
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+        if item.error is not None:
+            raise item.error
+        assert item.result is not None
+        return item.result
+
+    def _process(self, rows: int, items: List[_Item]) -> None:
+        try:
+            k = len(items)
+            kb = _round_up_pow2(k)
+            idxs = np.asarray(
+                [it.idx for it in items] + [items[0].idx] * (kb - k), np.int32
+            )
+            xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
+            program = self._program(rows, kb)
+            x_tail, pred, scaled, total = jax.device_get(
+                program(self.stacked, idxs, xs)
+            )
+            self.dispatch_count += 1
+            self.request_count += k
+            self.max_batch_seen = max(self.max_batch_seen, k)
+            for i, it in enumerate(items):
+                m = it.m_valid
+                it.result = ScoreResult(
+                    model_input=x_tail[i][:m],
+                    model_output=pred[i][:m],
+                    tag_anomaly_scores=scaled[i][:m],
+                    total_anomaly_score=total[i][:m],
+                )
+        except BaseException as exc:  # surface on every waiting thread
+            for it in items:
+                it.error = exc
+        finally:
+            for it in items:
+                it.done.set()
+
+
+class ServingEngine:
+    """Build stacked buckets from loaded models; score by machine name.
+
+    ``models``: ``{machine_name: materialized model}`` (the objects a model
+    dir loads to). Unsupported models are skipped — check :meth:`can_score`.
+    """
+
+    def __init__(
+        self,
+        models: Dict[str, Any],
+        max_batch: int = 64,
+        min_rows_bucket: int = 64,
+    ):
+        self.max_batch = max_batch
+        self.min_rows_bucket = min_rows_bucket
+        self._by_name: Dict[str, Tuple[_Bucket, int]] = {}
+        self._buckets: List[_Bucket] = []
+
+        groups: Dict[str, List[Tuple[Any, _MachineEntry]]] = {}
+        for name, model in models.items():
+            try:
+                analyzed = analyze_model(model)
+                est = analyzed.estimator
+                if est.params_ is None:
+                    raise ValueError("estimator is not fitted")
+                n_features = int(est.n_features_)
+                n_targets = int(est.n_features_out_)
+                if n_targets != n_features:
+                    raise ValueError(
+                        "engine scores reconstruction configs (targets == "
+                        f"inputs); got F={n_features}, T={n_targets}"
+                    )
+                detector = analyzed.detector
+                if detector is None:
+                    es = _identity(n_targets)
+                elif getattr(detector.scaler, "params_", "unset") is None:
+                    if detector.require_thresholds:
+                        # host path refuses to score this state (HTTP 400);
+                        # the engine must not serve it either
+                        raise ValueError(
+                            "error scaler unfitted and require_thresholds set"
+                        )
+                    # diff.anomaly's documented fallback: raw |residuals|
+                    es = _identity(n_targets)
+                else:
+                    es = _affine(detector.scaler, n_targets)
+                entry = _MachineEntry(
+                    name=name,
+                    params=jax.device_get(est.params_),
+                    sx=_affine(analyzed.input_scaler, n_features),
+                    sy=_affine(analyzed.target_scaler, n_targets),
+                    es=es,
+                    has_detector=detector is not None,
+                )
+            except (ValueError, AttributeError, TypeError) as exc:
+                logger.info("Serving engine skips %r: %s", name, exc)
+                continue
+            sig = json.dumps(
+                {
+                    "config": est._spec.config,
+                    "loss": est._spec.loss,
+                    "F": n_features,
+                    "T": n_targets,
+                    "L": est.lookback_window,
+                    "la": est.lookahead,
+                },
+                sort_keys=True,
+                default=str,
+            )
+            groups.setdefault(sig, []).append((est, entry))
+
+        for sig, members in sorted(groups.items()):
+            est0 = members[0][0]
+            bucket = _Bucket(
+                apply_fn=est0._spec.module.apply,
+                lookback=est0.lookback_window,
+                lookahead=est0.lookahead,
+                entries=[entry for _, entry in members],
+                max_batch=max_batch,
+            )
+            self._buckets.append(bucket)
+            for i, (_, entry) in enumerate(members):
+                self._by_name[entry.name] = (bucket, i)
+        if self._by_name:
+            logger.info(
+                "Serving engine: %d machine(s) in %d bucket(s)",
+                len(self._by_name),
+                len(self._buckets),
+            )
+
+    # -- public API ----------------------------------------------------------
+    def can_score(self, name: str) -> bool:
+        return name in self._by_name
+
+    def machines(self) -> List[str]:
+        return sorted(self._by_name)
+
+    def _prepare(self, bucket: _Bucket, X: np.ndarray) -> Tuple[np.ndarray, int]:
+        X = np.asarray(getattr(X, "values", X), np.float32)
+        if X.ndim == 1:
+            X = X[None, :]
+        n = X.shape[0]
+        L, la = bucket.lookback, bucket.lookahead
+        if la is None:
+            m_valid = n
+        else:
+            m_valid = windowing.n_windows(n, L, la)
+            if m_valid <= 0:
+                raise ValueError(
+                    f"Need at least lookback_window+lookahead={L + la} rows, "
+                    f"got {n}"
+                )
+        rows = _round_up_pow2(n, self.min_rows_bucket)
+        if rows != n:
+            X = np.concatenate(
+                [X, np.zeros((rows - n, X.shape[1]), np.float32)]
+            )
+        return X, m_valid
+
+    def anomaly(self, name: str, X) -> ScoreResult:
+        """Full anomaly scoring on device; numerically matches
+        ``DiffBasedAnomalyDetector.anomaly`` (parity-tested)."""
+        bucket, idx = self._by_name[name]
+        x_padded, m_valid = self._prepare(bucket, X)
+        return bucket.submit(idx, x_padded, m_valid)
+
+    def predict(self, name: str, X) -> np.ndarray:
+        """Raw-unit predictions (the /prediction payload)."""
+        return self.anomaly(name, X).model_output
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "machines": len(self._by_name),
+            "buckets": len(self._buckets),
+            "compiled_programs": sum(len(b._programs) for b in self._buckets),
+            "dispatches": sum(b.dispatch_count for b in self._buckets),
+            "batched_requests": sum(b.request_count for b in self._buckets),
+            "max_dispatch_batch": max(
+                (b.max_batch_seen for b in self._buckets), default=0
+            ),
+        }

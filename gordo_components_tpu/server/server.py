@@ -37,6 +37,7 @@ from werkzeug.wrappers import Request, Response
 from ..models.anomaly.base import AnomalyDetectorBase
 from ..serializer import dumps as serializer_dumps
 from ..serializer import load, load_metadata
+from .engine import ScoreResult, ServingEngine
 
 logger = logging.getLogger(__name__)
 
@@ -132,6 +133,12 @@ class ModelServer:
             )
         self.project = project
         self.latency = _Latency()
+        # stacked TPU scoring: machines sharing an architecture serve from
+        # one device-resident pytree + one jitted program (engine.py);
+        # anything the engine can't lift falls back to model.anomaly
+        self.engine = ServingEngine(
+            {name: machine.model for name, machine in self.machines.items()}
+        )
         logger.info(
             "ModelServer serving %d model(s): %s",
             len(self.machines),
@@ -181,7 +188,12 @@ class ModelServer:
                 self._machine_for(args)  # machine-scoped health: 404 if absent
             return _json({"ok": True})
         if endpoint == "metrics":
-            return _json({"latency": self.latency.snapshot()})
+            return _json(
+                {
+                    "latency": self.latency.snapshot(),
+                    "engine": self.engine.stats(),
+                }
+            )
         if endpoint == "models":
             return _json({"project": self.project, "models": sorted(self.machines)})
         machine = self._machine_for(args)
@@ -235,7 +247,10 @@ class ModelServer:
     def _predict(self, request: Request, machine: _Machine) -> Response:
         X = self._parse_X(request, machine)
         try:
-            output = machine.model.predict(X)
+            if self.engine.can_score(machine.name):
+                output = self.engine.predict(machine.name, X)
+            else:
+                output = machine.model.predict(X)
         except ValueError as exc:
             _abort(400, f"Prediction failed: {exc}")
         return _json(
@@ -261,21 +276,25 @@ class ModelServer:
         if start or end:
             X_frame = self._fetch_range(machine, start, end)
             timestamps_all = [ts.isoformat() for ts in X_frame.index]
-            frame = model.anomaly(X_frame)
-            timestamps = timestamps_all[len(timestamps_all) - len(frame) :]
+            try:
+                scored = self._score(machine, X_frame)
+            except ValueError as exc:  # permanently-bad range (e.g. too few
+                # rows for the lookback window) must be 4xx, not a retryable 500
+                _abort(400, f"Anomaly scoring failed: {exc}")
+            timestamps = timestamps_all[
+                len(timestamps_all) - len(scored.total_anomaly_score) :
+            ]
         else:
             X = self._parse_X(request, machine)
             try:
-                frame = model.anomaly(X)
+                scored = self._score(machine, X)
             except ValueError as exc:
                 _abort(400, f"Anomaly scoring failed: {exc}")
         data = {
-            "model-input": frame["model-input"].values.tolist(),
-            "model-output": frame["model-output"].values.tolist(),
-            "tag-anomaly-scores": frame["tag-anomaly-scores"].values.tolist(),
-            "total-anomaly-score": np.ravel(
-                frame["total-anomaly-score"].values
-            ).tolist(),
+            "model-input": scored.model_input.tolist(),
+            "model-output": scored.model_output.tolist(),
+            "tag-anomaly-scores": scored.tag_anomaly_scores.tolist(),
+            "total-anomaly-score": scored.total_anomaly_score.tolist(),
         }
         if timestamps is not None:
             data["timestamps"] = timestamps
@@ -286,6 +305,19 @@ class ModelServer:
                 "total-threshold": model.total_threshold_,
             }
         return _json({"data": data, **thresholds})
+
+    def _score(self, machine: _Machine, X):
+        """Anomaly arrays via the stacked TPU engine when the machine is
+        lifted into it, else the host path (``model.anomaly``)."""
+        if self.engine.can_score(machine.name):
+            return self.engine.anomaly(machine.name, X)
+        frame = machine.model.anomaly(X)
+        return ScoreResult(
+            model_input=frame["model-input"].values,
+            model_output=frame["model-output"].values,
+            tag_anomaly_scores=frame["tag-anomaly-scores"].values,
+            total_anomaly_score=np.ravel(frame["total-anomaly-score"].values),
+        )
 
     def _fetch_range(self, machine: _Machine, start, end):
         """?start&end server-side fetch: rebuild the dataset from the config

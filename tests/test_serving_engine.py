@@ -1,0 +1,232 @@
+"""Stacked serving engine: numerical parity with the host anomaly path,
+O(buckets) compilation, machine-id dispatch, and request micro-batching
+(VERDICT r1 #2: the serving half of the north star)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu.models.anomaly.diff import DiffBasedAnomalyDetector
+from gordo_components_tpu.serializer import pipeline_from_definition
+from gordo_components_tpu.server.engine import ServingEngine
+
+
+def _anomaly_config(epochs=2, extra=None):
+    dense = {"kind": "feedforward_hourglass", "epochs": epochs, "batch_size": 32}
+    dense.update(extra or {})
+    return {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "TransformedTargetRegressor": {
+                    "regressor": {
+                        "Pipeline": {
+                            "steps": ["MinMaxScaler", {"DenseAutoEncoder": dense}]
+                        }
+                    },
+                    "transformer": "MinMaxScaler",
+                }
+            }
+        }
+    }
+
+
+def _lstm_config():
+    return {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "TransformedTargetRegressor": {
+                    "regressor": {
+                        "Pipeline": {
+                            "steps": [
+                                "MinMaxScaler",
+                                {
+                                    "LSTMAutoEncoder": {
+                                        "kind": "lstm_symmetric",
+                                        "lookback_window": 8,
+                                        "dims": [8],
+                                        "epochs": 1,
+                                        "batch_size": 16,
+                                    }
+                                },
+                            ]
+                        }
+                    },
+                    "transformer": "MinMaxScaler",
+                }
+            }
+        }
+    }
+
+
+def _fit(config, n_rows=160, n_tags=4, seed=0, cv=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_rows, n_tags)).astype(np.float32) * 3 + 5
+    model = pipeline_from_definition(config)
+    if cv and isinstance(model, DiffBasedAnomalyDetector):
+        model.cross_validate(X, n_splits=2)
+    model.fit(X)
+    return model, X
+
+
+@pytest.fixture(scope="module")
+def fitted_pair():
+    m1, X1 = _fit(_anomaly_config(), seed=1)
+    m2, X2 = _fit(_anomaly_config(), seed=2)
+    return {"m1": (m1, X1), "m2": (m2, X2)}
+
+
+def test_parity_with_host_anomaly_path(fitted_pair):
+    models = {name: m for name, (m, _) in fitted_pair.items()}
+    engine = ServingEngine(models)
+    for name, (model, X) in fitted_pair.items():
+        scored = engine.anomaly(name, X)
+        frame = model.anomaly(X)
+        np.testing.assert_allclose(
+            scored.model_output, frame["model-output"].values, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            scored.tag_anomaly_scores,
+            frame["tag-anomaly-scores"].values,
+            atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            scored.total_anomaly_score,
+            np.ravel(frame["total-anomaly-score"].values),
+            atol=1e-3,
+        )
+        np.testing.assert_allclose(scored.model_input, X, atol=1e-6)
+
+
+def test_same_architecture_shares_one_bucket_and_program(fitted_pair):
+    models = {name: m for name, (m, _) in fitted_pair.items()}
+    engine = ServingEngine(models)
+    stats = engine.stats()
+    assert stats["machines"] == 2
+    assert stats["buckets"] == 1
+    for name, (_, X) in fitted_pair.items():
+        engine.anomaly(name, X)
+    # same request shape through both machines → ONE compiled program
+    assert engine.stats()["compiled_programs"] == 1
+
+
+def test_different_architectures_get_separate_buckets(fitted_pair):
+    m1, _ = fitted_pair["m1"]
+    m3, _ = _fit(_anomaly_config(extra={"compression_factor": 0.25}), seed=3)
+    engine = ServingEngine({"m1": m1, "m3": m3})
+    assert engine.stats()["buckets"] == 2
+
+
+def test_machine_id_dispatch_differs(fitted_pair):
+    """Two machines in one bucket must score with their OWN weights."""
+    models = {name: m for name, (m, _) in fitted_pair.items()}
+    engine = ServingEngine(models)
+    _, X = fitted_pair["m1"]
+    out1 = engine.anomaly("m1", X).model_output
+    out2 = engine.anomaly("m2", X).model_output
+    assert not np.allclose(out1, out2)
+
+
+def test_windowed_model_parity():
+    model, X = _fit(_lstm_config(), n_rows=96, seed=4)
+    engine = ServingEngine({"lstm": model})
+    scored = engine.anomaly("lstm", X)
+    frame = model.anomaly(X)
+    assert len(scored.total_anomaly_score) == len(X) - 8 + 1
+    np.testing.assert_allclose(
+        scored.model_output, frame["model-output"].values, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        scored.total_anomaly_score,
+        np.ravel(frame["total-anomaly-score"].values),
+        atol=1e-3,
+    )
+
+
+def test_windowed_too_few_rows_raises_value_error():
+    model, _ = _fit(_lstm_config(), n_rows=96, seed=5)
+    engine = ServingEngine({"lstm": model})
+    with pytest.raises(ValueError, match="lookback_window"):
+        engine.anomaly("lstm", np.zeros((4, 4), np.float32))
+
+
+def test_unsupported_model_is_skipped():
+    class Opaque:
+        def predict(self, X):
+            return np.asarray(X)
+
+    engine = ServingEngine({"weird": Opaque()})
+    assert not engine.can_score("weird")
+    assert engine.stats()["machines"] == 0
+
+
+def test_unfitted_error_scaler_scores_raw_errors():
+    """No cross_validate → unfitted error scaler → raw |residuals| (the
+    DiffBasedAnomalyDetector fallback), not garbage."""
+    model, X = _fit(_anomaly_config(), seed=6, cv=False)
+    engine = ServingEngine({"m": model})
+    scored = engine.anomaly("m", X)
+    expected = np.abs(X - scored.model_output)
+    np.testing.assert_allclose(scored.tag_anomaly_scores, expected, atol=1e-5)
+
+
+def test_require_thresholds_unfitted_is_not_lifted():
+    """require_thresholds + no cross_validate must keep the host path's
+    refusal (HTTP 400), not engine-served raw errors."""
+    config = _anomaly_config()
+    config["DiffBasedAnomalyDetector"]["require_thresholds"] = True
+    model, X = _fit(config, seed=7, cv=False)
+    engine = ServingEngine({"m": model})
+    assert not engine.can_score("m")
+
+
+def test_non_affine_target_transformer_is_not_lifted():
+    """A FunctionTransformer target scaler can't be stacked as an affine —
+    the machine must fall back to the host path, not serve wrong numbers."""
+    config = _anomaly_config()
+    config["DiffBasedAnomalyDetector"]["base_estimator"][
+        "TransformedTargetRegressor"
+    ]["transformer"] = {
+        "FunctionTransformer": {
+            "func": "gordo_components_tpu.models.transformers.multiply",
+            "kw_args": {"factor": 2.0},
+        }
+    }
+    model, X = _fit(config, seed=8, cv=False)
+    engine = ServingEngine({"m": model})
+    assert not engine.can_score("m")
+
+
+def test_concurrent_requests_micro_batch(fitted_pair):
+    models = {name: m for name, (m, _) in fitted_pair.items()}
+    engine = ServingEngine(models)
+    _, X = fitted_pair["m1"]
+    # warm the program so worker threads pile up behind the busy lock
+    engine.anomaly("m1", X)
+    sequential = {
+        name: engine.anomaly(name, fitted_pair[name][1]).total_anomaly_score
+        for name in fitted_pair
+    }
+    results = {}
+    errors = []
+
+    def work(name, i):
+        try:
+            scored = engine.anomaly(name, fitted_pair[name][1])
+            results[(name, i)] = scored.total_anomaly_score
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=work, args=(name, i))
+        for i in range(8)
+        for name in fitted_pair
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    assert len(results) == 16
+    for (name, _), total in results.items():
+        np.testing.assert_allclose(total, sequential[name], atol=1e-4)
