@@ -1,21 +1,36 @@
-"""Benchmark: machines trained per hour (the north-star fleet metric).
+"""Benchmark: machines trained per hour (the north-star fleet metric),
+with per-config MFU and honest compile/steady-state separation.
 
-Measures two things on whatever device JAX provides (the real TPU chip
-under the driver; CPU elsewhere):
+Covers the BASELINE.md benchmark configs (the reference publishes no
+numbers — BASELINE.json ``published: {}`` — so the anchors are measured):
 
-1. **Baseline anchor** — one 10-tag dense-AE machine built the
-   single-machine way (BASELINE.md: the reference publishes no numbers, so
-   the measured single-machine rate is the comparison anchor; it
-   corresponds to the reference's one-model-per-pod throughput).
-2. **Fleet rate** — M machines trained in one compiled vmap-over-mesh
-   program (full build per machine: scaler fits, 3-fold masked CV,
-   error-scaler fit, final fit — identical work per machine to the
-   baseline path).
+- ``dense_ae_10tag`` (configs 1/4): the headline fleet — M dense-hourglass
+  machines, full build per machine (scaler fits, k-fold masked CV,
+  error-scaler fit, final fit) in ONE compiled vmap program.
+- ``lstm_ae_50tag`` (config 2): windowed LSTM reconstruction fleet.
+- ``lstm_forecast_100tag`` (config 3): LSTM one-step forecast fleet.
+- ``patchtst_bf16`` (config 5, scaled): PatchTST anomaly head with
+  bfloat16 compute. The "10k-tag plant" is represented as 256 tags/machine
+  by default so the driver-run bench stays inside its time budget; set
+  BENCH_FULL=1 for the plant-scale shapes.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Honesty rules (VERDICT r1):
+- compile time is measured separately via the AOT path
+  (``program.lower(...).compile()``) and NEVER mixed into rates;
+- ``vs_baseline`` = fleet steady-state rate / single-machine
+  compile-excluded rate measured the same way on the same device;
+- FLOPs come from XLA's own ``cost_analysis()`` of the exact compiled
+  fleet program (no hand model), and MFU is reported against the chip's
+  bf16 peak (TPU v5e: 197 TFLOP/s) — tiny per-machine models are
+  HBM-bound, so single-digit MFU is the expected truthful number;
+- the measured CPU anchor for BASELINE config 1 is recorded in BASELINE.md
+  (run ``BENCH_CPU=1 python bench.py`` to re-measure it).
 
-Env overrides: BENCH_MACHINES (default 128), BENCH_ROWS (864 = 6 days at
-10-min resolution), BENCH_TAGS (10), BENCH_EPOCHS (10).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus a
+``configs`` breakdown.
+
+Env overrides: BENCH_MACHINES (128), BENCH_EPOCHS (10), BENCH_FULL (0),
+BENCH_CPU (0), BENCH_CONFIGS (comma list to restrict).
 """
 
 from __future__ import annotations
@@ -23,9 +38,17 @@ from __future__ import annotations
 import json
 import os
 import time
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
+
+# chip peak dense-matmul throughput (bf16), for MFU accounting
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+}
 
 
 def _synthetic(machines: int, rows: int, tags: int, seed: int = 0) -> np.ndarray:
@@ -37,19 +60,8 @@ def _synthetic(machines: int, rows: int, tags: int, seed: int = 0) -> np.ndarray
     return (X + rng.uniform(-3, 3, size=(machines, 1, tags))).astype(np.float32)
 
 
-def main() -> None:
-    machines = int(os.environ.get("BENCH_MACHINES", "128"))
-    rows = int(os.environ.get("BENCH_ROWS", "864"))
-    tags = int(os.environ.get("BENCH_TAGS", "10"))
-    epochs = int(os.environ.get("BENCH_EPOCHS", "10"))
-    n_splits = 3
-    batch_size = 64
-
-    from gordo_components_tpu.parallel import MachineBatch, train_fleet_arrays
-    from gordo_components_tpu.parallel.build_fleet import _analyze_model, _spec_for
-    from gordo_components_tpu.serializer import pipeline_from_definition
-
-    model_config = {
+def _anomaly_config(estimator: str, kind: str, **kwargs) -> Dict[str, Any]:
+    return {
         "DiffBasedAnomalyDetector": {
             "base_estimator": {
                 "TransformedTargetRegressor": {
@@ -57,13 +69,7 @@ def main() -> None:
                         "Pipeline": {
                             "steps": [
                                 "MinMaxScaler",
-                                {
-                                    "DenseAutoEncoder": {
-                                        "kind": "feedforward_hourglass",
-                                        "epochs": epochs,
-                                        "batch_size": batch_size,
-                                    }
-                                },
+                                {estimator: {"kind": kind, **kwargs}},
                             ]
                         }
                     },
@@ -72,48 +78,197 @@ def main() -> None:
             }
         }
     }
-    probe = pipeline_from_definition(model_config)
-    spec = _spec_for(_analyze_model(probe), tags, tags, n_splits=n_splits)
 
-    def run(n_machines: int, seed: int) -> float:
+
+def _configs(full: bool, epochs: int, machines: int) -> Dict[str, Dict[str, Any]]:
+    return {
+        "dense_ae_10tag": {
+            "model": _anomaly_config(
+                "DenseAutoEncoder",
+                "feedforward_hourglass",
+                epochs=epochs,
+                batch_size=64,
+            ),
+            "machines": machines,
+            "rows": 864,  # 6 days at 10-min resolution
+            "tags": 10,
+            "n_splits": 3,
+            "headline": True,
+        },
+        "lstm_ae_50tag": {
+            "model": _anomaly_config(
+                "LSTMAutoEncoder",
+                "lstm_symmetric",
+                lookback_window=24,
+                dims=[32],
+                epochs=max(2, epochs // 3),
+                batch_size=64,
+            ),
+            "machines": 32 if not full else 128,
+            "rows": 432,
+            "tags": 50,
+            "n_splits": 2,
+        },
+        "lstm_forecast_100tag": {
+            "model": _anomaly_config(
+                "LSTMForecast",
+                "lstm_symmetric",
+                lookback_window=24,
+                dims=[32],
+                epochs=max(2, epochs // 3),
+                batch_size=64,
+            ),
+            "machines": 16 if not full else 64,
+            "rows": 432,
+            "tags": 100,
+            "n_splits": 2,
+        },
+        "patchtst_bf16": {
+            "model": _anomaly_config(
+                "PatchTSTAutoEncoder",
+                "patchtst",
+                lookback_window=32,
+                d_model=64,
+                n_layers=2,
+                epochs=max(2, epochs // 3),
+                batch_size=64,
+                compute_dtype="bfloat16",
+            ),
+            "machines": 4 if not full else 8,
+            "rows": 384,
+            "tags": 256 if not full else 1024,
+            "n_splits": 2,
+        },
+    }
+
+
+def _flops_of(compiled) -> Optional[float]:
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        return float(analysis["flops"])
+    except Exception:  # backend without cost analysis
+        return None
+
+
+def _bench_config(name: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
+    from gordo_components_tpu.parallel import MachineBatch
+    from gordo_components_tpu.parallel.build_fleet import _analyze_model, _spec_for
+    from gordo_components_tpu.parallel.fleet import fleet_program
+    from gordo_components_tpu.serializer import pipeline_from_definition
+
+    machines, rows, tags = cfg["machines"], cfg["rows"], cfg["tags"]
+    probe = pipeline_from_definition(cfg["model"])
+    spec = _spec_for(_analyze_model(probe), tags, tags, n_splits=cfg["n_splits"])
+
+    def batch_for(n_machines: int, seed: int) -> MachineBatch:
         X = _synthetic(n_machines, rows, tags, seed)
-        batch = MachineBatch(
+        return MachineBatch(
             X=X,
             y=X.copy(),
             w=np.ones((n_machines, rows), np.float32),
             keys=jax.random.split(jax.random.PRNGKey(seed), n_machines),
         )
+
+    def timed_run(compiled, batch) -> float:
         started = time.perf_counter()
-        result = train_fleet_arrays(spec, batch)
-        jax.block_until_ready(result.params)
+        result = compiled(batch.X, batch.y, batch.w, batch.keys)
+        jax.block_until_ready(result)
         elapsed = time.perf_counter() - started
         history = np.asarray(result.loss_history)
-        assert np.isfinite(history).all()
-        # fleet-mean loss must drop; individual machines may wobble (SGD)
+        assert np.isfinite(history).all(), f"{name}: non-finite losses"
         assert history[:, -1].mean() < history[:, 0].mean(), (
-            "training must reduce mean loss"
+            f"{name}: training must reduce mean loss"
         )
         return elapsed
 
-    # -- baseline anchor: single machine (includes its compile, as the
-    # reference's per-pod run includes TF graph setup) ----------------------
-    t_single = run(1, seed=1)
+    # ---- fleet program: AOT-compile (timed separately), then a warm run
+    # and a timed steady-state run --------------------------------------
+    fleet_batch = batch_for(machines, seed=2)
+    program = fleet_program(spec, rows, tags, tags)
+    started = time.perf_counter()
+    compiled = program.lower(
+        fleet_batch.X, fleet_batch.y, fleet_batch.w, fleet_batch.keys
+    ).compile()
+    compile_s = time.perf_counter() - started
+    flops = _flops_of(compiled)
+    timed_run(compiled, fleet_batch)  # warm-up (allocator, transfers)
+    t_fleet = timed_run(compiled, batch_for(machines, seed=3))
 
-    # -- fleet: warm-up run compiles the M-machine program, second run is
-    # the steady-state rate a long-lived fleet builder sustains -------------
-    run(machines, seed=2)
-    t_fleet = run(machines, seed=3)
+    # ---- single-machine anchor, compile-excluded (same jitted program —
+    # the 1-machine shape just compiles its own executable) -------------
+    single_batch = batch_for(1, seed=1)
+    single_compiled = program.lower(
+        single_batch.X, single_batch.y, single_batch.w, single_batch.keys
+    ).compile()
+    timed_run(single_compiled, single_batch)
+    t_single = timed_run(single_compiled, batch_for(1, seed=4))
 
     fleet_rate = machines * 3600.0 / t_fleet
     single_rate = 3600.0 / t_single
-    result = {
-        "metric": "machines_trained_per_hour",
-        "value": round(fleet_rate, 1),
-        "unit": f"machines/hour ({jax.devices()[0].platform}, {machines} "
-        f"machines x {rows}x{tags}, {epochs} epochs, {n_splits}-fold CV)",
-        "vs_baseline": round(fleet_rate / single_rate, 2),
+    device = jax.devices()[0]
+    peak = _PEAK_FLOPS.get(device.device_kind)
+    mfu = (
+        round(flops / t_fleet / peak, 5)
+        if (flops is not None and peak is not None)
+        else None
+    )
+    return {
+        "machines_per_hour": round(fleet_rate, 1),
+        "vs_single_machine": round(fleet_rate / single_rate, 2),
+        "shape": f"{machines}x{rows}x{tags}",
+        "n_splits": cfg["n_splits"],
+        "steady_state_s": round(t_fleet, 3),
+        "compile_s": round(compile_s, 1),
+        "single_machine_s": round(t_single, 4),
+        "program_tflops": round(flops / 1e12, 4) if flops is not None else None,
+        "mfu_vs_bf16_peak": mfu,
     }
-    print(json.dumps(result))
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CPU", "0") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    machines = int(os.environ.get("BENCH_MACHINES", "128"))
+    epochs = int(os.environ.get("BENCH_EPOCHS", "10"))
+    full = os.environ.get("BENCH_FULL", "0") == "1"
+    configs = _configs(full, epochs, machines)
+    only = os.environ.get("BENCH_CONFIGS")
+    if only:
+        keep = {k.strip() for k in only.split(",")}
+        unknown = keep - set(configs)
+        if unknown:
+            raise SystemExit(
+                f"BENCH_CONFIGS names unknown configs {sorted(unknown)}; "
+                f"available: {sorted(configs)}"
+            )
+        configs = {k: v for k, v in configs.items() if k in keep}
+
+    results: Dict[str, Any] = {}
+    for name, cfg in configs.items():
+        results[name] = _bench_config(name, cfg)
+
+    headline_name = next(
+        (k for k, v in configs.items() if v.get("headline")), next(iter(configs))
+    )
+    headline = results[headline_name]
+    device = jax.devices()[0]
+    out = {
+        "metric": "machines_trained_per_hour",
+        "value": headline["machines_per_hour"],
+        "unit": (
+            f"machines/hour ({device.platform}, {headline['shape']} "
+            f"{headline_name} fleet, {headline['n_splits']}-fold CV; "
+            "steady-state, compile excluded and reported separately)"
+        ),
+        # fleet rate over the SAME-device compile-excluded single-machine
+        # rate — the in-compiler fan-out speedup, not a cross-stack claim
+        "vs_baseline": headline["vs_single_machine"],
+        "device": device.device_kind,
+        "configs": results,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

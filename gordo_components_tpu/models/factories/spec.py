@@ -43,7 +43,19 @@ def make_optimizer(
     (``lr``, ``beta_1``, ``beta_2``, ``epsilon``, ``momentum``, ``rho``) are
     translated so ported configs run unchanged; Keras' ``decay``
     (learning-rate schedule, no optax equivalent here) is dropped with a
-    warning rather than crashing the build."""
+    warning rather than crashing the build.
+
+    Memoized by (name, kwargs): identical configs return the SAME optax
+    object, so ``FleetSpec`` equality/hash work by value and the fleet
+    program cache hits across ``build_fleet`` invocations (optax
+    transforms otherwise compare by closure identity)."""
+    key = (optimizer, tuple(sorted((optimizer_kwargs or {}).items())))
+    try:
+        cached = _OPTIMIZER_CACHE.get(key)
+        if cached is not None:
+            return cached
+    except TypeError:  # unhashable kwarg value — build uncached
+        key = None
     import inspect
     import logging
 
@@ -72,7 +84,13 @@ def make_optimizer(
         logging.getLogger(__name__).warning(
             "Optimizer %s ignores unsupported kwargs: %s", optimizer, sorted(dropped)
         )
-    return fn(**kwargs)
+    transform = fn(**kwargs)
+    if key is not None:
+        _OPTIMIZER_CACHE[key] = transform
+    return transform
+
+
+_OPTIMIZER_CACHE: Dict[Any, optax.GradientTransformation] = {}
 
 
 class ModelSpec(NamedTuple):

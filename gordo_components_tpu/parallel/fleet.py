@@ -333,6 +333,48 @@ def make_machine_program(
     return program
 
 
+_PROGRAM_CACHE: dict = {}
+_PROGRAM_CACHE_MAX = 128  # distinct (spec, shape, mesh) programs kept live
+
+
+def fleet_program(
+    spec: FleetSpec,
+    n_rows: int,
+    n_features: int,
+    n_targets: int,
+    mesh=None,
+):
+    """The jitted vmap-over-machines program for one bucket shape, cached so
+    repeated calls with the same spec/shape reuse the traced+compiled
+    executable (``jax.jit`` keys on function identity — without this cache
+    every ``train_fleet_arrays`` call would re-trace)."""
+    try:
+        key = (spec, n_rows, n_features, n_targets, mesh)
+        cached = _PROGRAM_CACHE.get(key)
+    except TypeError:  # unhashable spec member — fall back to fresh build
+        key = None
+        cached = None
+    if cached is not None:
+        return cached
+    program = jax.vmap(make_machine_program(spec, n_rows, n_features, n_targets))
+    if mesh is None:
+        jitted = jax.jit(program)
+    else:
+        shard = fleet_sharding(mesh)
+        jitted = jax.jit(
+            program,
+            in_shardings=(shard, shard, shard, shard),
+            out_shardings=shard,
+        )
+    if key is not None:
+        if len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:  # FIFO bound — a
+            # long-lived builder seeing many distinct configs must not pin
+            # every compiled executable forever
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+        _PROGRAM_CACHE[key] = jitted
+    return jitted
+
+
 def train_fleet_arrays(
     spec: FleetSpec,
     batch: MachineBatch,
@@ -347,21 +389,11 @@ def train_fleet_arrays(
     """
     n_machines, n_rows, n_features = batch.X.shape
     n_targets = batch.y.shape[2]
-    program = jax.vmap(
-        make_machine_program(spec, n_rows, n_features, n_targets)
-    )
-    if mesh is None:
-        return jax.jit(program)(batch.X, batch.y, batch.w, batch.keys)
-    if n_machines % mesh.size != 0:
+    if mesh is not None and n_machines % mesh.size != 0:
         raise ValueError(
             f"Machine count {n_machines} must divide evenly over mesh size "
             f"{mesh.size}; pad with zero-weight machines "
             "(build_fleet does this automatically)"
         )
-    shard = fleet_sharding(mesh)
-    jitted = jax.jit(
-        program,
-        in_shardings=(shard, shard, shard, shard),
-        out_shardings=shard,
-    )
+    jitted = fleet_program(spec, n_rows, n_features, n_targets, mesh=mesh)
     return jitted(batch.X, batch.y, batch.w, batch.keys)
