@@ -283,10 +283,10 @@ def build_fleet(
         w = np.zeros((n_padded, n_rows), np.float32)
         for i, item in enumerate(items):
             rows = len(item["X"])
-            # RIGHT-aligned: padding in front keeps short machines' real data
-            # inside the later CV test folds (fold masks run left→right in
-            # time order; leading padding only ever dilutes train folds,
-            # where zero weights make it exact)
+            # RIGHT-aligned by convention (rows end at the bucket's latest
+            # timestamp). CV correctness does not depend on placement: fold
+            # masks are computed on real-sample ranks
+            # (fleet.timeseries_fold_masks), invariant to where padding sits
             X[i, n_rows - rows :] = item["X"]
             y[i, n_rows - rows :] = item["y"]
             w[i, n_rows - rows :] = 1.0

@@ -15,7 +15,9 @@ Static-shape strategy (the "hard part" SURVEY.md §8 calls out):
   machines — masks make padding exact, not approximate;
 - CV folds are *weight masks* over the padded row axis, not array slices,
   so one compilation serves every machine regardless of its true row count
-  (fold boundaries follow sklearn TimeSeriesSplit on the padded index);
+  (fold boundaries follow sklearn TimeSeriesSplit on each machine's REAL
+  samples — :func:`timeseries_fold_masks` computes them traced from the
+  weight vector, so padding never shifts a boundary);
 - the per-fold fits reuse the single-machine jittable fit program
   (:func:`gordo_components_tpu.models.train.make_fit_fn`) unchanged — the
   fleet engine is a transform over the single path, not a fork of it.
@@ -149,6 +151,31 @@ def _masked_explained_variance(y, pred, w) -> jnp.ndarray:
     return jnp.where(w_total > 0, score, jnp.nan)
 
 
+def timeseries_fold_masks(wt: jnp.ndarray, n_splits: int):
+    """sklearn ``TimeSeriesSplit`` fold masks computed per machine on its
+    REAL samples (``wt > 0``), traced — one compilation serves machines of
+    any true length inside a padded bucket.
+
+    sklearn's rule for ``n`` samples and ``k`` splits: ``test_size = n //
+    (k+1)``; split ``i`` tests ranks ``[n-(k-i)*ts, n-(k-i-1)*ts)`` and
+    trains on every earlier rank (``sklearn.model_selection.TimeSeriesSplit``
+    semantics — parity pinned by tests/test_fleet_parity.py). Masks are in
+    rank space over real samples, so padding anywhere on the axis (leading
+    row alignment, trailing batch fill) never shifts fold boundaries."""
+    real = (wt > 0).astype(jnp.float32)
+    n_real = jnp.sum(real).astype(jnp.int32)
+    rank = jnp.cumsum(real) - real  # 0-based rank among real samples
+    test_size = n_real // (n_splits + 1)
+    masks = []
+    for i in range(n_splits):
+        test_start = n_real - (n_splits - i) * test_size
+        test_end = n_real - (n_splits - i - 1) * test_size
+        train_mask = real * (rank < test_start)
+        test_mask = real * (rank >= test_start) * (rank < test_end)
+        masks.append((train_mask, test_mask))
+    return masks
+
+
 def make_machine_program(
     spec: FleetSpec, n_rows: int, n_features: int, n_targets: int
 ) -> Callable:
@@ -185,10 +212,10 @@ def make_machine_program(
         :mod:`gordo_components_tpu.ops.windowing` — the off-by-one contract
         lives there, pinned by its golden tests, not re-derived here.
 
-        Row padding may sit ANYWHERE in the row axis (build_fleet right-
-        aligns short machines so CV test folds still cover their real data):
-        a window's weight is the MIN of its rows' weights times its target
-        row's weight, so any window touching padding is masked out exactly.
+        Row padding may sit ANYWHERE in the row axis (fold boundaries are
+        computed on real-sample ranks, so placement is free): a window's
+        weight is the MIN of its rows' weights times its target row's
+        weight, so any window touching padding is masked out exactly.
         """
         if la is None:
             inputs, targets, wt = Xs, ys, w
@@ -208,20 +235,6 @@ def make_machine_program(
             targets = jnp.pad(targets, ((0, pad), (0, 0)))
             wt = jnp.pad(wt, (0, pad))
         return inputs, targets, wt
-
-    # static CV fold masks over the padded sample axis (TimeSeriesSplit
-    # boundaries on the padded index; weights make them exact per machine)
-    fold_masks = []
-    for k in range(1, spec.n_splits + 1):
-        b0 = padded * k // (spec.n_splits + 1)
-        b1 = padded * (k + 1) // (spec.n_splits + 1)
-        arange = np.arange(padded)
-        fold_masks.append(
-            (
-                jnp.asarray((arange < b0).astype(np.float32)),
-                jnp.asarray(((arange >= b0) & (arange < b1)).astype(np.float32)),
-            )
-        )
 
     sample_shape = (1, n_features) if la is None else (1, L, n_features)
 
@@ -259,16 +272,17 @@ def make_machine_program(
         cv_scores = []
         fold_errors = []
         fold_test_masks = []
+        fold_masks = timeseries_fold_masks(wt, spec.n_splits)
         for k, (train_mask, test_mask) in enumerate(fold_masks):
             res = fit_fn(params0, inputs, targets, wt * train_mask, fold_keys[k])
             pred = predict_fn(res.params, inputs)
             pred_raw = (pred - sy.offset) / sy.scale
             err = jnp.abs(raw_targets - pred_raw)
-            # a fold whose TRAIN region holds none of this machine's real
-            # rows fit nothing — its residuals come from an untrained
-            # network and must not feed the error scaler or CV scores
-            trained = (jnp.sum(wt * train_mask) > 0).astype(jnp.float32)
-            wtest = wt * test_mask * trained
+            # rank-space folds guarantee a nonempty train region whenever a
+            # test region is nonempty; machines too short for any fold
+            # (n_real < n_splits+1) get empty test masks here and fall back
+            # to final-model residuals below
+            wtest = wt * test_mask
             mask = (wtest > 0)[:, None]
             emin = jnp.minimum(emin, jnp.min(jnp.where(mask, err, jnp.inf), axis=0))
             emax = jnp.maximum(emax, jnp.max(jnp.where(mask, err, -jnp.inf), axis=0))
