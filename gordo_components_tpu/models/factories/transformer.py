@@ -21,13 +21,79 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh
 
+from ...ops.attention import dense_attention, ring_attention
 from ..modules import activation, resolve_dtype
 from ..register import register_model_factory
 from .feedforward import _reject_unknown
 from .spec import ModelSpec, make_optimizer
+
+
+class MultiHeadSelfAttention(nn.Module):
+    """q/k/v/out projections around a swappable attention core.
+
+    ``attention_impl``:
+
+    - ``"dense"`` — :func:`ops.attention.dense_attention` (XLA flash-fuses
+      it on TPU for these patch counts);
+    - ``"ring"`` — :func:`ops.attention.ring_attention`: the sequence
+      (patch) axis shards over a 1-D mesh of all local devices and K/V
+      blocks rotate via ICI neighbor hops (SURVEY.md §6.7 long-context
+      path). Same parameters, exact same math — pinned by
+      tests/test_transformer.py.
+
+    Attention-weight dropout applies on the dense path (weights are
+    materialized there); the ring path cannot drop weights it never
+    materializes, so it trains with residual dropout only.
+    """
+
+    d_model: int
+    n_heads: int
+    compute_dtype: Any
+    attention_impl: str = "dense"
+    ring_axis: str = "seq"
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        if self.d_model % self.n_heads != 0:
+            raise ValueError(
+                f"d_model ({self.d_model}) must be divisible by n_heads "
+                f"({self.n_heads})"
+            )
+        dtype = resolve_dtype(self.compute_dtype)
+        head_dim = self.d_model // self.n_heads
+        q = nn.DenseGeneral((self.n_heads, head_dim), dtype=dtype, name="query")(x)
+        k = nn.DenseGeneral((self.n_heads, head_dim), dtype=dtype, name="key")(x)
+        v = nn.DenseGeneral((self.n_heads, head_dim), dtype=dtype, name="value")(x)
+        if self.attention_impl == "ring":
+            mesh = Mesh(np.asarray(jax.devices()), (self.ring_axis,))
+            out = ring_attention(q, k, v, mesh=mesh, axis_name=self.ring_axis)
+        elif self.attention_impl == "dense":
+            if self.dropout_rate > 0.0 and not deterministic:
+                # materialized-weights path so dropout can hit the weights
+                # (same math as ops.attention.dense_attention)
+                scale = head_dim**-0.5
+                logits = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+                weights = jax.nn.softmax(logits, axis=-1)
+                weights = nn.Dropout(self.dropout_rate)(
+                    weights, deterministic=False
+                )
+                out = jnp.einsum("...hqk,...khd->...qhd", weights, v)
+            else:
+                out = dense_attention(q, k, v)
+        else:
+            raise ValueError(
+                f"Unknown attention_impl {self.attention_impl!r}; "
+                "use 'dense' or 'ring'"
+            )
+        return nn.DenseGeneral(
+            self.d_model, axis=(-2, -1), dtype=dtype, name="out"
+        )(out)
 
 
 class TransformerEncoderLayer(nn.Module):
@@ -36,17 +102,19 @@ class TransformerEncoderLayer(nn.Module):
     ff_dim: int
     dropout: float
     compute_dtype: Any
+    attention_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
         dtype = resolve_dtype(self.compute_dtype)
         h = nn.LayerNorm(dtype=dtype)(x)
-        h = nn.MultiHeadDotProductAttention(
-            num_heads=self.n_heads,
-            qkv_features=self.d_model,
+        h = MultiHeadSelfAttention(
+            d_model=self.d_model,
+            n_heads=self.n_heads,
+            compute_dtype=self.compute_dtype,
+            attention_impl=self.attention_impl,
             dropout_rate=self.dropout,
-            dtype=dtype,
-        )(h, h, deterministic=deterministic)
+        )(h, deterministic=deterministic)
         x = x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
         h = nn.LayerNorm(dtype=dtype)(x)
         h = nn.Dense(self.ff_dim, dtype=dtype)(h)
@@ -68,6 +136,7 @@ class PatchTSTModule(nn.Module):
     dropout: float = 0.0
     out_func: str = "linear"
     compute_dtype: Any = "float32"
+    attention_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -100,6 +169,7 @@ class PatchTSTModule(nn.Module):
                 ff_dim=self.ff_dim,
                 dropout=self.dropout,
                 compute_dtype=self.compute_dtype,
+                attention_impl=self.attention_impl,
             )(h, deterministic=deterministic)
         h = nn.LayerNorm(dtype=dtype)(h)
         flat = h.reshape(batch, n_features, n_patches * self.d_model)
@@ -126,6 +196,7 @@ def patchtst(
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     loss: str = "mse",
     compute_dtype: str = "float32",
+    attention_impl: str = "dense",
     **unknown: Any,
 ) -> ModelSpec:
     _reject_unknown("patchtst", unknown)
@@ -137,6 +208,25 @@ def patchtst(
     stride = stride or max(1, patch_length // 2)
     ff_dim = ff_dim or 2 * d_model
     n_features_out = n_features_out or n_features
+    if attention_impl not in ("dense", "ring"):
+        raise ValueError(
+            f"Unknown attention_impl {attention_impl!r}; use 'dense' or 'ring'"
+        )
+    if d_model % n_heads != 0:
+        raise ValueError(
+            f"d_model ({d_model}) must be divisible by n_heads ({n_heads})"
+        )
+    if attention_impl == "ring":
+        n_patches = (lookback_window - patch_length) // stride + 1
+        n_devices = jax.device_count()
+        if n_patches % n_devices != 0:
+            raise ValueError(
+                f"attention_impl='ring' shards the patch axis over "
+                f"{n_devices} device(s), but {n_patches} patches do not "
+                f"divide evenly; pick lookback_window/patch_length/stride "
+                "so (lookback_window - patch_length)//stride + 1 is a "
+                "multiple of the device count"
+            )
     module = PatchTSTModule(
         n_features_out=n_features_out,
         patch_length=patch_length,
@@ -148,6 +238,7 @@ def patchtst(
         dropout=dropout,
         out_func=out_func,
         compute_dtype=compute_dtype,
+        attention_impl=attention_impl,
     )
     config = {
         "n_features": n_features,
@@ -165,6 +256,7 @@ def patchtst(
         "optimizer_kwargs": dict(optimizer_kwargs or {}),
         "loss": loss,
         "compute_dtype": compute_dtype,
+        "attention_impl": attention_impl,
     }
     return ModelSpec(
         module=module,
