@@ -9,6 +9,7 @@ equivalent), and a gated InfluxDB provider.
 """
 
 from .base import GordoBaseDataProvider
+from .ncs_iroc import DataLakeProvider, IrocReader, NcsReader
 from .providers import (
     RandomDataProvider,
     FileDataProvider,
@@ -23,5 +24,8 @@ __all__ = [
     "FileDataProvider",
     "InfluxDataProvider",
     "CompositeDataProvider",
+    "DataLakeProvider",
+    "IrocReader",
+    "NcsReader",
     "provider_from_dict",
 ]

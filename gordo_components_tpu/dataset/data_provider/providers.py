@@ -318,3 +318,9 @@ class CompositeDataProvider(GordoBaseDataProvider):
                 train_start_date, train_end_date, tag_list[i:j], dry_run=dry_run
             )
             i = j
+
+
+# Reference data-lake layout readers live in ncs_iroc.py; re-exported here so
+# config dicts resolve them by bare name ("type": "NcsReader") through
+# GordoBaseDataProvider.from_dict's default module.
+from .ncs_iroc import DataLakeProvider, IrocReader, NcsReader  # noqa: E402,F401

@@ -94,9 +94,8 @@ class Pipeline(GordoBase):
         }
 
     def get_state(self) -> Dict[str, Any]:
-        # keyed by position, not name: into_definition does not preserve
-        # custom step names, so positional keys are what survives a
-        # dump → load round-trip
+        # keyed by position, not name: state must load into any equivalent
+        # pipeline regardless of how its steps are named
         return {
             f"step_{i}": step.get_state() if hasattr(step, "get_state") else {}
             for i, (_, step) in enumerate(self.steps)
@@ -106,6 +105,96 @@ class Pipeline(GordoBase):
         for i, (_, step) in enumerate(self.steps):
             if hasattr(step, "set_state"):
                 step.set_state(state.get(f"step_{i}", {}))
+        return self
+
+
+class FeatureUnion(GordoBase):
+    """Concatenate transformer outputs along the feature axis
+    (``sklearn.pipeline.FeatureUnion`` surface — reference configs nest it
+    inside Pipelines [SURVEY.md §3 serializer row]). ``transformer_list``
+    accepts ``[(name, transformer), …]`` or bare transformers;
+    ``transformer_weights`` scales each block by name."""
+
+    def __init__(
+        self,
+        transformer_list: Sequence[Union[Tuple[str, Any], Any]],
+        transformer_weights: Optional[Dict[str, float]] = None,
+    ):
+        self.transformer_list = _name_steps(transformer_list)
+        self.transformer_weights = transformer_weights
+        if transformer_weights:
+            names = {name for name, _ in self.transformer_list}
+            unknown = set(transformer_weights) - names
+            if unknown:
+                # sklearn raises too — a weight that matches no transformer
+                # would otherwise be silently ignored
+                raise ValueError(
+                    f"transformer_weights keys {sorted(unknown)} match no "
+                    f"transformer; names are {sorted(names)}"
+                )
+
+    def _weight(self, name: str) -> float:
+        if not self.transformer_weights:
+            return 1.0
+        return float(self.transformer_weights.get(name, 1.0))
+
+    def _assemble(self, name: str, block: Any) -> np.ndarray:
+        block = np.asarray(block, dtype=np.float32)
+        if block.ndim == 1:
+            block = block[:, None]
+        return block * self._weight(name)
+
+    def fit(self, X, y=None, **_kwargs) -> "FeatureUnion":
+        for _, transformer in self.transformer_list:
+            transformer.fit(X, y)
+        return self
+
+    def transform(self, X) -> np.ndarray:
+        return np.concatenate(
+            [
+                self._assemble(name, transformer.transform(X))
+                for name, transformer in self.transformer_list
+            ],
+            axis=1,
+        )
+
+    def fit_transform(self, X, y=None) -> np.ndarray:
+        blocks = []
+        for name, transformer in self.transformer_list:
+            if hasattr(transformer, "fit_transform"):
+                block = transformer.fit_transform(X, y)
+            else:
+                block = transformer.fit(X, y).transform(X)
+            blocks.append(self._assemble(name, block))
+        return np.concatenate(blocks, axis=1)
+
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        return {
+            "transformer_list": list(self.transformer_list),
+            "transformer_weights": self.transformer_weights,
+        }
+
+    def get_metadata(self) -> Dict[str, Any]:
+        return {
+            "type": "FeatureUnion",
+            "transformers": [
+                {name: step.get_metadata() if hasattr(step, "get_metadata") else {}}
+                for name, step in self.transformer_list
+            ],
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            f"transformer_{i}": (
+                step.get_state() if hasattr(step, "get_state") else {}
+            )
+            for i, (_, step) in enumerate(self.transformer_list)
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> "FeatureUnion":
+        for i, (_, step) in enumerate(self.transformer_list):
+            if hasattr(step, "set_state"):
+                step.set_state(state.get(f"transformer_{i}", {}))
         return self
 
 
@@ -174,6 +263,11 @@ def clone_pipeline(obj):
     """Deep unfitted clone of a pipeline/estimator graph."""
     if isinstance(obj, Pipeline):
         return Pipeline([(name, clone_pipeline(step)) for name, step in obj.steps])
+    if isinstance(obj, FeatureUnion):
+        return FeatureUnion(
+            [(name, clone_pipeline(step)) for name, step in obj.transformer_list],
+            transformer_weights=obj.transformer_weights,
+        )
     if isinstance(obj, TransformedTargetRegressor):
         return TransformedTargetRegressor(
             regressor=clone_pipeline(obj.regressor),

@@ -25,6 +25,9 @@ from ..utils.config import resolve_dotted_path
 _ALIASES: Dict[str, str] = {
     # sklearn surface the reference's configs use
     "sklearn.pipeline.Pipeline": "gordo_components_tpu.models.pipeline.Pipeline",
+    "sklearn.pipeline.FeatureUnion": (
+        "gordo_components_tpu.models.pipeline.FeatureUnion"
+    ),
     "sklearn.compose.TransformedTargetRegressor": (
         "gordo_components_tpu.models.pipeline.TransformedTargetRegressor"
     ),
@@ -80,6 +83,7 @@ _SHORT_NAMES: Dict[str, str] = {
 _SHORT_NAMES.update(
     {
         "Pipeline": "gordo_components_tpu.models.pipeline.Pipeline",
+        "FeatureUnion": "gordo_components_tpu.models.pipeline.FeatureUnion",
         "TransformedTargetRegressor": (
             "gordo_components_tpu.models.pipeline.TransformedTargetRegressor"
         ),

@@ -45,10 +45,17 @@ def _definition_of(obj: Any) -> Dict[str, Any]:
     params = obj.get_params(deep=False) if _takes_deep(obj) else obj.get_params()
     kwargs: Dict[str, Any] = {}
     for key, value in params.items():
-        if key == "steps" and isinstance(value, list):
-            # Pipeline steps: [(name, est), …] → list of nested definitions
+        if key in ("steps", "transformer_list") and isinstance(value, list):
+            # Pipeline steps / FeatureUnion transformers: [(name, est), …]
+            # → [name, definition] pairs. Names must survive the round-trip:
+            # FeatureUnion.transformer_weights is keyed by them
+            # (from_definition rebuilds pairs via _name_steps)
             kwargs[key] = [
-                _definition_of(step if not isinstance(step, (tuple, list)) else step[1])
+                (
+                    [step[0], _definition_of(step[1])]
+                    if isinstance(step, (tuple, list))
+                    else _definition_of(step)
+                )
                 for step in value
             ]
         else:

@@ -1,0 +1,181 @@
+"""NCS / IROC data-lake layout readers + DataLakeProvider dispatch
+(VERDICT r1 #6: real gordo fleet configs must port — SURVEY.md §3
+ncs_reader/iroc_reader/azure_utils rows)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_components_tpu.dataset import GordoBaseDataset
+from gordo_components_tpu.dataset.data_provider import (
+    DataLakeProvider,
+    GordoBaseDataProvider,
+    IrocReader,
+    NcsReader,
+)
+from gordo_components_tpu.dataset.sensor_tag import SensorTag
+
+START, END = "2022-06-01T00:00:00+00:00", "2023-06-01T00:00:00+00:00"
+
+
+def _hourly(year_start, year_end):
+    return pd.date_range(year_start, year_end, freq="1h", tz="UTC")[:-1]
+
+
+@pytest.fixture(scope="module")
+def lake(tmp_path_factory):
+    """A fixture tree in BOTH reference layouts:
+
+    lake/
+      asset-ncs/tag-n1/tag-n1_2022.parquet     (NCS: yearly per-tag parquet)
+      asset-ncs/tag-n1/tag-n1_2023.parquet
+      asset-ncs/tag-n2/tag-n2_2023.csv         (NCS: CSV fallback, one year)
+      asset-iroc/export_1.csv                  (IROC: concatenated CSVs,
+      asset-iroc/export_2.csv                   reference-era column names)
+    """
+    root = tmp_path_factory.mktemp("lake")
+    # ---- NCS ----
+    for year in (2022, 2023):
+        idx = _hourly(f"{year}-01-01", f"{year + 1}-01-01")
+        tag_dir = root / "asset-ncs" / "tag-n1"
+        tag_dir.mkdir(parents=True, exist_ok=True)
+        pd.DataFrame(
+            {"timestamp": idx, "value": np.sin(np.arange(len(idx)) / 24) + year}
+        ).to_parquet(tag_dir / f"tag-n1_{year}.parquet", index=False)
+    idx = _hourly("2023-01-01", "2024-01-01")
+    tag_dir = root / "asset-ncs" / "tag-n2"
+    tag_dir.mkdir(parents=True)
+    pd.DataFrame({"timestamp": idx, "value": np.arange(len(idx), dtype=float)}).to_csv(
+        tag_dir / "tag-n2_2023.csv", index=False
+    )
+    # ---- IROC ----
+    iroc = root / "asset-iroc"
+    iroc.mkdir()
+    idx = _hourly("2022-06-01", "2023-06-01")
+    half = len(idx) // 2
+    for n, (sl, name) in enumerate(
+        [(slice(None, half), "export_1.csv"), (slice(half, None), "export_2.csv")]
+    ):
+        rows = []
+        for tag in ("tag-i1", "tag-i2"):
+            rows.append(
+                pd.DataFrame(
+                    {
+                        "item_name": tag,  # reference-era spelling → "tag"
+                        "t": idx[sl],  # → "timestamp"
+                        "average_value": np.cos(np.arange(len(idx))[sl] / 12)
+                        + (10 if tag == "tag-i2" else 0),  # → "value"
+                    }
+                )
+            )
+        pd.concat(rows).to_csv(iroc / name, index=False)
+    return root
+
+
+# --------------------------------------------------------------------- NCS
+def test_ncs_reads_yearly_parquet_across_year_boundary(lake):
+    reader = NcsReader(base_dir=str(lake))
+    tag = SensorTag("tag-n1", "asset-ncs")
+    assert reader.can_handle_tag(tag)
+    (series,) = list(
+        reader.load_series(pd.Timestamp(START), pd.Timestamp(END), [tag])
+    )
+    assert series.index.min() >= pd.Timestamp(START)
+    assert series.index.max() < pd.Timestamp(END)
+    # spans both yearly files: values near 2022 AND near 2023 present
+    assert (series < 2022.5).any() and (series > 2022.5).any()
+    assert series.index.is_monotonic_increasing
+
+
+def test_ncs_csv_fallback_and_partial_history(lake):
+    reader = NcsReader(base_dir=str(lake))
+    tag = SensorTag("tag-n2", "asset-ncs")
+    # requested range starts in 2022 but the tag only has a 2023 file —
+    # partial histories are normal, not an error
+    (series,) = list(
+        reader.load_series(pd.Timestamp(START), pd.Timestamp(END), [tag])
+    )
+    assert series.index.min().year == 2023
+
+
+def test_ncs_missing_tag_raises(lake):
+    reader = NcsReader(base_dir=str(lake))
+    tag = SensorTag("no-such-tag", "asset-ncs")
+    assert not reader.can_handle_tag(tag)
+    with pytest.raises(FileNotFoundError, match="no-such-tag"):
+        list(reader.load_series(pd.Timestamp(START), pd.Timestamp(END), [tag]))
+
+
+# -------------------------------------------------------------------- IROC
+def test_iroc_reads_concatenated_csvs_with_reference_columns(lake):
+    reader = IrocReader(base_dir=str(lake))
+    tags = [SensorTag("tag-i1", "asset-iroc"), SensorTag("tag-i2", "asset-iroc")]
+    series = list(
+        reader.load_series(pd.Timestamp(START), pd.Timestamp(END), tags)
+    )
+    assert [s.name for s in series] == ["tag-i1", "tag-i2"]
+    # both halves (both files) contribute
+    assert len(series[0]) == len(_hourly("2022-06-01", "2023-06-01"))
+    assert series[1].mean() > 5  # tag-i2's +10 offset survived column mapping
+
+
+def test_iroc_missing_rows_raise(lake):
+    reader = IrocReader(base_dir=str(lake))
+    with pytest.raises(ValueError, match="no rows"):
+        list(
+            reader.load_series(
+                pd.Timestamp(START),
+                pd.Timestamp(END),
+                [SensorTag("tag-zz", "asset-iroc")],
+            )
+        )
+
+
+# ---------------------------------------------------------- DataLakeProvider
+def test_data_lake_provider_dispatches_by_layout(lake):
+    provider = DataLakeProvider(base_dir=str(lake))
+    tags = [
+        SensorTag("tag-n1", "asset-ncs"),
+        SensorTag("tag-i1", "asset-iroc"),
+        SensorTag("tag-n2", "asset-ncs"),
+    ]
+    series = list(
+        provider.load_series(pd.Timestamp(START), pd.Timestamp(END), tags)
+    )
+    # order preserved across readers (the dataset joins positionally)
+    assert [s.name for s in series] == ["tag-n1", "tag-i1", "tag-n2"]
+
+
+def test_data_lake_provider_azure_auth_requires_base_dir():
+    with pytest.raises(ValueError, match="base_dir"):
+        DataLakeProvider(interactive=True, storename="lake-store")
+
+
+def test_data_lake_provider_round_trips_through_config(lake):
+    provider = DataLakeProvider(base_dir=str(lake))
+    rebuilt = GordoBaseDataProvider.from_dict(provider.to_dict())
+    assert isinstance(rebuilt, DataLakeProvider)
+    assert rebuilt.base_dir == str(lake)
+
+
+def test_fixture_tree_loads_through_timeseries_dataset(lake):
+    """The VERDICT's 'done' bar: a reference-layout tree feeds
+    TimeSeriesDataset end-to-end, mixing NCS and IROC tags in one machine."""
+    dataset = GordoBaseDataset.from_dict(
+        {
+            "type": "TimeSeriesDataset",
+            "data_provider": {"type": "DataLakeProvider", "base_dir": str(lake)},
+            "train_start_date": START,
+            "train_end_date": END,
+            "tag_list": [
+                {"name": "tag-n1", "asset": "asset-ncs"},
+                {"name": "tag-i1", "asset": "asset-iroc"},
+                {"name": "tag-i2", "asset": "asset-iroc"},
+            ],
+            "resolution": "6h",
+        }
+    )
+    X, y = dataset.get_data()
+    assert list(X.columns) == ["tag-n1", "tag-i1", "tag-i2"]
+    assert len(X) > 100
+    assert np.isfinite(np.asarray(X, dtype=np.float64)).all()
