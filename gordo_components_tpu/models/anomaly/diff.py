@@ -135,10 +135,15 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         pred = np.asarray(self.predict(X_vals))
         y_aligned = _tail_align(y_vals, len(pred))
         error = np.abs(y_aligned - pred)
-        try:
-            scaled = np.asarray(self.scaler.transform(error))
-        except ValueError:  # scaler unfitted and thresholds not required
+        if getattr(self.scaler, "params_", "unset") is None:
+            # OUR scaler, unfitted (require_thresholds=False): raw errors.
+            # Everything else — a fitted scaler, or an external scaler
+            # without the params_ attribute — goes through transform, and
+            # its errors (width mismatch, sklearn NotFittedError) propagate:
+            # swallowing them would silently change the scores' units
             scaled = error
+        else:
+            scaled = np.asarray(self.scaler.transform(error))
         total = np.linalg.norm(scaled, axis=1)
 
         in_tags = list(getattr(X, "columns", [])) or [

@@ -223,8 +223,14 @@ class TransformedTargetRegressor(GordoBase):
     def score(self, X, y=None) -> float:
         from .metrics import explained_variance_score
 
-        y_arr = np.asarray(getattr(X if y is None else y, "values", X if y is None else y))
-        return explained_variance_score(y_arr, self.predict(X))
+        y_input = X if y is None else y
+        y_arr = np.asarray(getattr(y_input, "values", y_input))
+        pred = self.predict(X)
+        # windowed regressors (LSTM/PatchTST) emit n−L+1−lookahead rows;
+        # score against tail-aligned targets, same contract as
+        # BaseFlaxEstimator.score
+        y_arr = y_arr[len(y_arr) - len(pred) :]
+        return explained_variance_score(y_arr, pred)
 
     def get_params(self, deep: bool = True) -> Dict[str, Any]:
         return {"regressor": self.regressor, "transformer": self.transformer}

@@ -187,16 +187,21 @@ class FunctionTransformer(GordoBase):
         self.kw_args = kw_args
         self.inv_kw_args = inv_kw_args
 
-    @staticmethod
-    def _resolve(func):
+    def _resolve(self, func):
         if func is None:
             return lambda X: X
         if isinstance(func, str):
             # alias-aware so reference paths like
-            # gordo_components.model.transformer_funcs.general.multiply work
+            # gordo_components.model.transformer_funcs.general.multiply work.
+            # _allow_external_funcs is cleared by the serializer's
+            # artifact-load path: a func string from an untrusted
+            # definition.json may only name this package's functions
             from ..serializer.from_definition import resolve_class_path
 
-            return resolve_class_path(func)
+            return resolve_class_path(
+                func,
+                allow_external=getattr(self, "_allow_external_funcs", True),
+            )
         return func
 
     def fit(self, X, y=None, **_kwargs):

@@ -6,7 +6,7 @@ The reference windows time-series host-side with Keras' TimeseriesGenerator
 a pure, jittable gather so XLA fuses it with the model's first matmul and the
 data never round-trips through host Python.
 
-THE OFF-BY-ONE CONTRACT (pinned by tests/test_windowing.py — SURVEY.md §4.5
+THE OFF-BY-ONE CONTRACT (pinned by tests/test_ops.py — SURVEY.md §4.5
 calls this "subtle and MUST be pinned"):
 
 Given ``x`` with ``n`` rows and ``lookback_window = L``:

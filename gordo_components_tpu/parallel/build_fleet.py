@@ -46,6 +46,10 @@ from .mesh import pad_to_multiple
 
 logger = logging.getLogger(__name__)
 
+# sliced builds round the padded row axis up to a multiple of this, so
+# heterogeneous-history slices collapse onto few compiled shapes
+_ROW_QUANTUM = 256
+
 
 @dataclass
 class FleetMachineConfig:
@@ -185,6 +189,7 @@ def build_fleet(
     seed: int = 0,
     n_splits: int = 3,
     profile_dir: Optional[str] = None,
+    slice_size: Optional[int] = 256,
 ) -> Dict[str, str]:
     """Build every machine; returns ``{name: model_dir}``.
 
@@ -192,6 +197,13 @@ def build_fleet(
     resume). Remaining machines are bucketed by (model config, data shape)
     and each bucket trains as one compiled program, sharded over ``mesh``.
     ``profile_dir`` wraps the device work in a ``jax.profiler`` trace.
+
+    Buckets larger than ``slice_size`` train in slices: every slice is padded
+    to the same machine count (so the compiled executable is reused across
+    slices) and its artifacts + registry keys are written the moment it
+    finishes — a killed build loses at most one in-flight slice, and the
+    resume pass skips everything already registered. ``slice_size=None``
+    trains each bucket in a single program call (round-1 behavior).
     """
     import os
 
@@ -260,95 +272,131 @@ def build_fleet(
         n_targets = items[0]["T"]
         spec = _spec_for(analyzed, n_features, n_targets, n_splits)
 
-        # ---- host data fetch, this bucket only (the reference's per-pod
-        # data-lake reads) --------------------------------------------------
-        with timer.phase("data_fetch"):
-            for item in items:
-                if "X" in item:  # width probe already fetched it
-                    continue
-                X_frame, y_frame = item["dataset"].get_data()
-                item["X"] = np.asarray(
-                    getattr(X_frame, "values", X_frame), np.float32
-                )
-                item["y"] = np.asarray(
-                    getattr(y_frame, "values", y_frame), np.float32
-                )
-                item["dataset_metadata"] = item["dataset"].get_metadata()
-
-        n_rows = max(len(item["X"]) for item in items)
+        # ---- slice the bucket: each slice is an independent failure domain
+        # with its own data fetch, train call, and artifact writes. All
+        # slices share one padded machine count so the compiled executable
+        # is reused (fleet_program caches on spec+shape) --------------------
         n_real = len(items)
-        n_padded = pad_to_multiple(n_real, mesh.size) if mesh is not None else n_real
-        X = np.zeros((n_padded, n_rows, n_features), np.float32)
-        y = np.zeros((n_padded, n_rows, n_targets), np.float32)
-        w = np.zeros((n_padded, n_rows), np.float32)
-        for i, item in enumerate(items):
-            rows = len(item["X"])
-            # RIGHT-aligned by convention (rows end at the bucket's latest
-            # timestamp). CV correctness does not depend on placement: fold
-            # masks are computed on real-sample ranks
-            # (fleet.timeseries_fold_masks), invariant to where padding sits
-            X[i, n_rows - rows :] = item["X"]
-            y[i, n_rows - rows :] = item["y"]
-            w[i, n_rows - rows :] = 1.0
-        keys = jax.random.split(jax.random.fold_in(master_key, b), n_padded)
-
+        eff = n_real if not slice_size else min(slice_size, n_real)
+        n_padded = pad_to_multiple(eff, mesh.size) if mesh is not None else eff
+        slices = [items[s : s + eff] for s in range(0, n_real, eff)]
         logger.info(
-            "Fleet bucket %d/%d: %d machines (padded %d), rows %d, F=%d",
+            "Fleet bucket %d/%d: %d machines in %d slice(s) of %d "
+            "(padded %d), F=%d",
             b + 1,
             len(buckets),
             n_real,
+            len(slices),
+            eff,
             n_padded,
-            n_rows,
             n_features,
         )
-        with timer.phase("train"), device_trace(profile_dir):
-            result = train_fleet_arrays(
-                spec, MachineBatch(X=X, y=y, w=w, keys=keys), mesh=mesh
-            )
-            result = jax.device_get(result)
-        bucket_duration = time.perf_counter() - bucket_started
+        for s, slice_items in enumerate(slices):
+            slice_started = time.perf_counter()
+            # ---- host data fetch, this slice only (the reference's per-pod
+            # data-lake reads); peak host memory is one slice's data --------
+            with timer.phase("data_fetch"):
+                for item in slice_items:
+                    if "X" in item:  # width probe already fetched it
+                        continue
+                    X_frame, y_frame = item["dataset"].get_data()
+                    item["X"] = np.asarray(
+                        getattr(X_frame, "values", X_frame), np.float32
+                    )
+                    item["y"] = np.asarray(
+                        getattr(y_frame, "values", y_frame), np.float32
+                    )
+                    item["dataset_metadata"] = item["dataset"].get_metadata()
 
-        # ---- per-machine artifacts (same format as the single path) -------
-        for i, item in enumerate(items):
-            machine = item["machine"]
-            model = pipeline_from_definition(machine.model_config)
-            _install_result(model, result, i, n_features, n_targets, n_splits)
-            model_dir = os.path.join(output_dir, machine.name)
-            # same metadata contract as the single-machine builder
-            # (consumers read these keys uniformly off the shared registry);
-            # per-machine durations are the bucket's amortized share
-            amortized = bucket_duration / max(n_real, 1)
-            metadata = {
-                "name": machine.name,
-                "gordo_components_tpu_version": __version__,
-                "model": {
-                    "model_config": machine.model_config,
-                    "model_builder_metadata": (
-                        model.get_metadata() if hasattr(model, "get_metadata") else {}
-                    ),
-                    "cross_validation": _cv_metadata(result, i, n_splits),
-                    "model_training_duration_s": amortized,
-                    "model_creation_date": time.strftime("%Y-%m-%d %H:%M:%S%z"),
-                    "cache_key": item["cache_key"],
-                    "fleet": {
-                        "bucket": b,
-                        "bucket_size": n_real,
-                        "bucket_duration_s": bucket_duration,
-                    },
-                },
-                "dataset": item["dataset_metadata"],
-                "build_duration_s": amortized,
-                "user_defined": dict(machine.metadata),
-            }
-            dump(model, model_dir, metadata=metadata)
-            if model_register_dir:
-                disk_registry.write_key(
-                    model_register_dir, item["cache_key"], model_dir
+            n_rows = max(len(item["X"]) for item in slice_items)
+            if len(slices) > 1:
+                # quantize the row axis so slices with slightly different
+                # history lengths share one (n_padded, n_rows, F) shape and
+                # the bucket reuses a single compiled executable; padded
+                # rows are zero-weight and masked everywhere (fold masks
+                # run on real-sample ranks)
+                n_rows = -(-n_rows // _ROW_QUANTUM) * _ROW_QUANTUM
+            X = np.zeros((n_padded, n_rows, n_features), np.float32)
+            y = np.zeros((n_padded, n_rows, n_targets), np.float32)
+            w = np.zeros((n_padded, n_rows), np.float32)
+            for i, item in enumerate(slice_items):
+                rows = len(item["X"])
+                # RIGHT-aligned by convention (rows end at the bucket's
+                # latest timestamp). CV correctness does not depend on
+                # placement: fold masks are computed on real-sample ranks
+                # (fleet.timeseries_fold_masks), invariant to where padding
+                # sits
+                X[i, n_rows - rows :] = item["X"]
+                y[i, n_rows - rows :] = item["y"]
+                w[i, n_rows - rows :] = 1.0
+            keys = jax.random.split(
+                jax.random.fold_in(jax.random.fold_in(master_key, b), s),
+                n_padded,
+            )
+
+            with timer.phase("train"), device_trace(profile_dir):
+                result = train_fleet_arrays(
+                    spec, MachineBatch(X=X, y=y, w=w, keys=keys), mesh=mesh
                 )
-            results[machine.name] = model_dir
-        for item in items:  # free this bucket's host data before the next
-            item.pop("X", None)
-            item.pop("y", None)
+                result = jax.device_get(result)
+            slice_duration = time.perf_counter() - slice_started
+
+            # ---- per-machine artifacts (same format as the single path),
+            # written before the next slice trains so a kill loses at most
+            # the in-flight slice ------------------------------------------
+            for i, item in enumerate(slice_items):
+                machine = item["machine"]
+                model = pipeline_from_definition(machine.model_config)
+                _install_result(
+                    model, result, i, n_features, n_targets, n_splits
+                )
+                model_dir = os.path.join(output_dir, machine.name)
+                # same metadata contract as the single-machine builder
+                # (consumers read these keys uniformly off the shared
+                # registry); per-machine durations are the slice's amortized
+                # share
+                amortized = slice_duration / max(len(slice_items), 1)
+                metadata = {
+                    "name": machine.name,
+                    "gordo_components_tpu_version": __version__,
+                    "model": {
+                        "model_config": machine.model_config,
+                        "model_builder_metadata": (
+                            model.get_metadata()
+                            if hasattr(model, "get_metadata")
+                            else {}
+                        ),
+                        "cross_validation": _cv_metadata(result, i, n_splits),
+                        "model_training_duration_s": amortized,
+                        "model_creation_date": time.strftime(
+                            "%Y-%m-%d %H:%M:%S%z"
+                        ),
+                        "cache_key": item["cache_key"],
+                        "fleet": {
+                            "bucket": b,
+                            "bucket_size": n_real,
+                            "slice": s,
+                            "slice_size": len(slice_items),
+                            "slice_duration_s": slice_duration,
+                        },
+                    },
+                    "dataset": item["dataset_metadata"],
+                    "build_duration_s": amortized,
+                    "user_defined": dict(machine.metadata),
+                }
+                dump(model, model_dir, metadata=metadata)
+                if model_register_dir:
+                    disk_registry.write_key(
+                        model_register_dir, item["cache_key"], model_dir
+                    )
+                results[machine.name] = model_dir
+            for item in slice_items:  # free before the next slice fetches
+                item.pop("X", None)
+                item.pop("y", None)
+        bucket_duration = time.perf_counter() - bucket_started
+        logger.info(
+            "Fleet bucket %d/%d done in %.1fs", b + 1, len(buckets), bucket_duration
+        )
 
     logger.info(
         "Fleet build: %d machines in %.1fs (%d cached); phases: %s",

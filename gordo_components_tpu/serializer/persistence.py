@@ -88,10 +88,16 @@ def dump(obj: Any, dest_dir: str, metadata: Optional[Dict[str, Any]] = None) -> 
 
 
 def load(source_dir: str) -> Any:
-    """Rebuild the fitted pipeline persisted by :func:`dump`."""
+    """Rebuild the fitted pipeline persisted by :func:`dump`.
+
+    The artifact's definition is treated as *data*, not config:
+    ``allow_external=False`` restricts class/function resolution to this
+    package, so a tampered ``definition.json`` (e.g. fetched from a spoofed
+    server via ``/download-model``) cannot instantiate arbitrary importables.
+    """
     with open(os.path.join(source_dir, DEFINITION_FILE)) as fh:
         definition = json.load(fh)
-    obj = pipeline_from_definition(definition)
+    obj = pipeline_from_definition(definition, allow_external=False)
     with np.load(os.path.join(source_dir, STATE_FILE)) as npz:
         arrays = {key: npz[key] for key in npz.files}
     scalars: Dict[str, Any] = {}
@@ -133,5 +139,28 @@ def loads(blob: bytes) -> Any:
 
     with tempfile.TemporaryDirectory() as tmp:
         with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
-            tar.extractall(tmp, filter="data")
+            try:
+                tar.extractall(tmp, filter="data")
+            except TypeError:
+                # Python < 3.10.12/3.11.4 lacks extractall(filter=); apply
+                # the same path-traversal guard manually rather than
+                # extracting unfiltered
+                _safe_extract(tar, tmp)
         return load(tmp)
+
+
+def _safe_extract(tar: tarfile.TarFile, dest: str) -> None:
+    """Manual equivalent of ``filter="data"``: plain files/dirs only, no
+    absolute paths, no ``..`` escapes, no links."""
+    dest_real = os.path.realpath(dest)
+    for member in tar.getmembers():
+        if not (member.isfile() or member.isdir()):
+            raise ValueError(
+                f"Refusing to extract non-regular member {member.name!r}"
+            )
+        target = os.path.realpath(os.path.join(dest, member.name))
+        if not (target == dest_real or target.startswith(dest_real + os.sep)):
+            raise ValueError(
+                f"Refusing to extract {member.name!r} outside target dir"
+            )
+    tar.extractall(dest)

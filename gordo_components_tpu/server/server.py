@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 from typing import Any, Dict, List, Optional, Union
 
@@ -66,26 +67,38 @@ _URL_MAP = Map(
 
 
 class _Latency:
-    """Rolling per-endpoint latency stats for GET /metrics."""
+    """Rolling per-endpoint latency stats for GET /metrics.
+
+    ``record`` runs on every handler thread of the threaded WSGI server, so
+    the sample lists are mutated under a lock; ``snapshot`` copies under the
+    same lock and computes percentiles outside it.
+    """
 
     def __init__(self, keep: int = 1000):
         self.keep = keep
         self.samples: Dict[str, List[float]] = {}
         self.counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
 
     def record(self, endpoint: str, seconds: float) -> None:
-        samples = self.samples.setdefault(endpoint, [])
-        samples.append(seconds)
-        if len(samples) > self.keep:
-            del samples[: -self.keep]
-        self.counts[endpoint] = self.counts.get(endpoint, 0) + 1
+        with self._lock:
+            samples = self.samples.setdefault(endpoint, [])
+            samples.append(seconds)
+            if len(samples) > self.keep:
+                del samples[: -self.keep]
+            self.counts[endpoint] = self.counts.get(endpoint, 0) + 1
 
     def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            copied = {
+                endpoint: (list(samples), self.counts[endpoint])
+                for endpoint, samples in self.samples.items()
+            }
         out = {}
-        for endpoint, samples in self.samples.items():
+        for endpoint, (samples, count) in copied.items():
             arr = np.asarray(samples)
             out[endpoint] = {
-                "count": self.counts[endpoint],
+                "count": count,
                 "p50_ms": float(np.percentile(arr, 50) * 1000),
                 "p99_ms": float(np.percentile(arr, 99) * 1000),
                 "mean_ms": float(arr.mean() * 1000),
@@ -373,9 +386,19 @@ def run_server(
     port: int = 5555,
     project: str = "project",
 ) -> None:
-    """Serve with werkzeug's multithreaded dev server (reference used
-    gunicorn, absent from this image; threads suffice because inference is
-    released-GIL jax compute)."""
+    """Serve with werkzeug's multithreaded server.
+
+    Production story: the reference fronted each per-model Flask app with
+    gunicorn workers (SURVEY.md §4.2). Here the app is a plain WSGI callable
+    (``build_app``), so any WSGI server works — ``gunicorn -w 1 --threads N
+    "module:build_app(...)"`` is the intended deployment shape. One *process*
+    per TPU: the serving engine owns device-resident stacked params, and
+    forking workers would duplicate HBM and re-compile per worker; scale with
+    threads (jax releases the GIL during device compute) and replicas behind
+    the ingress, not preforked workers. The built-in werkzeug server below is
+    threaded and suffices for the single-host case; it is not hardened for
+    untrusted public traffic.
+    """
     from werkzeug.serving import run_simple
 
     app = build_app(model_dirs, project=project)

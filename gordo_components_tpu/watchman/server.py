@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import logging
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Union
 
 from werkzeug.wrappers import Request, Response
@@ -30,9 +31,13 @@ class WatchmanServer:
         machines: Union[Sequence[str], Dict[str, str]],
         target_url: Optional[str] = None,
         timeout: float = 5.0,
+        max_poll_workers: int = 32,
     ):
         """``machines``: list of names served at ``target_url``, or an
-        explicit ``{machine: base_url}`` map."""
+        explicit ``{machine: base_url}`` map. Health polls fan out over a
+        thread pool of ``max_poll_workers`` so a 1000-machine fleet with a
+        few dead endpoints answers ``GET /`` in ~``timeout`` seconds, not
+        ``n_dead * timeout``."""
         if isinstance(machines, dict):
             self.machine_urls = dict(machines)
         else:
@@ -43,6 +48,7 @@ class WatchmanServer:
             self.machine_urls = {name: target_url for name in machines}
         self.project = project
         self.timeout = timeout
+        self.max_poll_workers = max(1, int(max_poll_workers))
 
     def _check(self, machine: str, base_url: str) -> Dict:
         import requests
@@ -65,10 +71,12 @@ class WatchmanServer:
         }
 
     def status(self) -> Dict:
-        endpoints: List[Dict] = [
-            self._check(machine, url)
-            for machine, url in sorted(self.machine_urls.items())
-        ]
+        targets = sorted(self.machine_urls.items())
+        workers = min(self.max_poll_workers, max(1, len(targets)))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            endpoints: List[Dict] = list(
+                pool.map(lambda mu: self._check(*mu), targets)
+            )
         return {
             "project-name": self.project,
             "ok": all(e["healthy"] for e in endpoints),

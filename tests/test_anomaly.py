@@ -146,3 +146,29 @@ def test_dump_load_round_trip(fitted, frame, tmp_path):
     np.testing.assert_allclose(got.values, expected.values, rtol=1e-4)
     assert loaded.total_threshold_ == pytest.approx(fitted.total_threshold_)
     assert loaded.cross_validation_["n_splits"] == 3
+
+
+def test_fitted_scaler_width_mismatch_propagates(fitted, frame):
+    """A FITTED error scaler's transform failures must propagate (ADVICE r1:
+    swallowing them silently returned unscaled scores in different units)."""
+    import copy
+
+    det = copy.copy(fitted)
+    X = frame.iloc[:32]
+    y_wrong = frame.iloc[:32, :2]  # 2 targets vs the 4 the scaler was fit on
+    with pytest.raises(ValueError):
+        det.anomaly(X, y_wrong)
+
+
+def test_unfitted_scaler_falls_back_to_raw_errors(frame):
+    det = DiffBasedAnomalyDetector(
+        base_estimator=Pipeline(
+            [MinMaxScaler(),
+             DenseAutoEncoder(kind="feedforward_hourglass", epochs=2,
+                              batch_size=32)]
+        ),
+        require_thresholds=False,
+    )
+    det.fit(frame)  # no cross_validate -> error scaler never fit
+    out = det.anomaly(frame.iloc[:32])
+    assert np.isfinite(np.ravel(out["total-anomaly-score"].values)).all()

@@ -389,3 +389,61 @@ def test_fleet_heterogeneous_buckets(tmp_path):
     wide = load(dirs["wide"])
     assert narrow.predict(np.zeros((4, 2), np.float32)).shape == (4, 2)
     assert wide.predict(np.zeros((4, 4), np.float32)).shape == (4, 4)
+
+
+def test_fleet_slice_checkpoint_resume(tmp_path, monkeypatch):
+    """A build killed mid-bucket loses only the in-flight slice: completed
+    slices' artifacts + registry keys are already on disk, and the resume
+    pass retrains only the remainder (SURVEY.md §6.4 sub-bucket resume)."""
+    import importlib
+
+    bf = importlib.import_module("gordo_components_tpu.parallel.build_fleet")
+
+    mesh = fleet_mesh()
+    machines = [
+        FleetMachineConfig(
+            name=f"sl-{i}",
+            model_config=MODEL_CONFIG,
+            data_config=_data_config([f"s{i}-a", f"s{i}-b", f"s{i}-c"]),
+        )
+        for i in range(6)
+    ]
+    out = str(tmp_path / "fleet")
+    registry = str(tmp_path / "registry")
+
+    real_train = bf.train_fleet_arrays
+    calls = {"n": 0}
+
+    def dying_train(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 2:  # slice 0 completes, slice 1 dies mid-train
+            raise RuntimeError("simulated kill mid-build")
+        return real_train(*args, **kwargs)
+
+    monkeypatch.setattr(bf, "train_fleet_arrays", dying_train)
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        build_fleet(machines, out, model_register_dir=registry, mesh=mesh,
+                    n_splits=2, slice_size=2)
+
+    # slice 0 (first two machines) survived the kill: artifacts + registry
+    for name in ("sl-0", "sl-1"):
+        model_dir = os.path.join(out, name)
+        assert os.path.isdir(model_dir)
+        assert isinstance(load(model_dir), DiffBasedAnomalyDetector)
+    assert not os.path.isdir(os.path.join(out, "sl-2"))
+
+    # resume: only the 2 remaining slices train; slice 0 is a cache hit
+    resumed_calls = {"n": 0}
+
+    def counting_train(*args, **kwargs):
+        resumed_calls["n"] += 1
+        return real_train(*args, **kwargs)
+
+    monkeypatch.setattr(bf, "train_fleet_arrays", counting_train)
+    dirs = build_fleet(machines, out, model_register_dir=registry, mesh=mesh,
+                       n_splits=2, slice_size=2)
+    assert set(dirs) == {f"sl-{i}" for i in range(6)}
+    assert resumed_calls["n"] == 2
+    for name, model_dir in dirs.items():
+        meta = load_metadata(model_dir)
+        assert meta["model"]["fleet"]["slice_size"] == 2

@@ -283,3 +283,28 @@ def test_metadata_contract(X):
     import json
 
     json.dumps(meta)  # must be JSON-serializable for build metadata
+
+
+def test_ttr_score_tail_aligns_windowed_regressor(X):
+    """TransformedTargetRegressor.score with a windowed (LSTM) regressor:
+    predict returns n−L+1 rows while y has n — score must tail-align
+    instead of raising a broadcast error (ADVICE r1)."""
+    from gordo_components_tpu.models.pipeline import (
+        Pipeline,
+        TransformedTargetRegressor,
+    )
+    from gordo_components_tpu.models.transformers import MinMaxScaler
+
+    ttr = TransformedTargetRegressor(
+        regressor=Pipeline(
+            [
+                MinMaxScaler(),
+                LSTMAutoEncoder(kind="lstm_hourglass", lookback_window=6,
+                                epochs=2, batch_size=16),
+            ]
+        ),
+        transformer=MinMaxScaler(),
+    )
+    ttr.fit(X)
+    score = ttr.score(X)
+    assert np.isfinite(score)

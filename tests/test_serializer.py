@@ -326,3 +326,122 @@ def test_feature_union_unknown_weight_key_rejected():
         FeatureUnion(
             [("a", MinMaxScaler())], transformer_weights={"scaler": 2.0}
         )
+
+
+# -- artifact-load trust gate (load path treats definitions as data) ---------
+
+
+def test_load_path_refuses_external_dotted_class(tmp_path):
+    """A tampered definition.json naming an arbitrary importable must not
+    instantiate it (ADVICE r1: artifact load is not a code-loading API)."""
+    import json as _json
+    import os as _os
+
+    pipe = Pipeline(steps=[MinMaxScaler()])
+    X = np.random.default_rng(0).normal(size=(16, 3)).astype(np.float32)
+    pipe.fit(X)
+    model_dir = str(tmp_path / "model")
+    dump(pipe, model_dir)
+    definition_path = _os.path.join(model_dir, "definition.json")
+    with open(definition_path) as fh:
+        definition = _json.load(fh)
+    definition = {"subprocess.Popen": {"args": ["true"]}}
+    with open(definition_path, "w") as fh:
+        _json.dump(definition, fh)
+    with pytest.raises(ValueError, match="external dotted path"):
+        load(model_dir)
+
+
+def test_load_path_refuses_external_function_transformer_func(tmp_path):
+    """FunctionTransformer.func resolves lazily — the trust gate must still
+    apply at transform() time for artifacts loaded from disk."""
+    import json as _json
+    import os as _os
+
+    pipe = Pipeline(
+        steps=[FunctionTransformer(func="gordo_components_tpu.models.transformers.multiply")]
+    )
+    X = np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32)
+    pipe.fit(X)
+    model_dir = str(tmp_path / "model")
+    dump(pipe, model_dir)
+    definition_path = _os.path.join(model_dir, "definition.json")
+    with open(definition_path) as fh:
+        definition = _json.load(fh)
+    text = _json.dumps(definition).replace(
+        "gordo_components_tpu.models.transformers.multiply", "os.system"
+    )
+    with open(definition_path, "w") as fh:
+        fh.write(text)
+    loaded = load(model_dir)  # builds fine: func is lazy
+    with pytest.raises(ValueError, match="external dotted path"):
+        loaded.transform(X)
+
+
+def test_build_path_still_allows_external_plugins():
+    """The operator-authored build path keeps dotted-path plugins working."""
+    built = pipeline_from_definition(
+        {"fractions.Fraction": {"numerator": 3, "denominator": 4}}
+    )
+    from fractions import Fraction
+
+    assert built == Fraction(3, 4)
+
+
+def test_load_path_allows_reference_aliases(tmp_path):
+    """sklearn/gordo_components alias spellings land inside the package and
+    must keep loading under the trust gate."""
+    pipe = pipeline_from_definition(
+        {
+            "sklearn.pipeline.Pipeline": {
+                "steps": ["sklearn.preprocessing.MinMaxScaler"]
+            }
+        }
+    )
+    X = np.random.default_rng(0).normal(size=(16, 3)).astype(np.float32)
+    pipe.fit(X)
+    model_dir = str(tmp_path / "model")
+    dump(pipe, model_dir)
+    loaded = load(model_dir)
+    np.testing.assert_allclose(loaded.transform(X), pipe.transform(X), rtol=1e-6)
+
+
+def test_named_step_colliding_with_short_name_round_trips(tmp_path):
+    """A step literally named "MinMaxScaler" must survive dump/load as a
+    NAME, not get materialized into an extra bare step (the [name, def]
+    pair and a 2-element bare-steps list are distinguished by element
+    shape)."""
+    pipe = Pipeline(
+        steps=[
+            ("MinMaxScaler", MinMaxScaler()),
+            ("model", DenseAutoEncoder(kind="feedforward_hourglass",
+                                       epochs=2, batch_size=16)),
+        ]
+    )
+    X = np.random.default_rng(0).normal(size=(64, 3)).astype(np.float32)
+    pipe.fit(X)
+    model_dir = str(tmp_path / "model")
+    dump(pipe, model_dir)
+    loaded = load(model_dir)
+    assert [name for name, _ in loaded.steps] == ["MinMaxScaler", "model"]
+    np.testing.assert_allclose(
+        loaded.predict(X), pipe.predict(X), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_two_element_bare_steps_list_still_works():
+    """steps: [bare_string, definition] is a 2-step pipeline, not a named
+    pair — the pair detection must key on the ELEMENT being a 2-list."""
+    pipe = pipeline_from_definition(
+        {
+            "Pipeline": {
+                "steps": [
+                    "MinMaxScaler",
+                    {"DenseAutoEncoder": {"kind": "feedforward_hourglass",
+                                          "epochs": 2, "batch_size": 16}},
+                ]
+            }
+        }
+    )
+    assert len(pipe.steps) == 2
+    assert isinstance(pipe.steps[0][1], MinMaxScaler)
