@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from datetime import datetime
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -142,6 +143,66 @@ class Client:
         return pd.DataFrame(columns, index=index)
 
     # -- public API ----------------------------------------------------------
+    def predict_frame(
+        self, machine: str, frame: pd.DataFrame, fmt: str = "parquet"
+    ) -> pd.DataFrame:
+        """Score a client-held DataFrame directly (no server-side fetch):
+        POST it to ``/anomaly/prediction`` as parquet (default — columnar
+        and far smaller on the wire than JSON records) or JSON records, and
+        return the scored frame (timestamp-indexed when ``frame`` has a
+        DatetimeIndex and fmt is parquet)."""
+        import requests
+
+        url = (
+            f"{self.base_url}/gordo/v0/{self.project}/{machine}"
+            f"/anomaly/prediction"
+        )
+        if fmt == "parquet":
+            import io
+
+            buffer = io.BytesIO()
+            frame.to_parquet(buffer)
+            kwargs: Dict[str, Any] = {
+                "data": buffer.getvalue(),
+                "headers": {"Content-Type": "application/x-parquet"},
+            }
+        elif fmt == "json":
+            kwargs = {"json": {"X": frame.to_dict(orient="records")}}
+        else:
+            raise ValueError(f"fmt must be 'parquet' or 'json', got {fmt!r}")
+
+        # same retry contract as the async path (_fetch_chunk): 4xx is
+        # permanent, 5xx/connection errors retry with backoff, and every
+        # terminal failure surfaces as ClientError
+        last_error: Optional[str] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                time.sleep(self.retry_backoff * 2 ** (attempt - 1))
+            try:
+                response = requests.post(url, timeout=self.timeout, **kwargs)
+            except requests.RequestException as exc:
+                last_error = repr(exc)
+                continue
+            if 400 <= response.status_code < 500:
+                raise ClientError(
+                    f"{machine}: HTTP {response.status_code}: "
+                    f"{response.text[:500]}"
+                )
+            if response.status_code >= 500:
+                last_error = f"HTTP {response.status_code}"
+                continue
+            try:
+                payload = response.json()
+            except ValueError:  # 2xx with a non-JSON body (broken proxy):
+                # retryable, and terminal failures stay ClientError
+                last_error = "2xx response with non-JSON body"
+                continue
+            chunk = self._chunk_frame(payload)
+            return chunk if chunk is not None else pd.DataFrame()
+        raise ClientError(
+            f"{machine}: retries exhausted ({last_error})"
+        )
+
     def predict(
         self,
         start: Union[str, datetime],

@@ -159,6 +159,7 @@ class InfluxDataProvider(GordoBaseDataProvider):
         value_name: str = "value",
         api_key: Optional[str] = None,
         api_key_header: Optional[str] = None,
+        client: Any = None,
         **influx_config: Any,
     ):
         # NOTE: credentials (api_key, password) are deliberately NOT
@@ -172,6 +173,11 @@ class InfluxDataProvider(GordoBaseDataProvider):
         self.measurement = measurement
         self.value_name = value_name
         self.influx_config = influx_config
+        if client is not None:
+            # injected client (tests / pre-authenticated sessions); never
+            # serialized
+            self._client = client
+            return
         try:
             import influxdb  # type: ignore
 
@@ -217,7 +223,21 @@ class InfluxDataProvider(GordoBaseDataProvider):
                 continue
             result = self._client.query(query)
             frame = result.get(self.measurement, pd.DataFrame(columns=[self.value_name]))
+            if self.value_name not in frame.columns:
+                raise ValueError(
+                    f"Influx result for tag {tag.name!r} has no "
+                    f"{self.value_name!r} column (columns: "
+                    f"{list(frame.columns)}); check value_name/measurement"
+                )
             series = frame[self.value_name]
+            # dataset assembly joins on tz-aware UTC timestamps; Influx
+            # clients variously return naive or local-tz indexes
+            if isinstance(series.index, pd.DatetimeIndex):
+                if series.index.tz is None:
+                    series = series.tz_localize("UTC")
+                else:
+                    series = series.tz_convert("UTC")
+                series = series.sort_index()
             series.name = tag.name
             yield series
 

@@ -50,6 +50,37 @@ logger = logging.getLogger(__name__)
 # heterogeneous-history slices collapse onto few compiled shapes
 _ROW_QUANTUM = 256
 
+MANIFEST_FILE = "fleet_manifest.json"
+
+
+def _write_manifest(
+    output_dir: str, completed: Dict[str, Dict[str, Any]], pending: List[str]
+) -> None:
+    """Fleet completion bitmap (SURVEY.md §6.4): one JSON file in the output
+    dir recording which machines are done, rewritten atomically after every
+    slice — a monitor (or a resuming build) reads fleet progress without
+    scanning the registry."""
+    import os
+    import tempfile
+
+    os.makedirs(output_dir, exist_ok=True)
+    payload = {
+        "updated": time.strftime("%Y-%m-%d %H:%M:%S%z"),
+        "n_completed": len(completed),
+        "n_pending": len(pending),
+        "machines": completed,
+        "pending": sorted(pending),
+    }
+    fd, tmp = tempfile.mkstemp(dir=output_dir, suffix=".manifest")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(payload, fh, indent=2)
+        os.replace(tmp, os.path.join(output_dir, MANIFEST_FILE))
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
 
 @dataclass
 class FleetMachineConfig:
@@ -229,6 +260,14 @@ def build_fleet(
                 continue
         pending.append((machine, cache_key))
 
+    manifest: Dict[str, Dict[str, Any]] = {
+        name: {"status": "cached", "model_dir": path}
+        for name, path in results.items()
+    }
+    _write_manifest(
+        output_dir, manifest, [m.name for m, _ in pending]
+    )
+
     # ---- bucket by (model config, feature/target width) BEFORE fetching:
     # widths come from the dataset's declared columns, so peak host memory
     # is one bucket's data, not the whole fleet's ---------------------------
@@ -390,6 +429,17 @@ def build_fleet(
                         model_register_dir, item["cache_key"], model_dir
                     )
                 results[machine.name] = model_dir
+                manifest[machine.name] = {
+                    "status": "completed",
+                    "model_dir": model_dir,
+                    "bucket": b,
+                    "slice": s,
+                }
+            _write_manifest(
+                output_dir,
+                manifest,
+                [name for name in (m.name for m, _ in pending) if name not in manifest],
+            )
             for item in slice_items:  # free before the next slice fetches
                 item.pop("X", None)
                 item.pop("y", None)

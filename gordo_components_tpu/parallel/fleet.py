@@ -212,6 +212,13 @@ def make_machine_program(
         :mod:`gordo_components_tpu.ops.windowing` — the off-by-one contract
         lives there, pinned by its golden tests, not re-derived here.
 
+        For windowed models ``inputs`` is the window START INDEX vector,
+        not materialized windows: batches gather their ``(batch, L, F)``
+        windows from the scaled rows on the fly (see ``windowed_apply``
+        below), so HBM holds ``(n_rows, F)`` instead of the L×-blown-up
+        ``(n_windows, L, F)`` tensor — the enabler for plant-scale buckets
+        (10k tags × L=32 windows would be ~1 GB per machine materialized).
+
         Row padding may sit ANYWHERE in the row axis (fold boundaries are
         computed on real-sample ranks, so placement is free): a window's
         weight is the MIN of its rows' weights times its target row's
@@ -220,7 +227,7 @@ def make_machine_program(
         if la is None:
             inputs, targets, wt = Xs, ys, w
         else:
-            inputs = windowing.sliding_windows(Xs, L, la)
+            inputs = jnp.arange(n_samples)
             targets = (
                 windowing.reconstruction_targets(ys, L)
                 if la == 0
@@ -261,6 +268,39 @@ def make_machine_program(
         inputs, targets, wt = prepare(Xs, ys, w)
         raw_targets = (targets - sy.offset) / sy.scale
 
+        if la is None:
+            fit_local = fit_fn
+            predict_all = lambda params: predict_fn(params, inputs)  # noqa: E731
+        else:
+            offsets = jnp.arange(L)
+
+            def windowed_apply(variables, starts, **kwargs):
+                # (batch,) start indices → gather (batch, L, F) from the
+                # scaled rows; grads flow only into params, so this is pure
+                # data movement XLA fuses into the model's first op
+                return apply_fn(
+                    variables, Xs[starts[:, None] + offsets], **kwargs
+                )
+
+            fit_local = make_fit_fn(
+                windowed_apply,
+                spec.optimizer,
+                loss=spec.loss,
+                batch_size=spec.batch_size,
+                epochs=spec.epochs,
+                use_dropout=spec.use_dropout,
+            )
+            windowed_predict = make_predict_fn(windowed_apply)
+
+            def predict_all(params):
+                # bounded-memory full prediction: sequential batch chunks,
+                # so peak HBM per machine stays one (batch, L, F) gather
+                chunks = inputs.reshape(-1, spec.batch_size)
+                preds = jax.lax.map(
+                    lambda sb: windowed_predict(params, sb), chunks
+                )
+                return preds.reshape(padded, n_targets)
+
         keys = jax.random.split(key, spec.n_splits + 2)
         init_key, fit_key, fold_keys = keys[0], keys[1], keys[2:]
         params0 = spec.module.init(
@@ -274,8 +314,8 @@ def make_machine_program(
         fold_test_masks = []
         fold_masks = timeseries_fold_masks(wt, spec.n_splits)
         for k, (train_mask, test_mask) in enumerate(fold_masks):
-            res = fit_fn(params0, inputs, targets, wt * train_mask, fold_keys[k])
-            pred = predict_fn(res.params, inputs)
+            res = fit_local(params0, inputs, targets, wt * train_mask, fold_keys[k])
+            pred = predict_all(res.params)
             pred_raw = (pred - sy.offset) / sy.scale
             err = jnp.abs(raw_targets - pred_raw)
             # rank-space folds guarantee a nonempty train region whenever a
@@ -292,12 +332,12 @@ def make_machine_program(
             fold_errors.append(err)
             fold_test_masks.append(wtest)
 
-        final = fit_fn(params0, inputs, targets, wt, fit_key)
+        final = fit_local(params0, inputs, targets, wt, fit_key)
 
         # final-model residuals over all real rows: the error-scaler source
         # when CV is off, and the per-machine fallback when no CV fold
         # covered this machine's data (short machine in a tall bucket)
-        pred_final = predict_fn(final.params, inputs)
+        pred_final = predict_all(final.params)
         pred_final_raw = (pred_final - sy.offset) / sy.scale
         err_final = jnp.abs(raw_targets - pred_final_raw)
         mask_final = (wt > 0)[:, None]

@@ -87,17 +87,21 @@ def dump(obj: Any, dest_dir: str, metadata: Optional[Dict[str, Any]] = None) -> 
     return dest_dir
 
 
-def load(source_dir: str) -> Any:
+def load(source_dir: str, *, allow_external: bool = False) -> Any:
     """Rebuild the fitted pipeline persisted by :func:`dump`.
 
-    The artifact's definition is treated as *data*, not config:
-    ``allow_external=False`` restricts class/function resolution to this
-    package, so a tampered ``definition.json`` (e.g. fetched from a spoofed
-    server via ``/download-model``) cannot instantiate arbitrary importables.
+    The artifact's definition is treated as *data*, not config: by default
+    class/function resolution is restricted to this package, so a tampered
+    ``definition.json`` (e.g. fetched from a spoofed server via
+    ``/download-model``) cannot instantiate arbitrary importables.
+    Artifacts that legitimately reference an external plugin class load
+    with ``allow_external=True`` (an explicit trust statement about the
+    artifact), or after appending the plugin's package prefix to
+    ``from_definition._TRUSTED_PREFIXES`` once at startup.
     """
     with open(os.path.join(source_dir, DEFINITION_FILE)) as fh:
         definition = json.load(fh)
-    obj = pipeline_from_definition(definition, allow_external=False)
+    obj = pipeline_from_definition(definition, allow_external=allow_external)
     with np.load(os.path.join(source_dir, STATE_FILE)) as npz:
         arrays = {key: npz[key] for key in npz.files}
     scalars: Dict[str, Any] = {}
@@ -133,8 +137,8 @@ def dumps(obj: Any, metadata: Optional[Dict[str, Any]] = None) -> bytes:
     return buffer.getvalue()
 
 
-def loads(blob: bytes) -> Any:
-    """Inverse of :func:`dumps`."""
+def loads(blob: bytes, *, allow_external: bool = False) -> Any:
+    """Inverse of :func:`dumps` (same trust gate as :func:`load`)."""
     import tempfile
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -146,7 +150,7 @@ def loads(blob: bytes) -> Any:
                 # the same path-traversal guard manually rather than
                 # extracting unfiltered
                 _safe_extract(tar, tmp)
-        return load(tmp)
+        return load(tmp, allow_external=allow_external)
 
 
 def _safe_extract(tar: tarfile.TarFile, dest: str) -> None:

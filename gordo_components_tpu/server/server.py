@@ -224,7 +224,17 @@ class ModelServer:
         raise NotFound(endpoint)
 
     # -- payload handling ----------------------------------------------------
-    def _parse_X(self, request: Request, machine: _Machine) -> np.ndarray:
+    _PARQUET_TYPES = (
+        "application/octet-stream",
+        "application/x-parquet",
+        "application/vnd.apache.parquet",
+    )
+
+    def _parse_X(self, request: Request, machine: _Machine):
+        """Request body → ``(array, timestamps-or-None)``. JSON ``{"X": …}``
+        (records or nested lists) and parquet uploads (reference parity:
+        ``server/views/base.py`` parquet payloads [UNVERIFIED]) are both
+        accepted; a parquet DatetimeIndex flows into the response."""
         if request.method != "POST":
             raise HTTPException(
                 response=Response(
@@ -233,6 +243,16 @@ class ModelServer:
                     mimetype="application/json",
                 )
             )
+        content_type = (request.content_type or "").split(";")[0].strip()
+        if content_type in self._PARQUET_TYPES:
+            # generic octet-stream only routes to parquet when the body
+            # really is parquet (PAR1 magic) — clients that POST JSON under
+            # that content type keep working
+            if (
+                content_type != "application/octet-stream"
+                or request.get_data()[:4] == b"PAR1"
+            ):
+                return self._parse_parquet(request, machine)
         try:
             payload = json.loads(request.get_data(as_text=True) or "{}")
         except json.JSONDecodeError:
@@ -255,10 +275,35 @@ class ModelServer:
             arr = arr[None, :]
         if arr.ndim != 2:
             _abort(400, f'"X" must be 2-D, got shape {list(arr.shape)}')
-        return arr
+        return arr, None
+
+    def _parse_parquet(self, request: Request, machine: _Machine):
+        import io
+
+        try:
+            import pandas as pd
+
+            frame = pd.read_parquet(io.BytesIO(request.get_data()))
+        except Exception as exc:
+            _abort(400, f"Request body is not a readable parquet table: {exc}")
+        # same column-order rule as the JSON records path: build tag list,
+        # else sorted columns — never the client's raw file order
+        tags = machine.tag_list or sorted(frame.columns)
+        missing = [t for t in tags if t not in frame.columns]
+        if missing:
+            _abort(400, f"Parquet payload missing tag columns {missing}")
+        frame = frame[tags]
+        try:
+            arr = np.asarray(frame.values, dtype=np.float32)
+        except (ValueError, TypeError):
+            _abort(400, "Parquet payload must be all-numeric")
+        timestamps = None
+        if isinstance(frame.index, pd.DatetimeIndex):
+            timestamps = [ts.isoformat() for ts in frame.index]
+        return arr, timestamps
 
     def _predict(self, request: Request, machine: _Machine) -> Response:
-        X = self._parse_X(request, machine)
+        X, _ = self._parse_X(request, machine)
         try:
             if self.engine.can_score(machine.name):
                 output = self.engine.predict(machine.name, X)
@@ -298,11 +343,15 @@ class ModelServer:
                 len(timestamps_all) - len(scored.total_anomaly_score) :
             ]
         else:
-            X = self._parse_X(request, machine)
+            X, timestamps_all = self._parse_X(request, machine)
             try:
                 scored = self._score(machine, X)
             except ValueError as exc:
                 _abort(400, f"Anomaly scoring failed: {exc}")
+            if timestamps_all is not None:  # parquet DatetimeIndex
+                timestamps = timestamps_all[
+                    len(timestamps_all) - len(scored.total_anomaly_score) :
+                ]
         data = {
             "model-input": scored.model_input.tolist(),
             "model-output": scored.model_output.tolist(),

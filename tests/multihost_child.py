@@ -1,0 +1,107 @@
+"""Child process for the 2-process distributed fleet test (test_aux.py).
+
+Run as: python multihost_child.py <process_id> <num_processes> <port>
+
+Each process joins the jax.distributed runtime (Gloo over localhost),
+spans a global fleet mesh over BOTH processes' virtual CPU devices, and
+runs a sharded fleet train step where its process only holds its own
+machines' data — the real multi-host layout (SURVEY.md §2.3): machine
+shards are process-local, collectives cross the process boundary.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    from gordo_components_tpu.parallel.distributed import (
+        global_fleet_mesh,
+        initialize_multihost,
+    )
+
+    initialize_multihost(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert jax.process_count() == nproc
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from gordo_components_tpu.parallel import MachineBatch, train_fleet_arrays
+    from gordo_components_tpu.parallel.build_fleet import _analyze_model, _spec_for
+    from gordo_components_tpu.serializer import pipeline_from_definition
+
+    mesh = global_fleet_mesh()
+    n_machines = mesh.size  # one machine per global device
+    local = jax.local_device_count()
+    rows, tags = 64, 3
+
+    model_config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "Pipeline": {
+                    "steps": [
+                        "MinMaxScaler",
+                        {
+                            "DenseAutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 2,
+                                "batch_size": 16,
+                            }
+                        },
+                    ]
+                }
+            }
+        }
+    }
+    probe = pipeline_from_definition(model_config)
+    spec = _spec_for(_analyze_model(probe), tags, tags, n_splits=2)
+
+    # deterministic global batch; each process materializes ONLY its own
+    # machines' rows on device (jax.make_array_from_process_local_data)
+    rng = np.random.default_rng(0)
+    X_full = rng.normal(size=(n_machines, rows, tags)).astype(np.float32)
+    X_full += np.sin(np.linspace(0, 8, rows))[None, :, None]
+    w_full = np.ones((n_machines, rows), np.float32)
+    keys_full = np.asarray(jax.random.split(jax.random.PRNGKey(0), n_machines))
+
+    lo, hi = pid * local, (pid + 1) * local
+
+    def globalize(full, spec_axes):
+        sharding = NamedSharding(mesh, PartitionSpec(*spec_axes))
+        return jax.make_array_from_process_local_data(sharding, full[lo:hi])
+
+    batch = MachineBatch(
+        X=globalize(X_full, ("fleet", None, None)),
+        y=globalize(X_full.copy(), ("fleet", None, None)),
+        w=globalize(w_full, ("fleet", None)),
+        keys=globalize(keys_full, ("fleet", None)),
+    )
+    result = train_fleet_arrays(spec, batch, mesh=mesh)
+    jax.block_until_ready(result)
+
+    # every process checks ITS machines' losses (addressable shards only)
+    for shard in result.loss_history.addressable_shards:
+        history = np.asarray(shard.data)
+        assert np.isfinite(history).all(), "non-finite loss on local shard"
+        assert history.shape[-1] == spec.epochs
+    print(
+        f"proc {pid}: trained {n_machines} machines over "
+        f"{nproc} processes x {local} devices",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

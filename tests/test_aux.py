@@ -1,6 +1,8 @@
 """Auxiliary-subsystem tests: fault injection, phase timing/profiling, and
 multi-host helpers (SURVEY.md §6)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -134,3 +136,55 @@ def test_global_fleet_mesh_spans_devices():
     mesh = global_fleet_mesh()
     assert mesh.size == 8
     assert mesh.axis_names == ("fleet",)
+
+
+def test_two_process_distributed_fleet_train():
+    """Genuine multi-process training: two OS processes join one
+    jax.distributed runtime (Gloo over localhost), span one fleet mesh, and
+    run a sharded fleet train step where each process holds only its own
+    machines' data (SURVEY.md §2.3 multi-host backend — exercised, not just
+    single-process-tested)."""
+    import socket
+    import subprocess
+    import sys
+
+    child = os.path.join(os.path.dirname(__file__), "multihost_child.py")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+
+    def run_once():
+        # the free-port probe is TOCTOU-racy; the retry below covers the
+        # rare case of another process grabbing it between close and bind
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, child, str(pid), "2", str(port)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+            )
+            for pid in range(2)
+        ]
+        outputs, codes = [], []
+        for proc in procs:
+            try:
+                out, _ = proc.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                for p in procs:
+                    p.kill()
+                out, _ = proc.communicate()
+            outputs.append(out)
+            codes.append(proc.returncode)
+        return codes, outputs
+
+    codes, outputs = run_once()
+    if any(c != 0 for c in codes):  # possible port race — one retry
+        codes, outputs = run_once()
+    assert all(c == 0 for c in codes), f"children failed:\n" + "\n".join(outputs)
+    assert any("trained 8 machines over 2 processes" in o for o in outputs)

@@ -132,3 +132,29 @@ def test_watchman_aggregates_health(served):
     assert body["ok"] is False
     assert watchman.get("/healthz").get_json() == {"ok": True}
     assert watchman.get("/nope").status_code == 404
+
+
+def test_client_predict_frame_parquet(served):
+    """predict_frame POSTs a client-held DataFrame as parquet and returns a
+    timestamp-indexed scored frame."""
+    import pandas as pd
+
+    idx = pd.date_range("2023-03-01", periods=16, freq="10min", tz="UTC")
+    rng = np.random.default_rng(1)
+    frame = pd.DataFrame(
+        rng.normal(size=(16, 2)).astype(np.float32),
+        index=idx,
+        columns=["c-a", "c-b"],
+    )
+    client = Client(served, project="proj")
+    scored = client.predict_frame("mach-1", frame)
+    assert len(scored) == 16
+    assert "total-anomaly-score" in scored.columns
+    assert scored.index[0] == idx[0]
+    # json fallback scores the same rows (no index)
+    scored_json = client.predict_frame("mach-1", frame, fmt="json")
+    np.testing.assert_allclose(
+        scored_json["total-anomaly-score"].values,
+        scored["total-anomaly-score"].values,
+        rtol=1e-5,
+    )

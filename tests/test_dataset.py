@@ -248,3 +248,84 @@ class TestReviewRegressions:
         assert "password" not in serialized
         assert "api_key" not in serialized
         assert serialized["username"] == "u"
+
+
+class _FakeInfluxClient:
+    """Stands in for influxdb.DataFrameClient: returns one frame per query,
+    optionally with a naive or non-UTC index or a renamed value column."""
+
+    def __init__(self, frames_by_tag, measurement="m", tz="UTC", value_col="value"):
+        self.frames_by_tag = frames_by_tag
+        self.measurement = measurement
+        self.tz = tz
+        self.value_col = value_col
+        self.queries = []
+
+    def query(self, q):
+        self.queries.append(q)
+        import re
+
+        tag = re.search(r"WHERE tag = '([^']*)'", q).group(1)
+        values = self.frames_by_tag[tag]
+        idx = pd.date_range("2023-01-01", periods=len(values), freq="10min")
+        if self.tz is not None:
+            idx = idx.tz_localize(self.tz)
+        frame = pd.DataFrame({self.value_col: values}, index=idx)
+        return {self.measurement: frame}
+
+
+class TestInfluxProvider:
+    def _provider(self, **kwargs):
+        from gordo_components_tpu.dataset.data_provider import InfluxDataProvider
+
+        return InfluxDataProvider(measurement="m", **kwargs)
+
+    def _load(self, provider, tags):
+        from datetime import datetime, timezone
+
+        return list(
+            provider.load_series(
+                datetime(2023, 1, 1, tzinfo=timezone.utc),
+                datetime(2023, 1, 2, tzinfo=timezone.utc),
+                [SensorTag(t, "asset") for t in tags],
+            )
+        )
+
+    def test_fake_client_round_trip_utc(self):
+        client = _FakeInfluxClient({"t1": [1.0, 2.0], "t2": [3.0, 4.0]})
+        series = self._load(self._provider(client=client), ["t1", "t2"])
+        assert [s.name for s in series] == ["t1", "t2"]
+        assert all(str(s.index.tz) == "UTC" for s in series)
+
+    def test_naive_index_localized_to_utc(self):
+        client = _FakeInfluxClient({"t1": [1.0, 2.0]}, tz=None)
+        (s,) = self._load(self._provider(client=client), ["t1"])
+        assert str(s.index.tz) == "UTC"
+
+    def test_foreign_tz_converted_to_utc(self):
+        client = _FakeInfluxClient({"t1": [1.0, 2.0]}, tz="Europe/Oslo")
+        (s,) = self._load(self._provider(client=client), ["t1"])
+        assert str(s.index.tz) == "UTC"
+        # 2023-01-01 00:00 Oslo is 2022-12-31 23:00 UTC
+        assert s.index[0].hour == 23
+
+    def test_missing_value_column_is_clear_error(self):
+        client = _FakeInfluxClient({"t1": [1.0]}, value_col="other")
+        with pytest.raises(ValueError, match="no 'value' column"):
+            self._load(self._provider(client=client), ["t1"])
+
+    def test_injected_client_feeds_timeseries_dataset(self):
+        client = _FakeInfluxClient(
+            {"t1": list(range(144)), "t2": list(range(144))}
+        )
+        provider = self._provider(client=client)
+        ds = TimeSeriesDataset(
+            data_provider=provider,
+            train_start_date="2023-01-01T00:00:00+00:00",
+            train_end_date="2023-01-02T00:00:00+00:00",
+            tag_list=["t1", "t2"],
+            resolution="10min",
+        )
+        X, y = ds.get_data()
+        assert list(X.columns) == ["t1", "t2"]
+        assert len(X) > 100

@@ -447,3 +447,44 @@ def test_fleet_slice_checkpoint_resume(tmp_path, monkeypatch):
     for name, model_dir in dirs.items():
         meta = load_metadata(model_dir)
         assert meta["model"]["fleet"]["slice_size"] == 2
+
+
+def test_fleet_manifest_tracks_progress(tmp_path, monkeypatch):
+    """The fleet completion bitmap (fleet_manifest.json) is rewritten after
+    every slice: a kill leaves it reflecting exactly the finished slices."""
+    import importlib
+    import json
+
+    bf = importlib.import_module("gordo_components_tpu.parallel.build_fleet")
+    mesh = fleet_mesh()
+    machines = [
+        FleetMachineConfig(
+            name=f"mf-{i}",
+            model_config=MODEL_CONFIG,
+            data_config=_data_config([f"f{i}-a", f"f{i}-b", f"f{i}-c"]),
+        )
+        for i in range(4)
+    ]
+    out = str(tmp_path / "fleet")
+
+    real_train = bf.train_fleet_arrays
+    calls = {"n": 0}
+
+    def dying_train(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("kill")
+        return real_train(*args, **kwargs)
+
+    monkeypatch.setattr(bf, "train_fleet_arrays", dying_train)
+    with pytest.raises(RuntimeError):
+        build_fleet(machines, out, mesh=mesh, n_splits=2, slice_size=2)
+
+    manifest = json.load(open(os.path.join(out, bf.MANIFEST_FILE)))
+    assert manifest["n_completed"] == 2
+    assert sorted(manifest["machines"]) == ["mf-0", "mf-1"]
+    assert manifest["pending"] == ["mf-2", "mf-3"]
+    assert all(
+        m["status"] == "completed" and os.path.isdir(m["model_dir"])
+        for m in manifest["machines"].values()
+    )

@@ -445,3 +445,21 @@ def test_two_element_bare_steps_list_still_works():
     )
     assert len(pipe.steps) == 2
     assert isinstance(pipe.steps[0][1], MinMaxScaler)
+
+
+def test_load_external_plugin_opt_in(tmp_path):
+    """Artifacts that legitimately reference external functions load with
+    allow_external=True (an explicit trust statement); the default stays
+    locked down."""
+    pipe = Pipeline(steps=[FunctionTransformer(func="numpy.abs")])
+    X = np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32)
+    pipe.fit(X)
+    model_dir = str(tmp_path / "model")
+    dump(pipe, model_dir)
+
+    locked = load(model_dir)
+    with pytest.raises(ValueError, match="external dotted path"):
+        locked.transform(X)
+
+    trusted = load(model_dir, allow_external=True)
+    np.testing.assert_allclose(trusted.transform(X), np.abs(X), rtol=1e-6)

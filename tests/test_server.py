@@ -184,3 +184,60 @@ def test_metrics_endpoint(client):
     assert "healthz" in body["latency"]
     assert body["latency"]["healthz"]["count"] >= 1
     assert body["latency"]["healthz"]["p50_ms"] >= 0
+
+
+def test_parquet_payload_with_timestamps(client):
+    """Parquet upload (reference parity: parquet payloads on the prediction
+    views): columns aligned by tag list, DatetimeIndex → response
+    timestamps."""
+    import io
+
+    import pandas as pd
+
+    idx = pd.date_range("2023-02-01", periods=12, freq="10min", tz="UTC")
+    rng = np.random.default_rng(0)
+    frame = pd.DataFrame(
+        rng.normal(size=(12, 3)).astype(np.float32),
+        index=idx,
+        columns=["tag-c", "tag-a", "tag-b"],  # deliberately shuffled
+    )
+    buffer = io.BytesIO()
+    frame.to_parquet(buffer)
+    response = client.post(
+        "/gordo/v0/proj/machine-a/anomaly/prediction",
+        data=buffer.getvalue(),
+        content_type="application/x-parquet",
+    )
+    assert response.status_code == 200
+    data = response.get_json()["data"]
+    assert len(data["total-anomaly-score"]) == 12
+    assert data["timestamps"][0].startswith("2023-02-01T00:00")
+    # column alignment: model-input row 0 must be in tag_list order (a,b,c)
+    expected = frame[["tag-a", "tag-b", "tag-c"]].values[0]
+    np.testing.assert_allclose(data["model-input"][0], expected, rtol=1e-6)
+
+
+def test_parquet_payload_missing_column_400(client):
+    import io
+
+    import pandas as pd
+
+    frame = pd.DataFrame(np.zeros((4, 2)), columns=["tag-a", "tag-b"])
+    buffer = io.BytesIO()
+    frame.to_parquet(buffer)
+    response = client.post(
+        "/gordo/v0/proj/machine-a/anomaly/prediction",
+        data=buffer.getvalue(),
+        content_type="application/x-parquet",
+    )
+    assert response.status_code == 400
+    assert "tag-c" in response.get_json()["error"]
+
+
+def test_garbage_parquet_400(client):
+    response = client.post(
+        "/gordo/v0/proj/machine-a/prediction",
+        data=b"not parquet at all",
+        content_type="application/octet-stream",
+    )
+    assert response.status_code == 400
