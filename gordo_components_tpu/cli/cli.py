@@ -47,12 +47,26 @@ def _load_config(value: Optional[str], kind: str) -> dict:
 @click.group("gordo")
 @click.option("--log-level", default="INFO", envvar="GORDO_LOG_LEVEL",
               show_default=True)
-def gordo(log_level: str):
+@click.option("--debug-nans/--no-debug-nans", default=False,
+              envvar="GORDO_DEBUG_NANS", show_default=True,
+              help="Enable jax_debug_nans: compiled programs re-run op-by-op "
+                   "at the first NaN and raise with the producing op "
+                   "(SURVEY.md §6.2 — the rebuild's numeric sanitizer; "
+                   "large slowdown, diagnostics only).")
+def gordo(log_level: str, debug_nans: bool):
     """gordo-components-tpu: fleet-scale TPU anomaly-model factory."""
     logging.basicConfig(
         level=log_level.upper(),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    if debug_nans:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+        logging.getLogger(__name__).warning(
+            "jax_debug_nans enabled: training/scoring runs un-jitted "
+            "re-checks on NaN and will be much slower"
+        )
 
 
 @gordo.command("build")

@@ -121,7 +121,6 @@ class BaseFlaxEstimator(GordoBase):
             raise ValueError(
                 f"X and y row counts differ: {len(X)} vs {len(y_arr)}"
             )
-        inputs = self._prepare_inputs(X)
         targets = self._prepare_targets(y_arr)
         self.n_features_ = int(X.shape[1])
         self.n_features_out_ = int(y_arr.shape[1])
@@ -135,18 +134,60 @@ class BaseFlaxEstimator(GordoBase):
         params = variables["params"]
 
         dropout_rate = float(self._spec.config.get("dropout", 0.0) or 0.0)
-        fit_fn = jax.jit(
-            make_fit_fn(
-                self._spec.module.apply,
-                self._spec.optimizer,
-                loss=self._spec.loss,
-                batch_size=self.batch_size,
-                epochs=self.epochs,
-                use_dropout=dropout_rate > 0.0,
-            )
+        fit_kwargs = dict(
+            loss=self._spec.loss,
+            batch_size=self.batch_size,
+            epochs=self.epochs,
+            use_dropout=dropout_rate > 0.0,
         )
-        Xp, yp, w = pad_to_batches(inputs, targets, self.batch_size)
-        result = fit_fn(params, jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(w), fit_key)
+        if self.lookahead is None:
+            fit_fn = jax.jit(
+                make_fit_fn(
+                    self._spec.module.apply, self._spec.optimizer, **fit_kwargs
+                )
+            )
+            Xp, yp, w = pad_to_batches(X, targets, self.batch_size)
+            result = fit_fn(
+                params, jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(w), fit_key
+            )
+        else:
+            # windowed models train on start INDICES: each batch gathers its
+            # (batch, L, F) windows from the row matrix inside the compiled
+            # loop, so the device holds (n, F) rows — not the L×-blown-up
+            # window tensor — and the per-epoch shuffle permutes indices,
+            # not windows (same scheme as the fleet program; numerically
+            # identical to materialized windows)
+            L, la = self.lookback_window, self.lookahead
+            n_samples = windowing.n_windows(len(X), L, la)
+            if n_samples <= 0:
+                raise ValueError(
+                    f"Need at least lookback_window+lookahead={L + la} rows "
+                    f"to fit, got {len(X)}"
+                )
+            apply = self._spec.module.apply
+            optimizer = self._spec.optimizer
+
+            def fit_windowed(p, rows, starts, y_t, w_t, k):
+                def windowed_apply(variables, sb, **kw):
+                    return apply(
+                        variables, windowing.gather_windows(rows, sb, L), **kw
+                    )
+
+                return make_fit_fn(windowed_apply, optimizer, **fit_kwargs)(
+                    p, starts, y_t, w_t, k
+                )
+
+            starts, yp, w = pad_to_batches(
+                np.arange(n_samples), targets, self.batch_size
+            )
+            result = jax.jit(fit_windowed)(
+                params,
+                jnp.asarray(X),
+                jnp.asarray(starts),
+                jnp.asarray(yp),
+                jnp.asarray(w),
+                fit_key,
+            )
         self.params_ = result.params
         self.history_ = [float(v) for v in jax.device_get(result.loss_history)]
         self._predict_jit = jax.jit(make_predict_fn(self._spec.module.apply))

@@ -68,6 +68,20 @@ def sliding_windows(
     return x[idx]
 
 
+def gather_windows(
+    rows: jnp.ndarray, starts: jnp.ndarray, lookback_window: int
+) -> jnp.ndarray:
+    """``(n, F)`` rows + ``(k,)`` window-start indices → ``(k, L, F)``.
+
+    The lazy twin of :func:`sliding_windows`: training loops batch over
+    start indices and gather each batch's windows on the fly, so device
+    memory holds the row matrix instead of the L×-blown-up window tensor.
+    Window ``i`` is rows ``[starts[i], starts[i]+L)`` — the SAME index
+    arithmetic as :func:`sliding_windows`, kept here so the off-by-one
+    contract stays in this module."""
+    return rows[starts[:, None] + jnp.arange(lookback_window)[None, :]]
+
+
 def reconstruction_targets(x: jnp.ndarray, lookback_window: int) -> jnp.ndarray:
     """Targets for the LSTM-autoencoder contract: row ``i+L-1`` per window."""
     return x[lookback_window - 1 :]

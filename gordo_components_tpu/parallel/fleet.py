@@ -272,14 +272,13 @@ def make_machine_program(
             fit_local = fit_fn
             predict_all = lambda params: predict_fn(params, inputs)  # noqa: E731
         else:
-            offsets = jnp.arange(L)
 
             def windowed_apply(variables, starts, **kwargs):
                 # (batch,) start indices → gather (batch, L, F) from the
                 # scaled rows; grads flow only into params, so this is pure
                 # data movement XLA fuses into the model's first op
                 return apply_fn(
-                    variables, Xs[starts[:, None] + offsets], **kwargs
+                    variables, windowing.gather_windows(Xs, starts, L), **kwargs
                 )
 
             fit_local = make_fit_fn(

@@ -175,3 +175,20 @@ def test_cli_module_entrypoint():
     )
     assert proc.returncode == 0
     assert "fleet-build" in proc.stdout
+
+
+def test_debug_nans_flag():
+    """--debug-nans flips jax_debug_nans (SURVEY.md §6.2 numeric sanitizer)."""
+    import jax
+    from click.testing import CliRunner
+
+    from gordo_components_tpu.cli import gordo
+
+    assert not jax.config.jax_debug_nans
+    runner = CliRunner()
+    result = runner.invoke(gordo, ["--debug-nans", "build", "--help"])
+    try:
+        assert result.exit_code == 0, result.output
+        assert jax.config.jax_debug_nans
+    finally:
+        jax.config.update("jax_debug_nans", False)
