@@ -125,8 +125,13 @@ def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
               help="mesh size (default: all available devices)")
 @click.option("--n-splits", default=3, show_default=True)
 @click.option("--seed", default=0, show_default=True)
+@click.option("--slice-size", default=256, show_default=True, type=int,
+              help="machines per checkpointed slice within a bucket: each "
+                   "slice's artifacts + registry keys land as it finishes, "
+                   "so a killed build loses at most one slice; 0 disables "
+                   "slicing (whole bucket per program call)")
 def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
-                    n_splits, seed):
+                    n_splits, seed, slice_size):
     """Build an entire fleet in one process: machines are bucketed and
     trained as vmapped programs sharded over the device mesh."""
     from ..dataset.dataset import InsufficientDataError
@@ -152,6 +157,7 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
             mesh=mesh,
             seed=seed,
             n_splits=n_splits,
+            slice_size=slice_size or None,
         )
     except InsufficientDataError as exc:
         logger.error("Data error in fleet build: %s", exc)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -51,6 +52,144 @@ logger = logging.getLogger(__name__)
 _ROW_QUANTUM = 256
 
 MANIFEST_FILE = "fleet_manifest.json"
+_CKPT_SUBDIR = ".slice_checkpoints"
+
+
+def _abstract_result(spec, n_machines, n_rows, n_features, n_targets):
+    """Shape/dtype skeleton of a stacked slice result, WITHOUT running the
+    program — the restore template for orbax (types round-trip exactly)."""
+    import jax.numpy as jnp
+
+    from .fleet import make_machine_program
+
+    program = jax.vmap(make_machine_program(spec, n_rows, n_features, n_targets))
+    return jax.eval_shape(
+        program,
+        jax.ShapeDtypeStruct((n_machines, n_rows, n_features), jnp.float32),
+        jax.ShapeDtypeStruct((n_machines, n_rows, n_targets), jnp.float32),
+        jax.ShapeDtypeStruct((n_machines, n_rows), jnp.float32),
+        jax.ShapeDtypeStruct((n_machines, 2), jnp.uint32),
+    )
+
+
+class _SliceCheckpointer:
+    """Orbax-backed async checkpoint of each slice's stacked training result
+    (SURVEY.md §6.4: async checkpoint of the stacked fleet pytree).
+
+    The save overlaps the per-machine artifact loop (device→host transfer is
+    already done; orbax writes in a background thread), closing the crash
+    window between "training finished" and "every artifact + registry key
+    durable": a resume restores the trained pytree instead of retraining the
+    slice. Checkpoints are deleted once their slice's artifacts are all
+    written — steady state leaves nothing behind."""
+
+    def __init__(self, output_dir: str):
+        import orbax.checkpoint as ocp
+
+        self._root = os.path.abspath(os.path.join(output_dir, _CKPT_SUBDIR))
+        self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        self._ocp = ocp
+
+    @staticmethod
+    def slice_key(slice_items: List[dict]) -> str:
+        """Content key for a slice: the machines' cache keys (which already
+        hash name + model/data/evaluation configs). Positional (bucket,
+        slice) indices would SHIFT across resumes — completed machines
+        leave ``pending``, so the survivors re-slice differently, and a
+        stale positional checkpoint could silently restore another slice's
+        params for the wrong machines."""
+        import hashlib
+
+        digest = hashlib.md5(
+            json.dumps([item["cache_key"] for item in slice_items]).encode()
+        )
+        return digest.hexdigest()
+
+    def path(self, key: str) -> str:
+        return os.path.join(self._root, f"slice_{key}")
+
+    # orbax refuses zero-size arrays (e.g. cv_scores with CV off); stand in
+    # a 1-element placeholder on save and rebuild the empty array on restore
+    @staticmethod
+    def _shrink(tree):
+        return jax.tree_util.tree_map(
+            lambda a: (
+                np.zeros((1,), np.asarray(a).dtype)
+                if np.asarray(a).size == 0
+                else a
+            ),
+            tree,
+        )
+
+    @staticmethod
+    def _shrink_abstract(abstract):
+        return jax.tree_util.tree_map(
+            lambda s: (
+                jax.ShapeDtypeStruct((1,), s.dtype) if 0 in s.shape else s
+            ),
+            abstract,
+        )
+
+    @staticmethod
+    def _unshrink(abstract, restored):
+        return jax.tree_util.tree_map(
+            lambda s, r: (
+                np.zeros(s.shape, s.dtype) if 0 in s.shape else r
+            ),
+            abstract,
+            restored,
+        )
+
+    def try_restore(self, key: str, abstract_fn):
+        """``abstract_fn`` is a thunk: building the restore template costs a
+        full eval_shape trace of the training program, so it only runs when
+        a finalized checkpoint actually exists."""
+        path = self.path(key)
+        if not os.path.isdir(path):  # orbax finalizes via atomic rename, so
+            # a crashed mid-save leaves only a *-tmp dir, never this path
+            return None
+        abstract = abstract_fn()
+        try:
+            result = self._unshrink(
+                abstract,
+                self._ckptr.restore(
+                    path,
+                    args=self._ocp.args.StandardRestore(
+                        self._shrink_abstract(abstract)
+                    ),
+                ),
+            )
+            logger.info(
+                "Restored slice checkpoint %s (skipping retrain)", key
+            )
+            return result
+        except Exception as exc:
+            logger.warning(
+                "Slice checkpoint %s unreadable (%s); retraining", path, exc
+            )
+            return None
+
+    def save_async(self, key: str, result) -> None:
+        self._ckptr.save(
+            self.path(key),
+            args=self._ocp.args.StandardSave(self._shrink(result)),
+            force=True,
+        )
+
+    def finalize(self, key: str) -> None:
+        """Wait for the async save, then drop the checkpoint — the slice's
+        artifacts are durable now, so the registry is the source of truth."""
+        import shutil
+
+        self._ckptr.wait_until_finished()
+        shutil.rmtree(self.path(key), ignore_errors=True)
+
+    def close(self) -> None:
+        import shutil
+
+        self._ckptr.wait_until_finished()
+        self._ckptr.close()
+        shutil.rmtree(self._root, ignore_errors=True)
 
 
 def _write_manifest(
@@ -240,6 +379,13 @@ def build_fleet(
 
     from ..utils.profiling import PhaseTimer, device_trace
 
+    if slice_size is not None and slice_size < 1:
+        # validated BEFORE any dataset probing or cache scanning, so an
+        # invalid value errors even on a fully-cached (no-op) build
+        raise ValueError(
+            f"slice_size must be a positive integer or None, got {slice_size!r}"
+        )
+
     timer = PhaseTimer()
     started = time.perf_counter()
     results: Dict[str, str] = {}
@@ -302,6 +448,7 @@ def build_fleet(
         buckets.setdefault(sig, []).append(item)
 
     master_key = jax.random.PRNGKey(seed)
+    checkpointer = _SliceCheckpointer(output_dir)
     for b, (sig, items) in enumerate(sorted(buckets.items())):
         bucket_started = time.perf_counter()
         model_config = items[0]["machine"].model_config
@@ -373,11 +520,22 @@ def build_fleet(
                 n_padded,
             )
 
-            with timer.phase("train"), device_trace(profile_dir):
-                result = train_fleet_arrays(
-                    spec, MachineBatch(X=X, y=y, w=w, keys=keys), mesh=mesh
-                )
-                result = jax.device_get(result)
+            ckpt_key = checkpointer.slice_key(slice_items)
+            result = checkpointer.try_restore(
+                ckpt_key,
+                lambda: _abstract_result(
+                    spec, n_padded, n_rows, n_features, n_targets
+                ),
+            )
+            if result is None:
+                with timer.phase("train"), device_trace(profile_dir):
+                    result = train_fleet_arrays(
+                        spec, MachineBatch(X=X, y=y, w=w, keys=keys), mesh=mesh
+                    )
+                    result = jax.device_get(result)
+                # async: orbax writes in the background while the artifact
+                # loop below runs; finalize() below joins + deletes
+                checkpointer.save_async(ckpt_key, result)
             slice_duration = time.perf_counter() - slice_started
 
             # ---- per-machine artifacts (same format as the single path),
@@ -440,6 +598,7 @@ def build_fleet(
                 manifest,
                 [name for name in (m.name for m, _ in pending) if name not in manifest],
             )
+            checkpointer.finalize(ckpt_key)  # artifacts durable → drop ckpt
             for item in slice_items:  # free before the next slice fetches
                 item.pop("X", None)
                 item.pop("y", None)
@@ -448,6 +607,7 @@ def build_fleet(
             "Fleet bucket %d/%d done in %.1fs", b + 1, len(buckets), bucket_duration
         )
 
+    checkpointer.close()
     logger.info(
         "Fleet build: %d machines in %.1fs (%d cached); phases: %s",
         len(machines),

@@ -1,0 +1,41 @@
+"""Accelerator-backend liveness probing.
+
+JAX backend init can block indefinitely when the accelerator transport is
+wedged (observed on tunneled-TPU rigs: ``jax.devices()`` hung >10 min).
+Anything that must not inherit that hang — benchmarks, driver entry points
+— probes through here: the callable runs on a daemon thread and the caller
+gets an answer within ``timeout_s`` either way.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Tuple
+
+
+def call_with_timeout(
+    fn: Callable[[], Any], timeout_s: float = 60.0
+) -> Tuple[str, Optional[Any]]:
+    """Run ``fn()`` on a daemon thread; returns one of
+
+    - ``("ok", value)`` — completed within the deadline;
+    - ``("error", exception)`` — raised within the deadline;
+    - ``("timeout", None)`` — still blocked at the deadline (the thread is
+      abandoned; it is a daemon, so it cannot keep the process alive).
+    """
+    result: dict = {}
+
+    def probe():
+        try:
+            result["value"] = fn()
+        except Exception as exc:
+            result["error"] = exc
+
+    thread = threading.Thread(target=probe, daemon=True)
+    thread.start()
+    thread.join(timeout_s)
+    if "value" in result:
+        return "ok", result["value"]
+    if "error" in result:
+        return "error", result["error"]
+    return "timeout", None

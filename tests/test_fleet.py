@@ -488,3 +488,77 @@ def test_fleet_manifest_tracks_progress(tmp_path, monkeypatch):
         m["status"] == "completed" and os.path.isdir(m["model_dir"])
         for m in manifest["machines"].values()
     )
+
+
+def test_slice_checkpoint_restores_instead_of_retraining(tmp_path, monkeypatch):
+    """A crash AFTER a slice trains but BEFORE its artifacts land must not
+    lose the training: the async orbax checkpoint of the stacked result
+    restores on resume and only the untrained slices run (SURVEY.md §6.4
+    async checkpoint of the stacked fleet pytree)."""
+    import importlib
+    import time as _time
+
+    bf = importlib.import_module("gordo_components_tpu.parallel.build_fleet")
+    mesh = fleet_mesh()
+    machines = [
+        FleetMachineConfig(
+            name=f"ck-{i}",
+            model_config=MODEL_CONFIG,
+            data_config=_data_config([f"k{i}-a", f"k{i}-b", f"k{i}-c"]),
+        )
+        for i in range(4)
+    ]
+    out = str(tmp_path / "fleet")
+    registry = str(tmp_path / "reg")
+
+    real_dump = bf.dump
+
+    def dying_dump(*args, **kwargs):
+        raise RuntimeError("killed before artifacts")
+
+    monkeypatch.setattr(bf, "dump", dying_dump)
+    with pytest.raises(RuntimeError, match="killed before artifacts"):
+        build_fleet(machines, out, model_register_dir=registry, mesh=mesh,
+                    n_splits=2, slice_size=2)
+
+    # wait for the in-flight async save to FINALIZE: orbax writes into a
+    # "*.orbax-checkpoint-tmp" dir and renames atomically, so only a match
+    # without the tmp suffix counts (matching the tmp dir would race the
+    # rename and flakily retrain instead of restoring)
+    import glob as _glob
+
+    pattern = os.path.join(out, ".slice_checkpoints", "slice_*")
+
+    def finalized():
+        return [p for p in _glob.glob(pattern) if "tmp" not in os.path.basename(p)]
+
+    deadline = _time.time() + 30
+    while not finalized() and _time.time() < deadline:
+        _time.sleep(0.2)
+    assert finalized(), "slice checkpoint never finalized"
+
+    monkeypatch.setattr(bf, "dump", real_dump)
+    real_train = bf.train_fleet_arrays
+    trains = {"n": 0}
+
+    def counting_train(*args, **kwargs):
+        trains["n"] += 1
+        return real_train(*args, **kwargs)
+
+    monkeypatch.setattr(bf, "train_fleet_arrays", counting_train)
+    dirs = build_fleet(machines, out, model_register_dir=registry, mesh=mesh,
+                       n_splits=2, slice_size=2)
+    assert set(dirs) == {f"ck-{i}" for i in range(4)}
+    assert trains["n"] == 1, "slice 0 must restore from checkpoint, not retrain"
+    for model_dir in dirs.values():
+        assert isinstance(load(model_dir), DiffBasedAnomalyDetector)
+    # steady state leaves no checkpoint residue
+    assert not os.path.isdir(os.path.join(out, ".slice_checkpoints"))
+
+
+def test_negative_slice_size_rejected(tmp_path):
+    machines = [FleetMachineConfig(
+        name="neg", model_config=MODEL_CONFIG,
+        data_config=_data_config(["n-a", "n-b", "n-c"]))]
+    with pytest.raises(ValueError, match="slice_size"):
+        build_fleet(machines, str(tmp_path / "o"), n_splits=2, slice_size=-1)
