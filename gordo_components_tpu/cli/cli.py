@@ -191,19 +191,24 @@ def run_server_cmd(model_dirs, models_dir, host, port, project):
         )
         resolved[name] = model_dir
     if models_dir:
-        for entry in sorted(os.listdir(models_dir)):
-            path = os.path.join(models_dir, entry)
-            if os.path.isdir(path):
-                resolved.setdefault(entry, path)
+        from ..server.server import scan_models_root
+
+        # same scan rule as POST /reload (definition.json gate) so startup
+        # and reload can never disagree about what counts as a model dir
+        for entry, path in scan_models_root(models_dir).items():
+            resolved.setdefault(entry, path)
     if not resolved:
         raise click.UsageError(
             "Provide --model-dir (or MODEL_LOCATION) or --models-dir"
         )
-    if len(resolved) == 1:
+    if len(resolved) == 1 and not models_dir:
         run_server(next(iter(resolved.values())), host=host, port=port,
                    project=project)
     else:
-        run_server(resolved, host=host, port=port, project=project)
+        # models_dir servers stay reload-capable (POST /reload picks up
+        # machines a fleet build adds to the tree after startup)
+        run_server(resolved, host=host, port=port, project=project,
+                   models_root=models_dir)
 
 
 @gordo.command("run-watchman")

@@ -538,67 +538,70 @@ def build_fleet(
                 checkpointer.save_async(ckpt_key, result)
             slice_duration = time.perf_counter() - slice_started
 
-            # ---- per-machine artifacts (same format as the single path),
-            # written before the next slice trains so a kill loses at most
-            # the in-flight slice ------------------------------------------
-            for i, item in enumerate(slice_items):
-                machine = item["machine"]
-                model = pipeline_from_definition(machine.model_config)
-                _install_result(
-                    model, result, i, n_features, n_targets, n_splits
-                )
-                model_dir = os.path.join(output_dir, machine.name)
-                # same metadata contract as the single-machine builder
-                # (consumers read these keys uniformly off the shared
-                # registry); per-machine durations are the slice's amortized
-                # share
-                amortized = slice_duration / max(len(slice_items), 1)
-                metadata = {
-                    "name": machine.name,
-                    "gordo_components_tpu_version": __version__,
-                    "model": {
-                        "model_config": machine.model_config,
-                        "model_builder_metadata": (
-                            model.get_metadata()
-                            if hasattr(model, "get_metadata")
-                            else {}
-                        ),
-                        "cross_validation": _cv_metadata(result, i, n_splits),
-                        "model_training_duration_s": amortized,
-                        "model_creation_date": time.strftime(
-                            "%Y-%m-%d %H:%M:%S%z"
-                        ),
-                        "cache_key": item["cache_key"],
-                        "fleet": {
-                            "bucket": b,
-                            "bucket_size": n_real,
-                            "slice": s,
-                            "slice_size": len(slice_items),
-                            "slice_duration_s": slice_duration,
-                        },
-                    },
-                    "dataset": item["dataset_metadata"],
-                    "build_duration_s": amortized,
-                    "user_defined": dict(machine.metadata),
-                }
-                dump(model, model_dir, metadata=metadata)
-                if model_register_dir:
-                    disk_registry.write_key(
-                        model_register_dir, item["cache_key"], model_dir
+            with timer.phase("artifacts"):
+                # ---- per-machine artifacts (same format as the single path),
+                # written before the next slice trains so a kill loses at most
+                # the in-flight slice ------------------------------------------
+                for i, item in enumerate(slice_items):
+                    machine = item["machine"]
+                    model = pipeline_from_definition(machine.model_config)
+                    _install_result(
+                        model, result, i, n_features, n_targets, n_splits
                     )
-                results[machine.name] = model_dir
-                manifest[machine.name] = {
-                    "status": "completed",
-                    "model_dir": model_dir,
-                    "bucket": b,
-                    "slice": s,
-                }
-            _write_manifest(
-                output_dir,
-                manifest,
-                [name for name in (m.name for m, _ in pending) if name not in manifest],
-            )
-            checkpointer.finalize(ckpt_key)  # artifacts durable → drop ckpt
+                    model_dir = os.path.join(output_dir, machine.name)
+                    # same metadata contract as the single-machine builder
+                    # (consumers read these keys uniformly off the shared
+                    # registry); per-machine durations are the slice's amortized
+                    # share
+                    amortized = slice_duration / max(len(slice_items), 1)
+                    metadata = {
+                        "name": machine.name,
+                        "gordo_components_tpu_version": __version__,
+                        "model": {
+                            "model_config": machine.model_config,
+                            "model_builder_metadata": (
+                                model.get_metadata()
+                                if hasattr(model, "get_metadata")
+                                else {}
+                            ),
+                            "cross_validation": _cv_metadata(result, i, n_splits),
+                            "model_training_duration_s": amortized,
+                            "model_creation_date": time.strftime(
+                                "%Y-%m-%d %H:%M:%S%z"
+                            ),
+                            "cache_key": item["cache_key"],
+                            "fleet": {
+                                "bucket": b,
+                                "bucket_size": n_real,
+                                "slice": s,
+                                "slice_size": len(slice_items),
+                                "slice_duration_s": slice_duration,
+                            },
+                        },
+                        "dataset": item["dataset_metadata"],
+                        "build_duration_s": amortized,
+                        "user_defined": dict(machine.metadata),
+                    }
+                    dump(model, model_dir, metadata=metadata)
+                    if model_register_dir:
+                        disk_registry.write_key(
+                            model_register_dir, item["cache_key"], model_dir
+                        )
+                    results[machine.name] = model_dir
+                    manifest[machine.name] = {
+                        "status": "completed",
+                        "model_dir": model_dir,
+                        "bucket": b,
+                        "slice": s,
+                    }
+                _write_manifest(
+                    output_dir,
+                    manifest,
+                    [name for name in (m.name for m, _ in pending) if name not in manifest],
+                )
+            with timer.phase("checkpoint_wait"):
+                # artifacts durable → join the async save and drop the ckpt
+                checkpointer.finalize(ckpt_key)
             for item in slice_items:  # free before the next slice fetches
                 item.pop("X", None)
                 item.pop("y", None)

@@ -48,6 +48,7 @@ _URL_MAP = Map(
         Rule("/metadata", endpoint="metadata"),
         Rule("/metrics", endpoint="metrics"),
         Rule("/models", endpoint="models"),
+        Rule("/reload", endpoint="reload"),
         Rule("/prediction", endpoint="prediction"),
         Rule("/anomaly/prediction", endpoint="anomaly"),
         Rule("/download-model", endpoint="download-model"),
@@ -110,12 +111,66 @@ class _Machine:
     def __init__(self, name: str, model_dir: str):
         self.name = name
         self.model_dir = model_dir
+        # mtime FIRST: if a rebuild lands between this stat and load(),
+        # the stored mtime is older than the new artifacts and the next
+        # reload refreshes — stat-after-load would pin the stale model
+        self.mtime = _artifact_mtime(model_dir)
         self.model = load(model_dir)
         self.metadata = load_metadata(model_dir)
 
     @property
     def tag_list(self) -> Optional[List[str]]:
         return self.metadata.get("dataset", {}).get("tag_list")
+
+
+def scan_models_root(models_root: str) -> Dict[str, str]:
+    """``{subdir_name: path}`` for every immediate subdir that looks like a
+    model artifact (has ``definition.json``). The ONE scan rule, shared by
+    CLI startup and ``/reload`` so the two can never drift."""
+    import os
+
+    seen: Dict[str, str] = {}
+    for entry in sorted(os.listdir(models_root)):
+        path = os.path.join(models_root, entry)
+        if os.path.isdir(path) and os.path.exists(
+            os.path.join(path, "definition.json")
+        ):
+            seen[entry] = path
+    return seen
+
+
+def _artifact_mtime(model_dir: str) -> float:
+    """Newest mtime among the artifact files — the change signal reload
+    uses to spot a rebuilt machine in the same directory."""
+    import os
+
+    newest = 0.0
+    try:
+        for entry in os.scandir(model_dir):
+            if entry.is_file():
+                newest = max(newest, entry.stat().st_mtime)
+    except OSError:
+        pass
+    return newest
+
+
+class _ServerState:
+    """Everything a request needs, swapped as ONE reference on reload so a
+    handler never sees machines and engine from different generations."""
+
+    __slots__ = ("machines", "single", "engine")
+
+    def __init__(self, machines: Dict[str, _Machine]):
+        self.machines = machines
+        self.single = (
+            next(iter(machines.values())) if len(machines) == 1 else None
+        )
+        # stacked TPU scoring: machines sharing an architecture serve from
+        # one device-resident pytree + one jitted program (engine.py);
+        # anything the engine can't lift falls back to model.anomaly
+        self.engine = ServingEngine(
+            {name: machine.model for name, machine in machines.items()}
+        )
 
 
 class ModelServer:
@@ -129,43 +184,126 @@ class ModelServer:
         self,
         model_dirs: Union[str, Dict[str, str]],
         project: str = "project",
+        models_root: Optional[str] = None,
     ):
+        """``models_root``: optional directory whose immediate subdirs are
+        model dirs; enables ``POST /reload`` so machines built AFTER server
+        start (a fleet build appending to the same tree) become servable
+        without a restart."""
         if isinstance(model_dirs, str):
             machine = _Machine("default", model_dirs)
             machine.name = machine.metadata.get("name", "default")
-            self.machines = {machine.name: machine}
-            self._single: Optional[_Machine] = machine
+            machines = {machine.name: machine}
         else:
-            self.machines = {
+            machines = {
                 name: _Machine(name, path) for name, path in model_dirs.items()
             }
-            self._single = (
-                next(iter(self.machines.values()))
-                if len(self.machines) == 1
-                else None
-            )
         self.project = project
+        self.models_root = models_root
+        # explicitly-registered machines survive every rescan, whatever
+        # directory they live in (a reload must not drop --model-dir
+        # machines that sit outside models_root, or rename ones registered
+        # under their metadata name rather than their dir basename)
+        self._pinned = dict(machines) if models_root else {}
+        self._reload_lock = threading.Lock()
+        self._state = _ServerState(machines)
         self.latency = _Latency()
-        # stacked TPU scoring: machines sharing an architecture serve from
-        # one device-resident pytree + one jitted program (engine.py);
-        # anything the engine can't lift falls back to model.anomaly
-        self.engine = ServingEngine(
-            {name: machine.model for name, machine in self.machines.items()}
-        )
         logger.info(
             "ModelServer serving %d model(s): %s",
-            len(self.machines),
-            sorted(self.machines),
+            len(machines),
+            sorted(machines),
         )
+
+    # back-compat accessors (tests, metrics): always the CURRENT generation
+    @property
+    def machines(self) -> Dict[str, _Machine]:
+        return self._state.machines
+
+    @property
+    def engine(self) -> ServingEngine:
+        return self._state.engine
+
+    @property
+    def _single(self) -> Optional[_Machine]:
+        return self._state.single
+
+    def reload(self) -> Dict[str, Any]:
+        """Rescan ``models_root`` and swap in the new fleet as ONE state
+        reference: subdirs not yet served are loaded, vanished ones
+        dropped, machines whose artifacts changed on disk re-loaded, and
+        explicitly-registered (pinned) machines always kept. A directory
+        that fails to load is SKIPPED and reported — one half-written
+        artifact (a fleet build mid-write) must not abort the whole reload
+        or unserve the healthy machines."""
+        import os
+
+        if not self.models_root:
+            raise ValueError(
+                "Server was not started with a models_root directory; "
+                "reload has nothing to rescan"
+            )
+        with self._reload_lock:
+            state = self._state
+            seen = scan_models_root(self.models_root)
+            pinned_paths = {
+                os.path.realpath(m.model_dir) for m in self._pinned.values()
+            }
+            added, refreshed = [], []
+            errors: Dict[str, str] = {}
+            machines: Dict[str, _Machine] = {}
+            for name, machine in self._pinned.items():
+                machines[name] = state.machines.get(name, machine)
+            for name, path in seen.items():
+                if os.path.realpath(path) in pinned_paths:
+                    continue  # already served under its pinned name
+                current = state.machines.get(name)
+                try:
+                    if current is None:
+                        machines[name] = _Machine(name, path)
+                        added.append(name)
+                    elif (
+                        current.model_dir != path
+                        or _artifact_mtime(path) != current.mtime
+                    ):
+                        machines[name] = _Machine(name, path)
+                        refreshed.append(name)
+                    else:
+                        machines[name] = current
+                except Exception as exc:  # half-written or corrupt dir:
+                    # keep the old generation if we have one, else skip
+                    errors[name] = f"{type(exc).__name__}: {exc}"
+                    if current is not None:
+                        machines[name] = current
+            removed = sorted(set(state.machines) - set(machines))
+            if added or removed or refreshed:
+                self._state = _ServerState(machines)
+                logger.info(
+                    "Reload: +%d / -%d / refreshed %d -> %d machine(s)%s",
+                    len(added),
+                    len(removed),
+                    len(refreshed),
+                    len(machines),
+                    f"; errors: {errors}" if errors else "",
+                )
+            return {
+                "added": sorted(added),
+                "removed": removed,
+                "refreshed": sorted(refreshed),
+                "errors": errors,
+                "total": len(machines),
+            }
 
     # -- dispatch ------------------------------------------------------------
     def __call__(self, environ, start_response):
         request = Request(environ)
         started = time.perf_counter()
         adapter = _URL_MAP.bind_to_environ(environ)
+        # ONE state snapshot per request: machines and engine must come from
+        # the same generation even if a reload swaps mid-request
+        state = self._state
         try:
             endpoint, args = adapter.match()
-            response = self._dispatch(request, endpoint, args)
+            response = self._dispatch(request, endpoint, args, state)
         except HTTPException as exc:
             if exc.response is not None:
                 response = exc.response
@@ -179,11 +317,11 @@ class ModelServer:
         self.latency.record(endpoint, time.perf_counter() - started)
         return response(environ, start_response)
 
-    def _machine_for(self, args: Dict[str, Any]) -> _Machine:
+    def _machine_for(self, args: Dict[str, Any], state: _ServerState) -> _Machine:
         name = args.get("machine")
         if name is None:
-            if self._single is not None:
-                return self._single
+            if state.single is not None:
+                return state.single
             raise NotFound(
                 "Multiple models served; use "
                 "/gordo/v0/<project>/<machine>/<endpoint>"
@@ -191,25 +329,35 @@ class ModelServer:
         if args.get("project") not in (self.project, None):
             raise NotFound(f"Unknown project {args.get('project')!r}")
         try:
-            return self.machines[name]
+            return state.machines[name]
         except KeyError:
             raise NotFound(f"Unknown machine {name!r}") from None
 
-    def _dispatch(self, request: Request, endpoint: str, args) -> Response:
+    def _dispatch(
+        self, request: Request, endpoint: str, args, state: _ServerState
+    ) -> Response:
         if endpoint == "healthz":
             if args.get("machine") is not None:
-                self._machine_for(args)  # machine-scoped health: 404 if absent
+                # machine-scoped health: 404 if absent
+                self._machine_for(args, state)
             return _json({"ok": True})
         if endpoint == "metrics":
             return _json(
                 {
                     "latency": self.latency.snapshot(),
-                    "engine": self.engine.stats(),
+                    "engine": state.engine.stats(),
                 }
             )
         if endpoint == "models":
-            return _json({"project": self.project, "models": sorted(self.machines)})
-        machine = self._machine_for(args)
+            return _json({"project": self.project, "models": sorted(state.machines)})
+        if endpoint == "reload":
+            if request.method != "POST":
+                _abort(405, "POST required")
+            try:
+                return _json(self.reload())
+            except ValueError as exc:
+                _abort(422, str(exc))
+        machine = self._machine_for(args, state)
         if endpoint == "metadata":
             return _json({"name": machine.name, "metadata": machine.metadata})
         if endpoint == "download-model":
@@ -218,9 +366,9 @@ class ModelServer:
                 mimetype="application/octet-stream",
             )
         if endpoint == "prediction":
-            return self._predict(request, machine)
+            return self._predict(request, machine, state)
         if endpoint == "anomaly":
-            return self._anomaly(request, machine)
+            return self._anomaly(request, machine, state)
         raise NotFound(endpoint)
 
     # -- payload handling ----------------------------------------------------
@@ -302,11 +450,13 @@ class ModelServer:
             timestamps = [ts.isoformat() for ts in frame.index]
         return arr, timestamps
 
-    def _predict(self, request: Request, machine: _Machine) -> Response:
+    def _predict(
+        self, request: Request, machine: _Machine, state: _ServerState
+    ) -> Response:
         X, _ = self._parse_X(request, machine)
         try:
-            if self.engine.can_score(machine.name):
-                output = self.engine.predict(machine.name, X)
+            if state.engine.can_score(machine.name):
+                output = state.engine.predict(machine.name, X)
             else:
                 output = machine.model.predict(X)
         except ValueError as exc:
@@ -320,7 +470,9 @@ class ModelServer:
             }
         )
 
-    def _anomaly(self, request: Request, machine: _Machine) -> Response:
+    def _anomaly(
+        self, request: Request, machine: _Machine, state: _ServerState
+    ) -> Response:
         model = machine.model
         if not isinstance(model, AnomalyDetectorBase):
             _abort(
@@ -335,7 +487,7 @@ class ModelServer:
             X_frame = self._fetch_range(machine, start, end)
             timestamps_all = [ts.isoformat() for ts in X_frame.index]
             try:
-                scored = self._score(machine, X_frame)
+                scored = self._score(machine, X_frame, state)
             except ValueError as exc:  # permanently-bad range (e.g. too few
                 # rows for the lookback window) must be 4xx, not a retryable 500
                 _abort(400, f"Anomaly scoring failed: {exc}")
@@ -345,7 +497,7 @@ class ModelServer:
         else:
             X, timestamps_all = self._parse_X(request, machine)
             try:
-                scored = self._score(machine, X)
+                scored = self._score(machine, X, state)
             except ValueError as exc:
                 _abort(400, f"Anomaly scoring failed: {exc}")
             if timestamps_all is not None:  # parquet DatetimeIndex
@@ -368,11 +520,11 @@ class ModelServer:
             }
         return _json({"data": data, **thresholds})
 
-    def _score(self, machine: _Machine, X):
+    def _score(self, machine: _Machine, X, state: _ServerState):
         """Anomaly arrays via the stacked TPU engine when the machine is
         lifted into it, else the host path (``model.anomaly``)."""
-        if self.engine.can_score(machine.name):
-            return self.engine.anomaly(machine.name, X)
+        if state.engine.can_score(machine.name):
+            return state.engine.anomaly(machine.name, X)
         frame = machine.model.anomaly(X)
         return ScoreResult(
             model_input=frame["model-input"].values,
@@ -423,10 +575,12 @@ def _abort(code: int, message: str) -> None:
 
 
 def build_app(
-    model_dirs: Union[str, Dict[str, str]], project: str = "project"
+    model_dirs: Union[str, Dict[str, str]],
+    project: str = "project",
+    models_root: Optional[str] = None,
 ) -> ModelServer:
     """App factory (reference: ``server.build_app``)."""
-    return ModelServer(model_dirs, project=project)
+    return ModelServer(model_dirs, project=project, models_root=models_root)
 
 
 def run_server(
@@ -434,6 +588,7 @@ def run_server(
     host: str = "0.0.0.0",
     port: int = 5555,
     project: str = "project",
+    models_root: Optional[str] = None,
 ) -> None:
     """Serve with werkzeug's multithreaded server.
 
@@ -450,5 +605,5 @@ def run_server(
     """
     from werkzeug.serving import run_simple
 
-    app = build_app(model_dirs, project=project)
+    app = build_app(model_dirs, project=project, models_root=models_root)
     run_simple(host, port, app, threaded=True)

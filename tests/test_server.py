@@ -2,6 +2,7 @@
 fixture-built model dir — all routes, bad payloads → 4xx, response schema)."""
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -241,3 +242,93 @@ def test_garbage_parquet_400(client):
         content_type="application/octet-stream",
     )
     assert response.status_code == 400
+
+
+def test_reload_picks_up_new_and_removed_machines(tmp_path):
+    """POST /reload rescans models_root: machines built after server start
+    become servable without a restart; vanished dirs are dropped."""
+    import shutil
+
+    root = str(tmp_path / "fleet")
+    os.makedirs(root)
+    first = provide_saved_model(
+        "m-first", ANOMALY_MODEL, DATA_CONFIG, os.path.join(root, "m-first"),
+        evaluation_config={"n_splits": 2},
+    )
+    app = build_app({"m-first": first}, project="proj", models_root=root)
+    client = Client(app)
+    assert client.get("/models").get_json()["models"] == ["m-first"]
+
+    # a fleet build adds a machine to the tree while the server runs
+    provide_saved_model(
+        "m-second", ANOMALY_MODEL, DATA_CONFIG, os.path.join(root, "m-second"),
+        evaluation_config={"n_splits": 2},
+    )
+    response = client.post("/reload")
+    assert response.status_code == 200
+    body = response.get_json()
+    assert body["added"] == ["m-second"] and body["total"] == 2
+    scored = client.post(
+        "/gordo/v0/proj/m-second/anomaly/prediction",
+        data=json.dumps({"X": np.zeros((4, 3)).tolist()}),
+        content_type="application/json",
+    )
+    assert scored.status_code == 200
+
+    shutil.rmtree(os.path.join(root, "m-second"))
+    body = client.post("/reload").get_json()
+    assert body["removed"] == ["m-second"] and body["total"] == 1
+    assert client.get("/models").get_json()["models"] == ["m-first"]
+
+
+def test_reload_without_models_root_422(client):
+    assert client.post("/reload").status_code == 422
+
+
+def test_reload_requires_post(client):
+    assert client.get("/reload").status_code == 405
+
+
+def test_reload_skips_half_written_dir(tmp_path):
+    """A definition.json without state yet (fleet build mid-write) must be
+    skipped and reported — not abort the reload or unserve healthy
+    machines."""
+    root = str(tmp_path / "fleet")
+    os.makedirs(root)
+    ok_dir = provide_saved_model(
+        "ok-m", ANOMALY_MODEL, DATA_CONFIG, os.path.join(root, "ok-m"),
+        evaluation_config={"n_splits": 2},
+    )
+    app = build_app({"ok-m": ok_dir}, project="proj", models_root=root)
+    client = Client(app)
+
+    half = os.path.join(root, "half-m")
+    os.makedirs(half)
+    with open(os.path.join(half, "definition.json"), "w") as fh:
+        fh.write('{"Pipeline": {"steps": ["MinMaxScaler"]}}')  # no state.npz
+    body = client.post("/reload").get_json()
+    assert "half-m" in body["errors"]
+    assert body["total"] == 1
+    assert client.get("/models").get_json()["models"] == ["ok-m"]
+
+
+def test_reload_keeps_pinned_machine_outside_root(tmp_path):
+    """A --model-dir machine living OUTSIDE models_root must survive
+    reloads."""
+    root = str(tmp_path / "fleet")
+    os.makedirs(root)
+    outside = provide_saved_model(
+        "pinned-m", ANOMALY_MODEL, DATA_CONFIG, str(tmp_path / "elsewhere"),
+        evaluation_config={"n_splits": 2},
+    )
+    app = build_app({"pinned-m": outside}, project="proj", models_root=root)
+    client = Client(app)
+    provide_saved_model(
+        "in-root", ANOMALY_MODEL, DATA_CONFIG, os.path.join(root, "in-root"),
+        evaluation_config={"n_splits": 2},
+    )
+    body = client.post("/reload").get_json()
+    assert body["added"] == ["in-root"]
+    assert sorted(client.get("/models").get_json()["models"]) == [
+        "in-root", "pinned-m",
+    ]
