@@ -227,33 +227,12 @@ def _bench_config(name: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
-def _require_live_backend(timeout_s: float = 120.0) -> None:
-    """Fail FAST when the accelerator tunnel is wedged: a bench that hangs
-    records nothing. On timeout we exit 3 with a clear message so the
-    driver logs a failure instead of stalling the round."""
-    import sys
-
-    from gordo_components_tpu.utils.backend import call_with_timeout
-
-    status, value = call_with_timeout(jax.devices, timeout_s)
-    if status == "ok":
-        return
-    sys.stderr.write(
-        "bench.py: JAX backend init "
-        + (
-            f"failed: {value!r}\n"
-            if status == "error"
-            else f"hung for {timeout_s:.0f}s (accelerator tunnel down?); "
-            "aborting instead of hanging\n"
-        )
-    )
-    sys.exit(3)
-
-
 def main() -> None:
     if os.environ.get("BENCH_CPU", "0") == "1":
         jax.config.update("jax_platforms", "cpu")
-    _require_live_backend()
+    from gordo_components_tpu.utils.backend import require_live_backend
+
+    require_live_backend("bench.py")
     machines = int(os.environ.get("BENCH_MACHINES", "128"))
     epochs = int(os.environ.get("BENCH_EPOCHS", "10"))
     full = os.environ.get("BENCH_FULL", "0") == "1"

@@ -25,7 +25,9 @@ path. The bench reports three numbers:
 faster than target).
 
 Env overrides: BENCH_SERVE_MACHINES (100), BENCH_SERVE_ROWS (144 = one day
-at 10-min resolution), BENCH_SERVE_TAGS (10), BENCH_SERVE_REQUESTS (200).
+at 10-min resolution), BENCH_SERVE_TAGS (10), BENCH_SERVE_REQUESTS (200),
+BENCH_CPU (0 — force the CPU backend, e.g. when the accelerator tunnel is
+down).
 """
 
 from __future__ import annotations
@@ -96,6 +98,15 @@ def main() -> None:
     n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "200"))
 
     import jax
+
+    if os.environ.get("BENCH_CPU", "0") == "1":
+        # the env var alone is ignored when an accelerator plugin is
+        # installed; the config update must land before backend init
+        jax.config.update("jax_platforms", "cpu")
+
+    from gordo_components_tpu.utils.backend import require_live_backend
+
+    require_live_backend("bench_serving.py")
 
     engine = build_engine(machines, rows, tags)
     names = engine.machines()

@@ -13,6 +13,29 @@ import threading
 from typing import Any, Callable, Optional, Tuple
 
 
+def require_live_backend(script_name: str, timeout_s: float = 120.0) -> None:
+    """Exit fast (code 3, clear stderr message) when JAX backend init hangs
+    or fails — the shared guard for driver-run benchmark scripts, which must
+    record a failure rather than stall a round on a wedged tunnel."""
+    import sys
+
+    import jax
+
+    status, value = call_with_timeout(jax.devices, timeout_s)
+    if status == "ok":
+        return
+    sys.stderr.write(
+        f"{script_name}: JAX backend init "
+        + (
+            f"failed: {value!r}\n"
+            if status == "error"
+            else f"hung for {timeout_s:.0f}s (accelerator tunnel down?); "
+            "aborting instead of hanging\n"
+        )
+    )
+    sys.exit(3)
+
+
 def call_with_timeout(
     fn: Callable[[], Any], timeout_s: float = 60.0
 ) -> Tuple[str, Optional[Any]]:
