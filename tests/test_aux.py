@@ -188,3 +188,23 @@ def test_two_process_distributed_fleet_train():
         codes, outputs = run_once()
     assert all(c == 0 for c in codes), f"children failed:\n" + "\n".join(outputs)
     assert any("trained 8 machines over 2 processes" in o for o in outputs)
+
+
+# ------------------------------------------------------------ backend probe
+def test_call_with_timeout_paths():
+    import time as _time
+
+    from gordo_components_tpu.utils.backend import call_with_timeout
+
+    assert call_with_timeout(lambda: 7, 5.0) == ("ok", 7)
+    status, exc = call_with_timeout(
+        lambda: (_ for _ in ()).throw(RuntimeError("boom")), 5.0
+    )
+    assert status == "error" and isinstance(exc, RuntimeError)
+    assert call_with_timeout(lambda: _time.sleep(20), 0.2) == ("timeout", None)
+
+
+def test_require_live_backend_passes_on_live_cpu():
+    from gordo_components_tpu.utils.backend import require_live_backend
+
+    require_live_backend("test-script")  # CPU backend is live -> returns

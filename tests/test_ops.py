@@ -97,3 +97,30 @@ class TestScaling:
         for fit in (fit_minmax, fit_standard):
             out = np.asarray(transform(fit(x), x))
             assert np.isfinite(out).all()
+
+
+def test_gather_windows_matches_sliding_windows():
+    """The lazy gather and the materialized windows share one index
+    contract: gathering every start reproduces sliding_windows exactly."""
+    import jax.numpy as jnp
+
+    from gordo_components_tpu.ops.windowing import (
+        gather_windows,
+        n_windows,
+        sliding_windows,
+    )
+
+    rng = np.random.default_rng(3)
+    rows = jnp.asarray(rng.normal(size=(40, 5)), jnp.float32)
+    for L, la in ((6, 0), (6, 1), (1, 0)):
+        count = n_windows(40, L, la)
+        starts = jnp.arange(count)
+        np.testing.assert_array_equal(
+            np.asarray(gather_windows(rows, starts, L)),
+            np.asarray(sliding_windows(rows, L, la)),
+        )
+    # arbitrary subset/order: window i is rows [starts[i], starts[i]+L)
+    starts = jnp.asarray([9, 2, 17])
+    got = np.asarray(gather_windows(rows, starts, 4))
+    for j, s in enumerate([9, 2, 17]):
+        np.testing.assert_array_equal(got[j], np.asarray(rows[s : s + 4]))
