@@ -308,28 +308,51 @@ def make_machine_program(
 
         emin = jnp.full((n_targets,), jnp.inf)
         emax = jnp.full((n_targets,), -jnp.inf)
-        cv_scores = []
-        fold_errors = []
-        fold_test_masks = []
+        n_points = raw_targets.shape[0]
         fold_masks = timeseries_fold_masks(wt, spec.n_splits)
-        for k, (train_mask, test_mask) in enumerate(fold_masks):
-            res = fit_local(params0, inputs, targets, wt * train_mask, fold_keys[k])
-            pred = predict_all(res.params)
-            pred_raw = (pred - sy.offset) / sy.scale
-            err = jnp.abs(raw_targets - pred_raw)
-            # rank-space folds guarantee a nonempty train region whenever a
-            # test region is nonempty; machines too short for any fold
-            # (n_real < n_splits+1) get empty test masks here and fall back
-            # to final-model residuals below
-            wtest = wt * test_mask
-            mask = (wtest > 0)[:, None]
-            emin = jnp.minimum(emin, jnp.min(jnp.where(mask, err, jnp.inf), axis=0))
-            emax = jnp.maximum(emax, jnp.max(jnp.where(mask, err, -jnp.inf), axis=0))
-            cv_scores.append(
-                _masked_explained_variance(raw_targets, pred_raw, wtest)
+        if spec.n_splits > 0:
+            # ONE fold fit in the compiled graph, scanned over the stacked
+            # masks (folds share every shape) — an unrolled Python loop
+            # would inline n_splits copies of the whole training program
+            # and multiply XLA compile time accordingly
+            train_masks = jnp.stack([m[0] for m in fold_masks])
+            test_masks = jnp.stack([m[1] for m in fold_masks])
+
+            def fold_step(carry, xs):
+                emin, emax = carry
+                train_mask, test_mask, fold_key = xs
+                res = fit_local(
+                    params0, inputs, targets, wt * train_mask, fold_key
+                )
+                pred = predict_all(res.params)
+                pred_raw = (pred - sy.offset) / sy.scale
+                err = jnp.abs(raw_targets - pred_raw)
+                # rank-space folds guarantee a nonempty train region
+                # whenever a test region is nonempty; machines too short
+                # for any fold (n_real < n_splits+1) get empty test masks
+                # here and fall back to final-model residuals below
+                wtest = wt * test_mask
+                mask = (wtest > 0)[:, None]
+                emin = jnp.minimum(
+                    emin, jnp.min(jnp.where(mask, err, jnp.inf), axis=0)
+                )
+                emax = jnp.maximum(
+                    emax, jnp.max(jnp.where(mask, err, -jnp.inf), axis=0)
+                )
+                score = _masked_explained_variance(raw_targets, pred_raw, wtest)
+                return (emin, emax), (score, err, wtest)
+
+            (emin, emax), (cv_scores, fold_errors, fold_test_masks) = (
+                jax.lax.scan(
+                    fold_step,
+                    (emin, emax),
+                    (train_masks, test_masks, fold_keys),
+                )
             )
-            fold_errors.append(err)
-            fold_test_masks.append(wtest)
+        else:
+            cv_scores = jnp.zeros((0,))
+            fold_errors = jnp.zeros((0, n_points, n_targets))
+            fold_test_masks = jnp.zeros((0, n_points))
 
         final = fit_local(params0, inputs, targets, wt, fit_key)
 
@@ -343,10 +366,7 @@ def make_machine_program(
         fmin = jnp.min(jnp.where(mask_final, err_final, jnp.inf), axis=0)
         fmax = jnp.max(jnp.where(mask_final, err_final, -jnp.inf), axis=0)
 
-        if spec.n_splits == 0:
-            use_cv = jnp.asarray(False)
-        else:
-            use_cv = jnp.sum(jnp.stack(fold_test_masks)) > 0
+        use_cv = jnp.sum(fold_test_masks) > 0
         emin = jnp.where(use_cv, emin, fmin)
         emax = jnp.where(use_cv, emax, fmax)
         emin = jnp.where(jnp.isfinite(emin), emin, 0.0)
@@ -357,9 +377,11 @@ def make_machine_program(
 
         # thresholds: 99th percentile of scaled residuals — out-of-fold when
         # CV covered this machine, final-model residuals otherwise
-        errs = jnp.stack(fold_errors + [err_final])  # (K+1, P, T)
+        errs = jnp.concatenate([fold_errors, err_final[None]])  # (K+1, P, T)
         fallback_mask = wt * jnp.where(use_cv, 0.0, 1.0)
-        masks = jnp.stack(fold_test_masks + [fallback_mask])  # (K+1, P)
+        masks = jnp.concatenate(
+            [fold_test_masks, fallback_mask[None]]
+        )  # (K+1, P)
         scaled = errs * error_scaler.scale + error_scaler.offset
         scaled = jnp.where((masks > 0)[:, :, None], scaled, jnp.nan)
         tag_thresholds = jnp.nan_to_num(
@@ -376,9 +398,7 @@ def make_machine_program(
             target_scaler=sy,
             error_scaler=error_scaler,
             loss_history=final.loss_history,
-            cv_scores=(
-                jnp.stack(cv_scores) if cv_scores else jnp.zeros((0,))
-            ),
+            cv_scores=cv_scores,
             tag_thresholds=tag_thresholds,
             total_threshold=total_threshold,
         )
