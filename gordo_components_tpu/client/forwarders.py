@@ -42,7 +42,14 @@ class ForwardPredictionsIntoInflux(PredictionForwarder):
     """Write scores into InfluxDB (measurement per machine). Requires the
     optional ``influxdb`` client package."""
 
-    def __init__(self, measurement: str = "anomaly", **influx_config):
+    def __init__(self, measurement: str = "anomaly", client=None, **influx_config):
+        """``client``: a pre-built DataFrame-style client (tests /
+        pre-authenticated sessions) — mirrors InfluxDataProvider's
+        injection point."""
+        self.measurement = measurement
+        if client is not None:
+            self._client = client
+            return
         try:
             import influxdb  # type: ignore
         except ImportError as exc:
@@ -50,7 +57,6 @@ class ForwardPredictionsIntoInflux(PredictionForwarder):
                 "ForwardPredictionsIntoInflux requires the optional "
                 "'influxdb' package, which is not installed."
             ) from exc
-        self.measurement = measurement
         self._client = influxdb.DataFrameClient(**influx_config)
 
     def forward(self, machine: str, predictions: pd.DataFrame) -> None:

@@ -43,8 +43,18 @@ class Machine:
 
 
 def _merged(defaults: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    """Deep merge: machine wins per KEY, recursively for nested mappings —
+    so a machine overriding ``dataset.data_provider.base_dir`` keeps the
+    global provider ``type`` (the shape the module docstring promises; a
+    shallow update would silently drop sibling keys of any nested
+    override). Non-dict values (lists like tag_list included) replace
+    wholesale."""
     out = dict(defaults)
-    out.update(override or {})
+    for key, value in (override or {}).items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _merged(out[key], value)
+        else:
+            out[key] = value
     return out
 
 

@@ -158,3 +158,29 @@ def test_client_predict_frame_parquet(served):
         scored["total-anomaly-score"].values,
         rtol=1e-5,
     )
+
+
+def test_influx_forwarder_with_injected_client():
+    """ForwardPredictionsIntoInflux works with an injected client even
+    without the optional influxdb package (mirrors the provider's
+    injection point)."""
+    import pandas as pd
+
+    from gordo_components_tpu.client.forwarders import (
+        ForwardPredictionsIntoInflux,
+    )
+
+    written = []
+
+    class FakeClient:
+        def write_points(self, frame, measurement, tags=None):
+            written.append((measurement, tags, len(frame)))
+
+    forwarder = ForwardPredictionsIntoInflux(measurement="anomaly",
+                                             client=FakeClient())
+    frame = pd.DataFrame(
+        {"total-anomaly-score": [1.0, 2.0]},
+        index=pd.date_range("2023-01-01", periods=2, freq="10min", tz="UTC"),
+    )
+    forwarder.forward("mach-9", frame)
+    assert written == [("anomaly", {"machine": "mach-9"}, 2)]

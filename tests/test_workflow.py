@@ -106,3 +106,33 @@ def test_tpu_job_golden():
     assert "fleet-build" in args
     limits = job["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
     assert limits["google.com/tpu"] == 16
+
+
+def test_globals_dataset_deep_merge():
+    """A machine overriding one nested data_provider key keeps the global
+    provider's sibling keys (deep merge, machine wins per key)."""
+    config = {
+        "machines": [
+            {
+                "name": "m1",
+                "model": {"Pipeline": {"steps": ["MinMaxScaler"]}},
+                "dataset": {
+                    "tag_list": ["a"],
+                    "data_provider": {"base_dir": "/other/lake"},
+                },
+            }
+        ],
+        "globals": {
+            "dataset": {
+                "resolution": "10min",
+                "data_provider": {"type": "NcsReader", "base_dir": "/lake"},
+            }
+        },
+    }
+    machine = NormalizedConfig(config).machines[0]
+    assert machine.dataset["data_provider"] == {
+        "type": "NcsReader",
+        "base_dir": "/other/lake",
+    }
+    assert machine.dataset["resolution"] == "10min"
+    assert machine.dataset["tag_list"] == ["a"]
