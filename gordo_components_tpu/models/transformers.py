@@ -35,10 +35,24 @@ class _BaseScaler(GordoBase):
         self.params_ = self._fit_params(X)
         return self
 
+    def _check_width(self, X: np.ndarray) -> None:
+        """sklearn parity: transform validates the feature count against the
+        fit-time width. Without this, a 1-wide input silently BROADCASTS
+        against the fitted (F,) params — a served model would return
+        plausible-looking scores for a malformed payload (found by driving
+        ``POST /anomaly/prediction`` with a 1-feature row)."""
+        expected = len(np.atleast_1d(self.params_.scale))
+        if X.ndim >= 1 and X.shape[-1] != expected:
+            raise ValueError(
+                f"{type(self).__name__} was fitted with {expected} features "
+                f"but got {X.shape[-1]}"
+            )
+
     def transform(self, X) -> np.ndarray:
         if self.params_ is None:
             raise ValueError(f"{type(self).__name__} is not fitted")
         X = np.asarray(getattr(X, "values", X), dtype=np.float32)
+        self._check_width(X)
         return np.asarray(scaling.transform(self.params_, X))
 
     def fit_transform(self, X, y=None) -> np.ndarray:
@@ -48,6 +62,7 @@ class _BaseScaler(GordoBase):
         if self.params_ is None:
             raise ValueError(f"{type(self).__name__} is not fitted")
         X = np.asarray(getattr(X, "values", X), dtype=np.float32)
+        self._check_width(X)
         return np.asarray(scaling.inverse_transform(self.params_, X))
 
     def get_metadata(self) -> Dict[str, Any]:

@@ -25,6 +25,7 @@ The estimator must be a zoo model (``BaseFlaxEstimator``); the scaler
 from __future__ import annotations
 
 import json
+from concurrent.futures import ThreadPoolExecutor
 import logging
 import os
 import time
@@ -449,39 +450,46 @@ def build_fleet(
 
     master_key = jax.random.PRNGKey(seed)
     checkpointer = _SliceCheckpointer(output_dir)
-    for b, (sig, items) in enumerate(sorted(buckets.items())):
-        bucket_started = time.perf_counter()
-        model_config = items[0]["machine"].model_config
-        probe = pipeline_from_definition(model_config)
-        analyzed = _analyze_model(probe)
-        n_features = items[0]["F"]
-        n_targets = items[0]["T"]
-        spec = _spec_for(analyzed, n_features, n_targets, n_splits)
+    prefetcher = ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="fleet-prefetch"
+    )
+    try:
+        for b, (sig, items) in enumerate(sorted(buckets.items())):
+            bucket_started = time.perf_counter()
+            model_config = items[0]["machine"].model_config
+            probe = pipeline_from_definition(model_config)
+            analyzed = _analyze_model(probe)
+            n_features = items[0]["F"]
+            n_targets = items[0]["T"]
+            spec = _spec_for(analyzed, n_features, n_targets, n_splits)
 
-        # ---- slice the bucket: each slice is an independent failure domain
-        # with its own data fetch, train call, and artifact writes. All
-        # slices share one padded machine count so the compiled executable
-        # is reused (fleet_program caches on spec+shape) --------------------
-        n_real = len(items)
-        eff = n_real if not slice_size else min(slice_size, n_real)
-        n_padded = pad_to_multiple(eff, mesh.size) if mesh is not None else eff
-        slices = [items[s : s + eff] for s in range(0, n_real, eff)]
-        logger.info(
-            "Fleet bucket %d/%d: %d machines in %d slice(s) of %d "
-            "(padded %d), F=%d",
-            b + 1,
-            len(buckets),
-            n_real,
-            len(slices),
-            eff,
-            n_padded,
-            n_features,
-        )
-        for s, slice_items in enumerate(slices):
-            slice_started = time.perf_counter()
-            # ---- host data fetch, this slice only (the reference's per-pod
-            # data-lake reads); peak host memory is one slice's data --------
-            with timer.phase("data_fetch"):
+            # ---- slice the bucket: each slice is an independent failure domain
+            # with its own data fetch, train call, and artifact writes. All
+            # slices share one padded machine count so the compiled executable
+            # is reused (fleet_program caches on spec+shape) --------------------
+            n_real = len(items)
+            eff = n_real if not slice_size else min(slice_size, n_real)
+            n_padded = pad_to_multiple(eff, mesh.size) if mesh is not None else eff
+            slices = [items[s : s + eff] for s in range(0, n_real, eff)]
+            logger.info(
+                "Fleet bucket %d/%d: %d machines in %d slice(s) of %d "
+                "(padded %d), F=%d",
+                b + 1,
+                len(buckets),
+                n_real,
+                len(slices),
+                eff,
+                n_padded,
+                n_features,
+            )
+            def prepare_slice(slice_items):
+                """Host-side ingest for one slice: provider fetch + padded
+                stacked assembly. Runs on the prefetch worker so slice ``s+1``'s
+                data-lake reads (the reference's I/O hot spot, SURVEY.md §4.1)
+                overlap slice ``s``'s device training + artifact writes. Peak
+                host memory is therefore TWO slices' data (double buffer), not
+                one — still bounded and documented at the slice_size knob."""
+                fetch_started = time.perf_counter()
                 for item in slice_items:
                     if "X" in item:  # width probe already fetched it
                         continue
@@ -494,122 +502,133 @@ def build_fleet(
                     )
                     item["dataset_metadata"] = item["dataset"].get_metadata()
 
-            n_rows = max(len(item["X"]) for item in slice_items)
-            if len(slices) > 1:
-                # quantize the row axis so slices with slightly different
-                # history lengths share one (n_padded, n_rows, F) shape and
-                # the bucket reuses a single compiled executable; padded
-                # rows are zero-weight and masked everywhere (fold masks
-                # run on real-sample ranks)
-                n_rows = -(-n_rows // _ROW_QUANTUM) * _ROW_QUANTUM
-            X = np.zeros((n_padded, n_rows, n_features), np.float32)
-            y = np.zeros((n_padded, n_rows, n_targets), np.float32)
-            w = np.zeros((n_padded, n_rows), np.float32)
-            for i, item in enumerate(slice_items):
-                rows = len(item["X"])
-                # RIGHT-aligned by convention (rows end at the bucket's
-                # latest timestamp). CV correctness does not depend on
-                # placement: fold masks are computed on real-sample ranks
-                # (fleet.timeseries_fold_masks), invariant to where padding
-                # sits
-                X[i, n_rows - rows :] = item["X"]
-                y[i, n_rows - rows :] = item["y"]
-                w[i, n_rows - rows :] = 1.0
-            keys = jax.random.split(
-                jax.random.fold_in(jax.random.fold_in(master_key, b), s),
-                n_padded,
-            )
-
-            ckpt_key = checkpointer.slice_key(slice_items)
-            result = checkpointer.try_restore(
-                ckpt_key,
-                lambda: _abstract_result(
-                    spec, n_padded, n_rows, n_features, n_targets
-                ),
-            )
-            if result is None:
-                with timer.phase("train"), device_trace(profile_dir):
-                    result = train_fleet_arrays(
-                        spec, MachineBatch(X=X, y=y, w=w, keys=keys), mesh=mesh
-                    )
-                    result = jax.device_get(result)
-                # async: orbax writes in the background while the artifact
-                # loop below runs; finalize() below joins + deletes
-                checkpointer.save_async(ckpt_key, result)
-            slice_duration = time.perf_counter() - slice_started
-
-            with timer.phase("artifacts"):
-                # ---- per-machine artifacts (same format as the single path),
-                # written before the next slice trains so a kill loses at most
-                # the in-flight slice ------------------------------------------
+                n_rows = max(len(item["X"]) for item in slice_items)
+                if len(slices) > 1:
+                    # quantize the row axis so slices with slightly different
+                    # history lengths share one (n_padded, n_rows, F) shape and
+                    # the bucket reuses a single compiled executable; padded
+                    # rows are zero-weight and masked everywhere (fold masks
+                    # run on real-sample ranks)
+                    n_rows = -(-n_rows // _ROW_QUANTUM) * _ROW_QUANTUM
+                X = np.zeros((n_padded, n_rows, n_features), np.float32)
+                y = np.zeros((n_padded, n_rows, n_targets), np.float32)
+                w = np.zeros((n_padded, n_rows), np.float32)
                 for i, item in enumerate(slice_items):
-                    machine = item["machine"]
-                    model = pipeline_from_definition(machine.model_config)
-                    _install_result(
-                        model, result, i, n_features, n_targets, n_splits
-                    )
-                    model_dir = os.path.join(output_dir, machine.name)
-                    # same metadata contract as the single-machine builder
-                    # (consumers read these keys uniformly off the shared
-                    # registry); per-machine durations are the slice's amortized
-                    # share
-                    amortized = slice_duration / max(len(slice_items), 1)
-                    metadata = {
-                        "name": machine.name,
-                        "gordo_components_tpu_version": __version__,
-                        "model": {
-                            "model_config": machine.model_config,
-                            "model_builder_metadata": (
-                                model.get_metadata()
-                                if hasattr(model, "get_metadata")
-                                else {}
-                            ),
-                            "cross_validation": _cv_metadata(result, i, n_splits),
-                            "model_training_duration_s": amortized,
-                            "model_creation_date": time.strftime(
-                                "%Y-%m-%d %H:%M:%S%z"
-                            ),
-                            "cache_key": item["cache_key"],
-                            "fleet": {
-                                "bucket": b,
-                                "bucket_size": n_real,
-                                "slice": s,
-                                "slice_size": len(slice_items),
-                                "slice_duration_s": slice_duration,
-                            },
-                        },
-                        "dataset": item["dataset_metadata"],
-                        "build_duration_s": amortized,
-                        "user_defined": dict(machine.metadata),
-                    }
-                    dump(model, model_dir, metadata=metadata)
-                    if model_register_dir:
-                        disk_registry.write_key(
-                            model_register_dir, item["cache_key"], model_dir
-                        )
-                    results[machine.name] = model_dir
-                    manifest[machine.name] = {
-                        "status": "completed",
-                        "model_dir": model_dir,
-                        "bucket": b,
-                        "slice": s,
-                    }
-                _write_manifest(
-                    output_dir,
-                    manifest,
-                    [name for name in (m.name for m, _ in pending) if name not in manifest],
-                )
-            with timer.phase("checkpoint_wait"):
-                # artifacts durable → join the async save and drop the ckpt
-                checkpointer.finalize(ckpt_key)
-            for item in slice_items:  # free before the next slice fetches
-                item.pop("X", None)
-                item.pop("y", None)
-        bucket_duration = time.perf_counter() - bucket_started
-        logger.info(
-            "Fleet bucket %d/%d done in %.1fs", b + 1, len(buckets), bucket_duration
-        )
+                    rows = len(item["X"])
+                    # RIGHT-aligned by convention (rows end at the bucket's
+                    # latest timestamp). CV correctness does not depend on
+                    # placement: fold masks are computed on real-sample ranks
+                    # (fleet.timeseries_fold_masks), invariant to where padding
+                    # sits
+                    X[i, n_rows - rows :] = item["X"]
+                    y[i, n_rows - rows :] = item["y"]
+                    w[i, n_rows - rows :] = 1.0
+                return X, y, w, n_rows, time.perf_counter() - fetch_started
 
+            prepared = prefetcher.submit(prepare_slice, slices[0])
+            for s, slice_items in enumerate(slices):
+                slice_started = time.perf_counter()
+                X, y, w, n_rows, fetch_s = prepared.result()
+                timer.add("data_fetch", fetch_s)
+                if s + 1 < len(slices):
+                    prepared = prefetcher.submit(prepare_slice, slices[s + 1])
+                keys = jax.random.split(
+                    jax.random.fold_in(jax.random.fold_in(master_key, b), s),
+                    n_padded,
+                )
+
+                ckpt_key = checkpointer.slice_key(slice_items)
+                result = checkpointer.try_restore(
+                    ckpt_key,
+                    lambda: _abstract_result(
+                        spec, n_padded, n_rows, n_features, n_targets
+                    ),
+                )
+                if result is None:
+                    with timer.phase("train"), device_trace(profile_dir):
+                        result = train_fleet_arrays(
+                            spec, MachineBatch(X=X, y=y, w=w, keys=keys), mesh=mesh
+                        )
+                        result = jax.device_get(result)
+                    # async: orbax writes in the background while the artifact
+                    # loop below runs; finalize() below joins + deletes
+                    checkpointer.save_async(ckpt_key, result)
+                slice_duration = time.perf_counter() - slice_started
+
+                with timer.phase("artifacts"):
+                    # ---- per-machine artifacts (same format as the single path),
+                    # written before the next slice trains so a kill loses at most
+                    # the in-flight slice ------------------------------------------
+                    for i, item in enumerate(slice_items):
+                        machine = item["machine"]
+                        model = pipeline_from_definition(machine.model_config)
+                        _install_result(
+                            model, result, i, n_features, n_targets, n_splits
+                        )
+                        model_dir = os.path.join(output_dir, machine.name)
+                        # same metadata contract as the single-machine builder
+                        # (consumers read these keys uniformly off the shared
+                        # registry); per-machine durations are the slice's amortized
+                        # share
+                        amortized = slice_duration / max(len(slice_items), 1)
+                        metadata = {
+                            "name": machine.name,
+                            "gordo_components_tpu_version": __version__,
+                            "model": {
+                                "model_config": machine.model_config,
+                                "model_builder_metadata": (
+                                    model.get_metadata()
+                                    if hasattr(model, "get_metadata")
+                                    else {}
+                                ),
+                                "cross_validation": _cv_metadata(result, i, n_splits),
+                                "model_training_duration_s": amortized,
+                                "model_creation_date": time.strftime(
+                                    "%Y-%m-%d %H:%M:%S%z"
+                                ),
+                                "cache_key": item["cache_key"],
+                                "fleet": {
+                                    "bucket": b,
+                                    "bucket_size": n_real,
+                                    "slice": s,
+                                    "slice_size": len(slice_items),
+                                    "slice_duration_s": slice_duration,
+                                },
+                            },
+                            "dataset": item["dataset_metadata"],
+                            "build_duration_s": amortized,
+                            "user_defined": dict(machine.metadata),
+                        }
+                        dump(model, model_dir, metadata=metadata)
+                        if model_register_dir:
+                            disk_registry.write_key(
+                                model_register_dir, item["cache_key"], model_dir
+                            )
+                        results[machine.name] = model_dir
+                        manifest[machine.name] = {
+                            "status": "completed",
+                            "model_dir": model_dir,
+                            "bucket": b,
+                            "slice": s,
+                        }
+                    _write_manifest(
+                        output_dir,
+                        manifest,
+                        [name for name in (m.name for m, _ in pending) if name not in manifest],
+                    )
+                with timer.phase("checkpoint_wait"):
+                    # artifacts durable → join the async save and drop the ckpt
+                    checkpointer.finalize(ckpt_key)
+                for item in slice_items:  # free before the next slice fetches
+                    item.pop("X", None)
+                    item.pop("y", None)
+            bucket_duration = time.perf_counter() - bucket_started
+            logger.info(
+                "Fleet bucket %d/%d done in %.1fs", b + 1, len(buckets), bucket_duration
+            )
+
+    finally:
+        prefetcher.shutdown(wait=True, cancel_futures=True)
     checkpointer.close()
     logger.info(
         "Fleet build: %d machines in %.1fs (%d cached); phases: %s",

@@ -448,6 +448,84 @@ def fleet_program(
     return jitted
 
 
+_EXEC_CACHE: dict = {}
+_EXEC_CACHE_MAX = 64
+
+
+def fleet_executable(
+    spec: FleetSpec,
+    n_machines: int,
+    n_rows: int,
+    n_features: int,
+    n_targets: int,
+    mesh=None,
+):
+    """AOT-compiled fleet executable + its input formats, cached by
+    (spec, shape, mesh).
+
+    Why AOT: ``compiled.input_formats`` exposes the exact device layouts
+    (tiling) the executable expects, so callers can ``jax.device_put``
+    ingest data straight into the right layout. Feeding plain host arrays
+    or default-layout device arrays instead makes EVERY execution pay a
+    device-side relayout — measured at ~200 ms for an 18 MB batch on v5e
+    vs 0.7 ms program execution, i.e. the relayout would dominate the
+    fleet hot loop ~300×.
+
+    Returns ``(compiled, formats)``; ``formats`` is ``None`` when the
+    backend has no layout API (the call path then falls back to plain
+    ``device_put``).
+    """
+    key = (spec, n_machines, n_rows, n_features, n_targets, mesh)
+    try:
+        cached = _EXEC_CACHE.get(key)
+    except TypeError:
+        key, cached = None, None
+    if cached is not None:
+        return cached
+    program = fleet_program(spec, n_rows, n_features, n_targets, mesh=mesh)
+    avatars = (
+        jax.ShapeDtypeStruct((n_machines, n_rows, n_features), jnp.float32),
+        jax.ShapeDtypeStruct((n_machines, n_rows, n_targets), jnp.float32),
+        jax.ShapeDtypeStruct((n_machines, n_rows), jnp.float32),
+        jax.ShapeDtypeStruct((n_machines, 2), jnp.uint32),
+    )
+    compiled = program.lower(*avatars).compile()
+    try:
+        formats = compiled.input_formats[0]
+    except (AttributeError, TypeError, IndexError):
+        formats = None
+    entry = (compiled, formats)
+    if key is not None:
+        if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
+            _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
+        _EXEC_CACHE[key] = entry
+    return entry
+
+
+def put_fleet_batch(batch: MachineBatch, formats=None) -> MachineBatch:
+    """Device-place a batch, layout-matched when ``formats`` is given (see
+    :func:`fleet_executable`). The returned batch's arrays are device
+    arrays; transfers are issued immediately so a caller can overlap them
+    with an in-flight execution before blocking."""
+    keys = batch.keys
+    if jax.dtypes.issubdtype(getattr(keys, "dtype", None), jax.dtypes.prng_key):
+        keys = jax.random.key_data(keys)  # typed keys → raw uint32 pairs
+    args = tuple(
+        # host-side cast on mismatch: jnp.asarray would device-place in the
+        # DEFAULT layout first, re-paying the relayout this path avoids
+        a if getattr(a, "dtype", None) == d else np.asarray(a, d)
+        for a, d in zip(
+            (batch.X, batch.y, batch.w, keys),
+            (jnp.float32, jnp.float32, jnp.float32, jnp.uint32),
+        )
+    )
+    if formats is None:
+        placed = [jax.device_put(a) for a in args]
+    else:
+        placed = [jax.device_put(a, f) for a, f in zip(args, formats)]
+    return MachineBatch(*placed)
+
+
 def train_fleet_arrays(
     spec: FleetSpec,
     batch: MachineBatch,
@@ -459,6 +537,10 @@ def train_fleet_arrays(
     be a multiple of the mesh size — pad with zero-weight machines) and XLA
     partitions the whole program; without, the vmapped program runs on the
     default device.
+
+    Host arrays are device-placed layout-matched via the AOT executable
+    (:func:`fleet_executable`); keys uint32 dtype aside, any float inputs
+    are accepted as-is.
     """
     n_machines, n_rows, n_features = batch.X.shape
     n_targets = batch.y.shape[2]
@@ -468,5 +550,8 @@ def train_fleet_arrays(
             f"{mesh.size}; pad with zero-weight machines "
             "(build_fleet does this automatically)"
         )
-    jitted = fleet_program(spec, n_rows, n_features, n_targets, mesh=mesh)
-    return jitted(batch.X, batch.y, batch.w, batch.keys)
+    compiled, formats = fleet_executable(
+        spec, n_machines, n_rows, n_features, n_targets, mesh=mesh
+    )
+    placed = put_fleet_batch(batch, formats)
+    return compiled(placed.X, placed.y, placed.w, placed.keys)

@@ -120,6 +120,7 @@ class _Bucket:
         self.lookahead = lookahead
         self.max_batch = max_batch
         self.names = [e.name for e in entries]
+        self.n_features = int(np.atleast_1d(entries[0].sx.scale).shape[0])
         self.stacked = jax.device_put(
             {
                 "params": jax.tree_util.tree_map(
@@ -349,6 +350,13 @@ class ServingEngine:
         X = np.asarray(getattr(X, "values", X), np.float32)
         if X.ndim == 1:
             X = X[None, :]
+        if X.shape[1] != bucket.n_features:
+            # without this, a narrower payload silently BROADCASTS against
+            # the stacked (F,) scaler affines and returns plausible-looking
+            # scores (the host path's scalers validate width the same way)
+            raise ValueError(
+                f"Model expects {bucket.n_features} features, got {X.shape[1]}"
+            )
         n = X.shape[0]
         L, la = bucket.lookback, bucket.lookahead
         if la is None:

@@ -33,9 +33,15 @@ class PhaseTimer:
             yield
         finally:
             elapsed = time.perf_counter() - started
-            self.durations[name] = self.durations.get(name, 0.0) + elapsed
-            self.counts[name] = self.counts.get(name, 0) + 1
+            self.add(name, elapsed)
             logger.debug("phase %s: %.3fs", name, elapsed)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Record a duration measured elsewhere (e.g. in a prefetch worker
+        thread, where the contextmanager would attribute overlapped time
+        to the wrong wall-clock interval)."""
+        self.durations[name] = self.durations.get(name, 0.0) + seconds
+        self.counts[name] = self.counts.get(name, 0) + 1
 
     def report(self) -> Dict[str, Any]:
         return {

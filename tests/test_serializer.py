@@ -49,6 +49,17 @@ def test_minmax_scaler_sklearn_parity(X):
     np.testing.assert_allclose(ours.inverse_transform(ours.transform(X)), X, atol=1e-4)
 
 
+def test_scaler_width_mismatch_raises(X):
+    """sklearn parity: transform/inverse_transform validate the feature
+    count — a narrower input must raise, not broadcast against (F,) params."""
+    for scaler in (MinMaxScaler().fit(X), StandardScaler().fit(X)):
+        for bad in (np.ones((4, 1), np.float32), np.ones((4, X.shape[1] + 1))):
+            with pytest.raises(ValueError, match="features"):
+                scaler.transform(bad)
+            with pytest.raises(ValueError, match="features"):
+                scaler.inverse_transform(bad)
+
+
 def test_standard_scaler_sklearn_parity(X):
     import sklearn.preprocessing as skp
 

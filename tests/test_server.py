@@ -152,6 +152,23 @@ def test_bad_payloads_4xx(client):
     assert _post(client, path, {"X": records}).status_code == 400
 
 
+def test_width_mismatch_400_not_broadcast(client):
+    """A payload narrower than the fitted tag set must 400, not silently
+    BROADCAST against the (F,) scaler affines and return plausible scores.
+    Regression: the width-1 case slipped through both the host scalers and
+    the stacked serving engine (numpy broadcasting (n,1)x(F,) -> (n,F))."""
+    cases = [  # host path + engine path (anomaly route needs the detector)
+        ("machine-a", "prediction"),
+        ("machine-a", "anomaly/prediction"),
+        ("machine-p", "prediction"),
+    ]
+    for machine, route in cases:
+        path = f"/gordo/v0/proj/{machine}/{route}"
+        response = _post(client, path, {"X": [[1.0]] * 4})
+        assert response.status_code == 400, (machine, route, response.status_code)
+        assert "features" in response.get_json()["error"]
+
+
 def test_unknown_machine_404(client):
     assert client.get("/gordo/v0/proj/nope/metadata").status_code == 404
     assert client.get("/gordo/v0/wrongproj/machine-a/metadata").status_code == 404
