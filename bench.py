@@ -98,7 +98,9 @@ def _configs(full: bool, epochs: int, machines: int) -> Dict[str, Dict[str, Any]
                 epochs=epochs,
                 batch_size=64,
             ),
-            "machines": machines,
+            # FULL = the north-star fleet size (1000 machines, padded to the
+            # next power of two) built on however many chips are present
+            "machines": machines if not full else max(machines, 1024),
             "rows": 864,  # 6 days at 10-min resolution
             "tags": 10,
             "n_splits": 3,

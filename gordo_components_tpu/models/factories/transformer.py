@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ...ops.attention import dense_attention, ring_attention
+from ...ops.flash_attention import flash_attention
 from ..modules import activation, resolve_dtype
 from ..register import register_model_factory
 from .feedforward import _reject_unknown
@@ -38,8 +39,12 @@ class MultiHeadSelfAttention(nn.Module):
 
     ``attention_impl``:
 
-    - ``"dense"`` — :func:`ops.attention.dense_attention` (XLA flash-fuses
-      it on TPU for these patch counts);
+    - ``"dense"`` — :func:`ops.attention.dense_attention` (XLA fuses it
+      well for patch counts in the dozens);
+    - ``"flash"`` — :func:`ops.flash_attention.flash_attention`: the
+      Pallas blockwise kernel — scores stay in VMEM tiles, never O(P²)
+      HBM; the single-device long-window path. Exact; parity pinned by
+      tests/test_flash_attention.py;
     - ``"ring"`` — :func:`ops.attention.ring_attention`: the sequence
       (patch) axis shards over a 1-D mesh of all local devices and K/V
       blocks rotate via ICI neighbor hops (SURVEY.md §6.7 long-context
@@ -47,8 +52,8 @@ class MultiHeadSelfAttention(nn.Module):
       tests/test_transformer.py.
 
     Attention-weight dropout applies on the dense path (weights are
-    materialized there); the ring path cannot drop weights it never
-    materializes, so it trains with residual dropout only.
+    materialized there); the flash and ring paths cannot drop weights they
+    never materialize, so they train with residual dropout only.
     """
 
     d_model: int
@@ -73,6 +78,8 @@ class MultiHeadSelfAttention(nn.Module):
         if self.attention_impl == "ring":
             mesh = Mesh(np.asarray(jax.devices()), (self.ring_axis,))
             out = ring_attention(q, k, v, mesh=mesh, axis_name=self.ring_axis)
+        elif self.attention_impl == "flash":
+            out = flash_attention(q, k, v)
         elif self.attention_impl == "dense":
             if self.dropout_rate > 0.0 and not deterministic:
                 # materialized-weights path so dropout can hit the weights
@@ -89,7 +96,7 @@ class MultiHeadSelfAttention(nn.Module):
         else:
             raise ValueError(
                 f"Unknown attention_impl {self.attention_impl!r}; "
-                "use 'dense' or 'ring'"
+                "use 'dense', 'flash', or 'ring'"
             )
         return nn.DenseGeneral(
             self.d_model, axis=(-2, -1), dtype=dtype, name="out"
@@ -208,9 +215,10 @@ def patchtst(
     stride = stride or max(1, patch_length // 2)
     ff_dim = ff_dim or 2 * d_model
     n_features_out = n_features_out or n_features
-    if attention_impl not in ("dense", "ring"):
+    if attention_impl not in ("dense", "flash", "ring"):
         raise ValueError(
-            f"Unknown attention_impl {attention_impl!r}; use 'dense' or 'ring'"
+            f"Unknown attention_impl {attention_impl!r}; "
+            "use 'dense', 'flash', or 'ring'"
         )
     if d_model % n_heads != 0:
         raise ValueError(

@@ -1,0 +1,234 @@
+"""Blockwise (flash) attention as a Pallas TPU kernel.
+
+The reference has no attention at all (SURVEY.md §6.7); this backs the
+rebuild's long-window PatchTST path. ``dense_attention`` materializes the
+``(seq, seq)`` score matrix — fine for patch counts in the dozens, but a
+long-window config (thousands of patches) pays O(S²) HBM for scores that
+exist only to be softmaxed and contracted away. This kernel computes
+attention blockwise in VMEM with the online-softmax recurrence (running
+max ``m``, normalizer ``l``, accumulator ``acc`` — the same fold
+:func:`gordo_components_tpu.ops.attention.ring_attention` runs across ICI
+hops, here run across VMEM tiles): per-core live memory is
+O(block_q x block_k), the two contractions per tile are
+``lax.dot_general`` calls that land on the MXU, and scores never touch
+HBM.
+
+Exactness and autodiff:
+
+- forward is exact (not approximate); parity vs ``dense_attention`` is
+  pinned by tests/test_flash_attention.py, including an odd sequence
+  length that exercises the padding mask;
+- backward is a ``jax.custom_vjp`` implemented as a blockwise
+  ``lax.scan`` over key blocks using the saved per-row logsumexp — the
+  standard flash backward recurrence — so gradients are exact and peak
+  memory stays O(S x block_k), never O(S²).
+
+Off-TPU the kernel runs in Pallas interpret mode, so CPU tests execute
+the same code path the TPU lowers.
+
+Scope: non-causal self-attention (the PatchTST encoder is bidirectional;
+nothing in the zoo is autoregressive). Attention-weight dropout is not
+representable (weights are never materialized) — callers fall back to the
+dense path for that, as with ring attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# finite stand-in for -inf in the masked-score/online-max recurrence:
+# genuine -inf turns the first block's ``exp(s - m)`` into exp(-inf + inf)
+# = NaN when a tile is fully masked; exp(-1e30 - x) just underflows to 0
+_MASK = -1e30
+
+_LANES = 128
+_DEF_BLOCK_Q = 128
+_DEF_BLOCK_K = 128
+
+
+def _pad_to(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, seq_len: int, block_k: int, n_k: int, masked: bool
+):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _MASK)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)  # (bq, D)
+    k = k_ref[0].astype(jnp.float32)  # (bk, D)
+    v = v_ref[0].astype(jnp.float32)
+    s = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        * scale
+    )  # (bq, bk) — scores live in VMEM only
+    if masked:  # the padded tail (from EITHER block size) carries phantom
+        # keys — mask any key position at or beyond the true sequence length
+        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < seq_len, s, _MASK)
+
+    m_prev = m_scr[...][:, :1]  # (bq, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_scr[...][:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    acc_scr[...] = acc_scr[...] * corr + pv
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        l = l_scr[...][:, :1]
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse = m_scr[...][:, :1] + jnp.log(l)  # (bq, 1)
+        lse_ref[0] = jnp.broadcast_to(lse.T, lse_ref.shape[1:])
+
+
+def _flash_fwd_3d(q3, k3, v3, scale: float, block_q: int, block_k: int):
+    """q3/k3/v3: ``(BH, S, D)`` → ``(out (BH, S, D), lse (BH, S))``."""
+    bh, seq, d = q3.shape
+    s_pad = _pad_to(seq, max(block_q, block_k))
+    d_pad = _pad_to(d, _LANES)
+    pad = [(0, 0), (0, s_pad - seq), (0, d_pad - d)]
+    q3, k3, v3 = (jnp.pad(a, pad) for a in (q3, k3, v3))
+    n_q, n_k = s_pad // block_q, s_pad // block_k
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale,
+        seq_len=seq,
+        block_k=block_k,
+        n_k=n_k,
+        masked=s_pad != seq,
+    )
+    out, lse8 = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, qi, ki: (b, qi, 0)),
+            # lse per q row, broadcast over 8 sublanes to satisfy tiling
+            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_pad, d_pad), q3.dtype),
+            jax.ShapeDtypeStruct((bh, 8, s_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d_pad), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=jax.default_backend() != "tpu",
+    )(q3, k3, v3)
+    return out[:, :seq, :d], lse8[:, 0, :seq]
+
+
+def _bwd_3d(scale, block_k, res, do):
+    """Blockwise flash backward (pure JAX, exact): scan over key blocks
+    using the saved logsumexp; peak memory O(S x block_k)."""
+    q3, k3, v3, out, lse = res
+    bh, seq, d = q3.shape
+    qf = q3.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s_pad = _pad_to(seq, block_k)
+    padk = [(0, 0), (0, s_pad - seq), (0, 0)]
+    kp = jnp.pad(k3.astype(jnp.float32), padk)
+    vp = jnp.pad(v3.astype(jnp.float32), padk)
+    kpos = jnp.arange(s_pad)
+    valid = (kpos < seq).astype(jnp.float32)
+    k_blocks = kp.reshape(bh, s_pad // block_k, block_k, d).swapaxes(0, 1)
+    v_blocks = vp.reshape(bh, s_pad // block_k, block_k, d).swapaxes(0, 1)
+    m_blocks = valid.reshape(s_pad // block_k, 1, 1, block_k)
+    d_i = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (BH, S)
+
+    def step(dq_acc, blk):
+        k_b, v_b, mask = blk  # (BH, bk, D), (1, 1, bk)
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_b) * scale
+        p = jnp.exp(s - lse[..., None]) * mask  # (BH, S, bk)
+        dv_b = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, v_b)
+        ds = p * (dp - d_i[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_b)
+        dk_b = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_acc, (dk_b, dv_b)
+
+    dq, (dk_s, dv_s) = jax.lax.scan(
+        step, jnp.zeros_like(qf), (k_blocks, v_blocks, m_blocks)
+    )
+    dk = dk_s.swapaxes(0, 1).reshape(bh, s_pad, d)[:, :seq]
+    dv = dv_s.swapaxes(0, 1).reshape(bh, s_pad, d)[:, :seq]
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_3d(q3, k3, v3, scale, block_q, block_k):
+    out, _ = _flash_fwd_3d(q3, k3, v3, scale, block_q, block_k)
+    return out
+
+
+def _flash_3d_fwd(q3, k3, v3, scale, block_q, block_k):
+    out, lse = _flash_fwd_3d(q3, k3, v3, scale, block_q, block_k)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _flash_3d_bwd(scale, block_q, block_k, res, do):
+    return _bwd_3d(scale, block_k, res, do)
+
+
+_flash_3d.defvjp(_flash_3d_fwd, _flash_3d_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    scale: Optional[float] = None,
+    block_q: int = _DEF_BLOCK_Q,
+    block_k: int = _DEF_BLOCK_K,
+) -> jnp.ndarray:
+    """Exact blockwise attention; drop-in for :func:`dense_attention`.
+
+    Shapes follow the flax convention: q/k/v ``(..., seq, heads,
+    head_dim)`` → ``(..., seq, heads, head_dim)``. Worth using when the
+    patch/sequence axis is long (the score matrix would be large); for
+    short sequences the tile padding makes ``dense_attention`` faster.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    *batch, seq, heads, head_dim = q.shape
+    bh = heads
+    for dim in batch:  # python shape math — jnp would trace it
+        bh *= int(dim)
+
+    def to3d(a):
+        moved = jnp.moveaxis(a, -2, -3)  # (..., heads, seq, head_dim)
+        return moved.reshape(bh, seq, head_dim)
+
+    out3 = _flash_3d(to3d(q), to3d(k), to3d(v), float(scale), block_q, block_k)
+    out = out3.reshape(*batch, heads, seq, head_dim)
+    return jnp.moveaxis(out, -3, -2)

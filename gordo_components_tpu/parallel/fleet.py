@@ -410,6 +410,23 @@ _PROGRAM_CACHE: dict = {}
 _PROGRAM_CACHE_MAX = 128  # distinct (spec, shape, mesh) programs kept live
 
 
+def _cached(cache: dict, max_size: int, key, build):
+    """FIFO-bounded memo shared by the program and executable caches; an
+    unhashable key (exotic spec member) just builds uncached."""
+    try:
+        hit = cache.get(key)
+    except TypeError:
+        return build()
+    if hit is not None:
+        return hit
+    value = build()
+    if len(cache) >= max_size:  # FIFO bound — a long-lived builder seeing
+        # many distinct configs must not pin every compiled artifact forever
+        cache.pop(next(iter(cache)))
+    cache[key] = value
+    return value
+
+
 def fleet_program(
     spec: FleetSpec,
     n_rows: int,
@@ -421,31 +438,22 @@ def fleet_program(
     repeated calls with the same spec/shape reuse the traced+compiled
     executable (``jax.jit`` keys on function identity — without this cache
     every ``train_fleet_arrays`` call would re-trace)."""
-    try:
-        key = (spec, n_rows, n_features, n_targets, mesh)
-        cached = _PROGRAM_CACHE.get(key)
-    except TypeError:  # unhashable spec member — fall back to fresh build
-        key = None
-        cached = None
-    if cached is not None:
-        return cached
-    program = jax.vmap(make_machine_program(spec, n_rows, n_features, n_targets))
-    if mesh is None:
-        jitted = jax.jit(program)
-    else:
+
+    def build():
+        program = jax.vmap(
+            make_machine_program(spec, n_rows, n_features, n_targets)
+        )
+        if mesh is None:
+            return jax.jit(program)
         shard = fleet_sharding(mesh)
-        jitted = jax.jit(
+        return jax.jit(
             program,
             in_shardings=(shard, shard, shard, shard),
             out_shardings=shard,
         )
-    if key is not None:
-        if len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:  # FIFO bound — a
-            # long-lived builder seeing many distinct configs must not pin
-            # every compiled executable forever
-            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
-        _PROGRAM_CACHE[key] = jitted
-    return jitted
+
+    key = (spec, n_rows, n_features, n_targets, mesh)
+    return _cached(_PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build)
 
 
 _EXEC_CACHE: dict = {}
@@ -475,31 +483,23 @@ def fleet_executable(
     backend has no layout API (the call path then falls back to plain
     ``device_put``).
     """
+    def build():
+        program = fleet_program(spec, n_rows, n_features, n_targets, mesh=mesh)
+        avatars = (
+            jax.ShapeDtypeStruct((n_machines, n_rows, n_features), jnp.float32),
+            jax.ShapeDtypeStruct((n_machines, n_rows, n_targets), jnp.float32),
+            jax.ShapeDtypeStruct((n_machines, n_rows), jnp.float32),
+            jax.ShapeDtypeStruct((n_machines, 2), jnp.uint32),
+        )
+        compiled = program.lower(*avatars).compile()
+        try:
+            formats = compiled.input_formats[0]
+        except (AttributeError, TypeError, IndexError):
+            formats = None
+        return compiled, formats
+
     key = (spec, n_machines, n_rows, n_features, n_targets, mesh)
-    try:
-        cached = _EXEC_CACHE.get(key)
-    except TypeError:
-        key, cached = None, None
-    if cached is not None:
-        return cached
-    program = fleet_program(spec, n_rows, n_features, n_targets, mesh=mesh)
-    avatars = (
-        jax.ShapeDtypeStruct((n_machines, n_rows, n_features), jnp.float32),
-        jax.ShapeDtypeStruct((n_machines, n_rows, n_targets), jnp.float32),
-        jax.ShapeDtypeStruct((n_machines, n_rows), jnp.float32),
-        jax.ShapeDtypeStruct((n_machines, 2), jnp.uint32),
-    )
-    compiled = program.lower(*avatars).compile()
-    try:
-        formats = compiled.input_formats[0]
-    except (AttributeError, TypeError, IndexError):
-        formats = None
-    entry = (compiled, formats)
-    if key is not None:
-        if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
-            _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
-        _EXEC_CACHE[key] = entry
-    return entry
+    return _cached(_EXEC_CACHE, _EXEC_CACHE_MAX, key, build)
 
 
 def put_fleet_batch(batch: MachineBatch, formats=None) -> MachineBatch:

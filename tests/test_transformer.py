@@ -241,7 +241,7 @@ def test_patchtst_ring_requires_divisible_patches():
 
 def test_patchtst_unknown_attention_impl_rejected():
     with pytest.raises(ValueError, match="attention_impl"):
-        get_factory("patchtst")(n_features=3, attention_impl="flash")
+        get_factory("patchtst")(n_features=3, attention_impl="sparse")
 
 
 def test_patchtst_d_model_heads_divisibility_rejected():
