@@ -35,6 +35,7 @@ dense path for that, as with ring attention.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -105,7 +106,10 @@ def _fwd_kernel(
 def _flash_fwd_3d(q3, k3, v3, scale: float, block_q: int, block_k: int):
     """q3/k3/v3: ``(BH, S, D)`` → ``(out (BH, S, D), lse (BH, S))``."""
     bh, seq, d = q3.shape
-    s_pad = _pad_to(seq, max(block_q, block_k))
+    # a common multiple of BOTH block sizes: padding to max() alone leaves
+    # trailing key blocks unvisited when block_k does not divide it
+    # (n_k floor-divides), silently dropping real keys from the softmax
+    s_pad = _pad_to(seq, math.lcm(block_q, block_k))
     d_pad = _pad_to(d, _LANES)
     pad = [(0, 0), (0, s_pad - seq), (0, d_pad - d)]
     q3, k3, v3 = (jnp.pad(a, pad) for a in (q3, k3, v3))

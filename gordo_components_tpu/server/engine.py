@@ -340,6 +340,21 @@ class ServingEngine:
             )
 
     # -- public API ----------------------------------------------------------
+    def warmup(self, rows: Optional[int] = None) -> int:
+        """Score one synthetic request per bucket so its program compiles
+        (and its stacked params land on device) before traffic arrives —
+        the first real request then pays dispatch, not XLA compile
+        (~20-40 s on TPU, far beyond any latency target). ``rows``: warm
+        the padded-row bucket real requests will hit (default: the
+        smallest row count each bucket can score). Returns the number of
+        buckets warmed."""
+        for bucket in self._buckets:
+            need = bucket.lookback + (bucket.lookahead or 0)
+            n = max(rows or 0, need, 1)
+            first = bucket.names[0]
+            self.anomaly(first, np.zeros((n, bucket.n_features), np.float32))
+        return len(self._buckets)
+
     def can_score(self, name: str) -> bool:
         return name in self._by_name
 

@@ -276,7 +276,12 @@ class ModelServer:
                         machines[name] = current
             removed = sorted(set(state.machines) - set(machines))
             if added or removed or refreshed:
-                self._state = _ServerState(machines)
+                new_state = _ServerState(machines)
+                # warm new/changed bucket programs BEFORE publishing the
+                # generation: the old state serves meanwhile, so no request
+                # ever races the compile (the reload POST waits instead)
+                self._warm_engine(new_state)
+                self._state = new_state
                 logger.info(
                     "Reload: +%d / -%d / refreshed %d -> %d machine(s)%s",
                     len(added),
@@ -292,6 +297,13 @@ class ModelServer:
                 "errors": errors,
                 "total": len(machines),
             }
+
+    @staticmethod
+    def _warm_engine(state: "_ServerState") -> None:
+        try:
+            state.engine.warmup()
+        except Exception:  # warm-up is best-effort; scoring still compiles
+            logger.warning("Post-reload engine warm-up failed", exc_info=True)
 
     # -- dispatch ------------------------------------------------------------
     def __call__(self, environ, start_response):
@@ -606,4 +618,17 @@ def run_server(
     from werkzeug.serving import run_simple
 
     app = build_app(model_dirs, project=project, models_root=models_root)
+    # compile each bucket's scoring program BEFORE accepting traffic: the
+    # first request must pay dispatch (ms), not XLA compile (tens of s).
+    # Best-effort — one broken bucket must not keep the healthy machines
+    # from serving (its own requests will surface the error)
+    try:
+        warmed = app.engine.warmup()
+    except Exception:
+        logger.warning("Serving engine warm-up failed", exc_info=True)
+    else:
+        if warmed:
+            logger.info(
+                "Serving engine warm: %d bucket program(s) compiled", warmed
+            )
     run_simple(host, port, app, threaded=True)

@@ -44,6 +44,16 @@ def test_flash_asymmetric_blocks():
     np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
 
 
+def test_flash_non_divisible_blocks():
+    """block_k not dividing block_q: padding must reach a common multiple
+    of both, or trailing key blocks are never visited (regression: keys
+    64-79 were silently dropped for block_q=96, block_k=64, seq=80)."""
+    q, k, v = _qkv((1, 80, 1, 8), seed=13)
+    ours = flash_attention(q, k, v, block_q=96, block_k=64)
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
+
+
 def test_flash_matches_dense_gradients():
     q, k, v = _qkv((1, 40, 2, 8), seed=3)
     g = jnp.asarray(

@@ -562,3 +562,35 @@ def test_negative_slice_size_rejected(tmp_path):
         data_config=_data_config(["n-a", "n-b", "n-c"]))]
     with pytest.raises(ValueError, match="slice_size"):
         build_fleet(machines, str(tmp_path / "o"), n_splits=2, slice_size=-1)
+
+
+def test_fleet_executable_formats_and_placement():
+    """fleet_executable AOT-compiles once per (spec, shape, mesh) and
+    put_fleet_batch coerces host dtypes (float64 data, typed PRNG keys)
+    before placement — AOT executables are strict where jit would coerce."""
+    from gordo_components_tpu.parallel.fleet import (
+        fleet_executable,
+        put_fleet_batch,
+    )
+
+    spec, batch = _make_spec_and_batch(4, n_rows=128)
+    compiled, formats = fleet_executable(spec, 4, 128, 3, 3)
+    again, _ = fleet_executable(spec, 4, 128, 3, 3)
+    assert compiled is again, "executable cache must hit on identical key"
+
+    sloppy = MachineBatch(
+        X=np.asarray(batch.X, np.float64),  # float64 data (raw pandas .values)
+        y=np.asarray(batch.y, np.float64),
+        w=np.asarray(batch.w, np.float64),
+        keys=jax.random.split(jax.random.key(0), 4),  # typed keys
+    )
+    placed = put_fleet_batch(sloppy, formats)
+    assert placed.X.dtype == np.float32
+    assert placed.keys.dtype == np.uint32
+    result = compiled(placed.X, placed.y, placed.w, placed.keys)
+    assert np.isfinite(np.asarray(result.loss_history)).all()
+
+    # formats=None fallback (backends without the layout API) still executes
+    placed2 = put_fleet_batch(batch, None)
+    result2 = compiled(placed2.X, placed2.y, placed2.w, placed2.keys)
+    assert np.isfinite(np.asarray(result2.loss_history)).all()

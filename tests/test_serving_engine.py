@@ -230,3 +230,15 @@ def test_concurrent_requests_micro_batch(fitted_pair):
     assert len(results) == 16
     for (name, _), total in results.items():
         np.testing.assert_allclose(total, sequential[name], atol=1e-4)
+
+
+def test_engine_warmup_compiles_bucket_programs(fitted_pair):
+    engine = ServingEngine({name: m for name, (m, _) in fitted_pair.items()})
+    assert engine.stats()["compiled_programs"] == 0
+    warmed = engine.warmup()
+    assert warmed == engine.stats()["buckets"]
+    assert engine.stats()["compiled_programs"] >= warmed
+    # warm again: idempotent, no new programs for the same shapes
+    before = engine.stats()["compiled_programs"]
+    engine.warmup()
+    assert engine.stats()["compiled_programs"] == before
