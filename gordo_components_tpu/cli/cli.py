@@ -123,7 +123,11 @@ def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
 @click.option("--model-register-dir", envvar="MODEL_REGISTER_DIR", default=None)
 @click.option("--n-devices", default=None, type=int,
               help="mesh size (default: all available devices)")
-@click.option("--n-splits", default=3, show_default=True)
+@click.option("--n-splits", default=3, show_default=True,
+              help="cross-validation folds for machines that do not set "
+                   "their own evaluation.n_splits in the fleet YAML "
+                   "(per-machine/globals evaluation takes precedence over "
+                   "this flag, mirroring the reference's config hierarchy)")
 @click.option("--seed", default=0, show_default=True)
 @click.option("--slice-size", default=256, show_default=True, type=int,
               help="machines per checkpointed slice within a bucket: each "
@@ -146,6 +150,7 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
                 model_config=machine.model,
                 data_config=machine.dataset,
                 metadata=machine.metadata,
+                evaluation=machine.evaluation,
             )
             for machine in config.machines
         ]

@@ -228,6 +228,38 @@ class FleetMachineConfig:
     model_config: Dict[str, Any]
     data_config: Dict[str, Any]
     metadata: Dict[str, Any] = field(default_factory=dict)
+    # per-machine evaluation overrides (the reference's Machine.evaluation):
+    # ``n_splits`` here beats build_fleet's global — machines with different
+    # CV depths land in different compilation buckets
+    evaluation: Dict[str, Any] = field(default_factory=dict)
+
+
+def _effective_splits(
+    machine: "FleetMachineConfig", default: int
+) -> Tuple[int, List[str]]:
+    """Resolve the machine's CV depth: ``evaluation.n_splits`` beats the
+    builder default (``None``/absent means "use the default"). Returns the
+    keys the fleet builder does NOT honor (e.g. ``cv_mode`` — always
+    ``"fleet"`` here) so the caller can surface them instead of silently
+    dropping config."""
+    evaluation = machine.evaluation or {}
+    value = evaluation.get("n_splits")
+    if value is None:
+        eff = int(default)
+    else:
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise ValueError(
+                f"Machine {machine.name!r}: evaluation.n_splits must be an "
+                f"integer, got {value!r}"
+            )
+        if value < 0:
+            raise ValueError(
+                f"Machine {machine.name!r}: evaluation.n_splits must be >= 0, "
+                f"got {value}"
+            )
+        eff = value
+    ignored = sorted(k for k in evaluation if k != "n_splits")
+    return eff, ignored
 
 
 def _scaler_kind(
@@ -390,9 +422,13 @@ def build_fleet(
     timer = PhaseTimer()
     started = time.perf_counter()
     results: Dict[str, str] = {}
-    pending: List[Tuple[FleetMachineConfig, str]] = []
-    evaluation_config = {"n_splits": n_splits, "cv_mode": "fleet"}
+    pending: List[Tuple[FleetMachineConfig, str, int]] = []
+    ignored_eval: Dict[str, List[str]] = {}
     for machine in machines:
+        eff_splits, ignored = _effective_splits(machine, n_splits)
+        if ignored:
+            ignored_eval[machine.name] = ignored
+        evaluation_config = {"n_splits": eff_splits, "cv_mode": "fleet"}
         cache_key = calculate_model_key(
             machine.name,
             machine.model_config,
@@ -405,21 +441,30 @@ def build_fleet(
                 logger.info("Fleet cache hit for %r -> %s", machine.name, cached)
                 results[machine.name] = cached
                 continue
-        pending.append((machine, cache_key))
+        pending.append((machine, cache_key, eff_splits))
+    if ignored_eval:
+        sample = dict(list(ignored_eval.items())[:5])
+        logger.warning(
+            "Fleet builder ignores unsupported evaluation keys on %d "
+            "machine(s) (cv_mode is always 'fleet' here): %s%s",
+            len(ignored_eval),
+            sample,
+            " ..." if len(ignored_eval) > 5 else "",
+        )
 
     manifest: Dict[str, Dict[str, Any]] = {
         name: {"status": "cached", "model_dir": path}
         for name, path in results.items()
     }
     _write_manifest(
-        output_dir, manifest, [m.name for m, _ in pending]
+        output_dir, manifest, [m.name for m, *_ in pending]
     )
 
     # ---- bucket by (model config, feature/target width) BEFORE fetching:
     # widths come from the dataset's declared columns, so peak host memory
     # is one bucket's data, not the whole fleet's ---------------------------
     buckets: Dict[str, List[dict]] = {}
-    for machine, cache_key in pending:
+    for machine, cache_key, eff_splits in pending:
         dataset = _dataset_from_config(machine.data_config)
         item: dict = {
             "machine": machine,
@@ -437,11 +482,13 @@ def build_fleet(
             item["y"] = np.asarray(getattr(y_probe, "values", y_probe), np.float32)
             item["dataset_metadata"] = dataset.get_metadata()
         item["F"], item["T"] = n_features, n_targets
+        item["n_splits"] = eff_splits
         sig = json.dumps(
             {
                 "model_config": machine.model_config,
                 "F": n_features,
                 "T": n_targets,
+                "n_splits": item["n_splits"],
             },
             sort_keys=True,
             default=str,
@@ -461,7 +508,8 @@ def build_fleet(
             analyzed = _analyze_model(probe)
             n_features = items[0]["F"]
             n_targets = items[0]["T"]
-            spec = _spec_for(analyzed, n_features, n_targets, n_splits)
+            bucket_splits = items[0]["n_splits"]
+            spec = _spec_for(analyzed, n_features, n_targets, bucket_splits)
 
             # ---- slice the bucket: each slice is an independent failure domain
             # with its own data fetch, train call, and artifact writes. All
@@ -563,7 +611,7 @@ def build_fleet(
                         machine = item["machine"]
                         model = pipeline_from_definition(machine.model_config)
                         _install_result(
-                            model, result, i, n_features, n_targets, n_splits
+                            model, result, i, n_features, n_targets, bucket_splits
                         )
                         model_dir = os.path.join(output_dir, machine.name)
                         # same metadata contract as the single-machine builder
@@ -581,7 +629,7 @@ def build_fleet(
                                     if hasattr(model, "get_metadata")
                                     else {}
                                 ),
-                                "cross_validation": _cv_metadata(result, i, n_splits),
+                                "cross_validation": _cv_metadata(result, i, bucket_splits),
                                 "model_training_duration_s": amortized,
                                 "model_creation_date": time.strftime(
                                     "%Y-%m-%d %H:%M:%S%z"
@@ -614,7 +662,7 @@ def build_fleet(
                     _write_manifest(
                         output_dir,
                         manifest,
-                        [name for name in (m.name for m, _ in pending) if name not in manifest],
+                        [name for name in (m.name for m, *_ in pending) if name not in manifest],
                     )
                 with timer.phase("checkpoint_wait"):
                     # artifacts durable → join the async save and drop the ckpt

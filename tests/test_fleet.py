@@ -594,3 +594,61 @@ def test_fleet_executable_formats_and_placement():
     placed2 = put_fleet_batch(batch, None)
     result2 = compiled(placed2.X, placed2.y, placed2.w, placed2.keys)
     assert np.isfinite(np.asarray(result2.loss_history)).all()
+
+
+def test_per_machine_evaluation_n_splits(tmp_path):
+    """A machine's ``evaluation: {n_splits: N}`` (reference Machine
+    semantics) overrides build_fleet's global — machines with different CV
+    depths land in different buckets and their metadata records their own
+    fold count."""
+    machines = [
+        FleetMachineConfig(
+            name="deep-cv",
+            model_config=MODEL_CONFIG,
+            data_config=_data_config(["a", "b", "c"]),
+            evaluation={"n_splits": 4},
+        ),
+        FleetMachineConfig(
+            name="default-cv",
+            model_config=MODEL_CONFIG,
+            data_config=_data_config(["a", "b", "c"]),
+        ),
+    ]
+    results = build_fleet(
+        machines, str(tmp_path / "out"), mesh=None, n_splits=2
+    )
+    deep = load_metadata(results["deep-cv"])
+    default = load_metadata(results["default-cv"])
+    assert deep["model"]["cross_validation"]["n_splits"] == 4
+    assert len(deep["model"]["cross_validation"]["splits"]) == 4
+    assert default["model"]["cross_validation"]["n_splits"] == 2
+    assert len(default["model"]["cross_validation"]["splits"]) == 2
+
+
+def test_evaluation_n_splits_validation(tmp_path):
+    """Non-integer evaluation.n_splits is a config error (ValueError -> the
+    CLI's EXIT_CONFIG path), not a raw TypeError; None means 'use default';
+    unsupported evaluation keys are surfaced, not silently dropped."""
+    def machine(name, evaluation):
+        return FleetMachineConfig(
+            name=name,
+            model_config=MODEL_CONFIG,
+            data_config=_data_config(["a", "b", "c"]),
+            evaluation=evaluation,
+        )
+
+    with pytest.raises(ValueError, match="n_splits must be an integer"):
+        build_fleet([machine("bad", {"n_splits": "three"})], str(tmp_path / "o1"))
+    with pytest.raises(ValueError, match="n_splits must be an integer"):
+        build_fleet([machine("badf", {"n_splits": 2.5})], str(tmp_path / "o2"))
+    with pytest.raises(ValueError, match="n_splits must be >= 0"):
+        build_fleet([machine("neg", {"n_splits": -1})], str(tmp_path / "o3"))
+
+    # None -> builder default; unsupported keys warn but build proceeds
+    results = build_fleet(
+        [machine("null-splits", {"n_splits": None, "cv_mode": "cross_val_only"})],
+        str(tmp_path / "o4"),
+        n_splits=2,
+    )
+    meta = load_metadata(results["null-splits"])
+    assert meta["model"]["cross_validation"]["n_splits"] == 2
