@@ -43,7 +43,12 @@ from ..models.transformers import MinMaxScaler, StandardScaler
 from ..ops.scaling import ScalerParams
 from ..serializer import dump, pipeline_from_definition
 from ..utils import disk_registry
-from .fleet import FleetSpec, MachineBatch, train_fleet_arrays
+from .fleet import (
+    FLEET_CV_METRICS,
+    FleetSpec,
+    MachineBatch,
+    train_fleet_arrays,
+)
 from .mesh import pad_to_multiple
 
 logger = logging.getLogger(__name__)
@@ -359,28 +364,32 @@ def _install_result(
 
 
 def _cv_metadata(result, i: int, n_splits: int) -> Dict[str, Any]:
-    """Per-machine CV record; NaN fold scores (fold had no real rows for
-    this machine) are reported as null, never averaged in."""
-    cv_scores = np.asarray(result.cv_scores[i])
-    real = cv_scores[np.isfinite(cv_scores)]
+    """Per-machine CV record with the same metric keys the single-machine
+    builder emits (models.metrics.METRICS); NaN fold scores (fold had no
+    real rows for this machine) are reported as null, never averaged in."""
+    cv_scores = np.asarray(result.cv_scores[i])  # (n_splits, n_metrics)
+
+    def val(s):
+        return float(s) if np.isfinite(s) else None
+
+    aggregates = {}
+    for m, name in enumerate(FLEET_CV_METRICS):
+        col = cv_scores[:, m]
+        real = col[np.isfinite(col)]
+        aggregates[name] = float(np.mean(real)) if len(real) else None
     return {
         "n_splits": n_splits,
         "splits": [
             {
                 "fold": k,
                 "scores": {
-                    "explained_variance_score": (
-                        float(s) if np.isfinite(s) else None
-                    )
+                    name: val(fold[m])
+                    for m, name in enumerate(FLEET_CV_METRICS)
                 },
             }
-            for k, s in enumerate(cv_scores)
+            for k, fold in enumerate(cv_scores)
         ],
-        "scores": {
-            "explained_variance_score": (
-                float(np.mean(real)) if len(real) else None
-            )
-        },
+        "scores": aggregates,
     }
 
 

@@ -88,7 +88,8 @@ class MachineResult(NamedTuple):
     target_scaler: ScalerParams  # (T,)
     error_scaler: ScalerParams  # (T,) minmax over |raw residuals|
     loss_history: jnp.ndarray  # (epochs,)
-    cv_scores: jnp.ndarray  # (n_splits,) masked explained variance (or (0,))
+    # (n_splits, len(FLEET_CV_METRICS)) masked fold metrics (or (0, 4))
+    cv_scores: jnp.ndarray
     tag_thresholds: jnp.ndarray  # (T,) 99th pct of scaled residuals
     total_threshold: jnp.ndarray  # () 99th pct of residual L2 norms
 
@@ -135,20 +136,43 @@ def _fit_scaler(kind: str, options, feature_range, x, w) -> ScalerParams:
     raise ValueError(f"Unknown scaler kind {kind!r}")
 
 
-def _masked_explained_variance(y, pred, w) -> jnp.ndarray:
-    """Weighted explained variance; NaN when the fold has no real rows (so
-    empty folds report as missing, never as a fake perfect score)."""
+# column order of the per-fold metric vector the compiled program emits —
+# the same four metrics (sklearn ``uniform_average`` semantics) the
+# single-machine builder records via models.metrics.METRICS, so fleet and
+# single builds expose identical CV metadata keys
+FLEET_CV_METRICS = (
+    "explained_variance_score",
+    "r2_score",
+    "mean_squared_error",
+    "mean_absolute_error",
+)
+
+
+def _masked_metrics(y, pred, w) -> jnp.ndarray:
+    """Weighted fold metrics in :data:`FLEET_CV_METRICS` order, NaN when the
+    fold has no real rows (empty folds report as missing, never as a fake
+    perfect score). Per-output scores average uniformly across outputs,
+    matching sklearn ``multioutput="uniform_average"`` (pinned against
+    sklearn by tests/test_fleet_parity.py)."""
     w_total = jnp.sum(w)
     wsum = jnp.maximum(w_total, 1.0)
     wcol = w[:, None]
     diff = y - pred
+    # explained variance: 1 - Var(residual)/Var(y)
     dmean = jnp.sum(diff * wcol, axis=0) / wsum
     dvar = jnp.sum((diff - dmean) ** 2 * wcol, axis=0) / wsum
     ymean = jnp.sum(y * wcol, axis=0) / wsum
     yvar = jnp.sum((y - ymean) ** 2 * wcol, axis=0) / wsum
     ev = 1.0 - dvar / jnp.where(yvar < _EPS, 1.0, yvar)
-    score = jnp.mean(jnp.where(yvar < _EPS, jnp.where(dvar < _EPS, 1.0, 0.0), ev))
-    return jnp.where(w_total > 0, score, jnp.nan)
+    ev = jnp.mean(jnp.where(yvar < _EPS, jnp.where(dvar < _EPS, 1.0, 0.0), ev))
+    # r2: 1 - SS_res/SS_tot (not mean-adjusted residuals)
+    ss_res = jnp.sum(diff**2 * wcol, axis=0) / wsum
+    r2 = 1.0 - ss_res / jnp.where(yvar < _EPS, 1.0, yvar)
+    r2 = jnp.mean(jnp.where(yvar < _EPS, jnp.where(ss_res < _EPS, 1.0, 0.0), r2))
+    mse = jnp.mean(jnp.sum(diff**2 * wcol, axis=0) / wsum)
+    mae = jnp.mean(jnp.sum(jnp.abs(diff) * wcol, axis=0) / wsum)
+    scores = jnp.stack([ev, r2, mse, mae])
+    return jnp.where(w_total > 0, scores, jnp.nan)
 
 
 def timeseries_fold_masks(wt: jnp.ndarray, n_splits: int):
@@ -339,8 +363,8 @@ def make_machine_program(
                 emax = jnp.maximum(
                     emax, jnp.max(jnp.where(mask, err, -jnp.inf), axis=0)
                 )
-                score = _masked_explained_variance(raw_targets, pred_raw, wtest)
-                return (emin, emax), (score, err, wtest)
+                scores = _masked_metrics(raw_targets, pred_raw, wtest)
+                return (emin, emax), (scores, err, wtest)
 
             (emin, emax), (cv_scores, fold_errors, fold_test_masks) = (
                 jax.lax.scan(
@@ -350,7 +374,7 @@ def make_machine_program(
                 )
             )
         else:
-            cv_scores = jnp.zeros((0,))
+            cv_scores = jnp.zeros((0, len(FLEET_CV_METRICS)))
             fold_errors = jnp.zeros((0, n_points, n_targets))
             fold_test_masks = jnp.zeros((0, n_points))
 

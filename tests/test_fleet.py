@@ -74,7 +74,7 @@ def test_fleet_trains_stacked_machines():
     result = train_fleet_arrays(spec, batch)
     # stacked shapes: leading machine axis everywhere
     assert result.loss_history.shape == (4, spec.epochs)
-    assert result.cv_scores.shape == (4, 2)
+    assert result.cv_scores.shape == (4, 2, 4)  # machines, folds, metrics
     assert result.input_scaler.scale.shape == (4, 3)
     assert result.error_scaler.scale.shape == (4, 3)
     leaves = jax.tree_util.tree_leaves(result.params)

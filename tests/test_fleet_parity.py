@@ -224,6 +224,13 @@ def test_cv_scores_comparable(both_builds):
     # easy synthetic dataset, and agree with each other within 0.15
     assert ev_s > 0.5 and ev_f > 0.5
     assert abs(ev_s - ev_f) < 0.15, f"CV scores diverge: {ev_s} vs {ev_f}"
+    # the fleet program emits the SAME four metric keys as the single
+    # builder, and each agrees within tolerance (r2 <= ev by definition)
+    for name, tol in [("r2_score", 0.2), ("mean_absolute_error", 0.05),
+                      ("mean_squared_error", 0.05)]:
+        s, f = meta_s["scores"][name], meta_f["scores"][name]
+        assert f is not None, name
+        assert abs(s - f) < tol, f"{name} diverges: {s} vs {f}"
 
 
 def test_thresholds_same_scale(both_builds):
