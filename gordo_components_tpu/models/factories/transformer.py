@@ -144,6 +144,11 @@ class PatchTSTModule(nn.Module):
     out_func: str = "linear"
     compute_dtype: Any = "float32"
     attention_impl: str = "dense"
+    # rematerialize encoder layers on the backward pass: activations are
+    # recomputed instead of stored, trading ~1 extra forward of FLOPs for
+    # O(n_layers) less HBM — the standard lever for plant-scale configs
+    # (10k tags x long windows) whose activations otherwise exceed HBM
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -169,15 +174,25 @@ class PatchTSTModule(nn.Module):
         )
         h = h + pos.astype(dtype)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
-        for _ in range(self.n_layers):
-            h = TransformerEncoderLayer(
+        layer_cls = (
+            nn.remat(TransformerEncoderLayer, static_argnums=(2,))
+            if self.remat
+            else TransformerEncoderLayer
+        )
+        for i in range(self.n_layers):
+            # explicit names pin the param tree: nn.remat renames the class
+            # (Checkpoint...), and auto-scoping would give remat=True a
+            # different tree than remat=False — breaking artifact loads
+            # that flip the flag (remat is a memory knob, not a new model)
+            h = layer_cls(
                 d_model=self.d_model,
                 n_heads=self.n_heads,
                 ff_dim=self.ff_dim,
                 dropout=self.dropout,
                 compute_dtype=self.compute_dtype,
                 attention_impl=self.attention_impl,
-            )(h, deterministic=deterministic)
+                name=f"TransformerEncoderLayer_{i}",
+            )(h, deterministic)
         h = nn.LayerNorm(dtype=dtype)(h)
         flat = h.reshape(batch, n_features, n_patches * self.d_model)
         out = nn.Dense(1, dtype=dtype)(flat)[..., 0]  # per-channel head (B, F)
@@ -204,6 +219,7 @@ def patchtst(
     loss: str = "mse",
     compute_dtype: str = "float32",
     attention_impl: str = "dense",
+    remat: bool = False,
     **unknown: Any,
 ) -> ModelSpec:
     _reject_unknown("patchtst", unknown)
@@ -247,6 +263,7 @@ def patchtst(
         out_func=out_func,
         compute_dtype=compute_dtype,
         attention_impl=attention_impl,
+        remat=remat,
     )
     config = {
         "n_features": n_features,
@@ -265,6 +282,7 @@ def patchtst(
         "loss": loss,
         "compute_dtype": compute_dtype,
         "attention_impl": attention_impl,
+        "remat": remat,
     }
     return ModelSpec(
         module=module,

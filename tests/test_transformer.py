@@ -247,3 +247,27 @@ def test_patchtst_unknown_attention_impl_rejected():
 def test_patchtst_d_model_heads_divisibility_rejected():
     with pytest.raises(ValueError, match="divisible by n_heads"):
         get_factory("patchtst")(n_features=3, d_model=18, n_heads=4)
+
+
+def test_patchtst_remat_same_values_and_grads():
+    """remat=True recomputes encoder activations on backward (HBM lever for
+    plant-scale configs) without changing outputs or gradients."""
+    kwargs = dict(n_features=3, lookback_window=16, patch_length=4, stride=4,
+                  d_model=16, n_heads=2, n_layers=2)
+    plain = get_factory("patchtst")(**kwargs)
+    remat = get_factory("patchtst")(**kwargs, remat=True)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 16, 3)), jnp.float32)
+    params = plain.module.init(jax.random.PRNGKey(0), x, deterministic=True)
+
+    def loss(mod):
+        return lambda p: jnp.sum(
+            mod.apply(p, x, deterministic=True) ** 2
+        )
+
+    out_p, out_r = (m.module.apply(params, x, deterministic=True)
+                    for m in (plain, remat))
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_p), atol=1e-6)
+    g_p = jax.grad(loss(plain.module))(params)
+    g_r = jax.grad(loss(remat.module))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_p), jax.tree_util.tree_leaves(g_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
