@@ -1,0 +1,80 @@
+"""bench.py smoke: the driver runs it at round end — a broken bench means
+a missing benchmark artifact, so its measurement core and JSON schema are
+guarded here on a tiny CPU config."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+
+REQUIRED_CONFIG_KEYS = {
+    "machines_per_hour",
+    "machines_per_hour_serial",
+    "vs_single_machine",
+    "exec_s",
+    "ingest_s",
+    "ingest_mb",
+    "compile_s",
+    "single_machine_s",
+}
+
+
+@pytest.mark.slow
+def test_bench_emits_valid_json_with_split_measurements(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        env={
+            "PATH": "/usr/bin:/bin",
+            "HOME": str(tmp_path),
+            "BENCH_CPU": "1",
+            "BENCH_CONFIGS": "dense_ae_10tag",
+            "BENCH_MACHINES": "2",
+            "BENCH_EPOCHS": "2",
+            "JAX_PLATFORMS": "cpu",
+        },
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # ONE JSON line on stdout (the driver contract)
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["metric"] == "machines_trained_per_hour"
+    assert payload["value"] > 0
+    assert isinstance(payload["vs_baseline"], (int, float))
+    cfg = payload["configs"]["dense_ae_10tag"]
+    assert REQUIRED_CONFIG_KEYS <= set(cfg)
+    assert cfg["exec_s"] > 0 and cfg["compile_s"] > 0
+    # execution must be measured separately from ingest: the serial rate
+    # can never exceed the execution-only rate
+    assert cfg["machines_per_hour_serial"] <= cfg["machines_per_hour"]
+
+
+@pytest.mark.slow
+def test_bench_serving_emits_valid_json(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "bench_serving.py"],
+        env={
+            "PATH": "/usr/bin:/bin",
+            "HOME": str(tmp_path),
+            "BENCH_CPU": "1",
+            "BENCH_SERVE_MACHINES": "4",
+            "BENCH_SERVE_REQUESTS": "8",
+            "JAX_PLATFORMS": "cpu",
+        },
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["metric"] == "serving_p50_ms"
+    assert payload["value"] > 0
+    assert payload["end_to_end_p50_ms"] >= 0
+    assert payload["compiled_programs"] >= 1
