@@ -222,11 +222,21 @@ def run_server_cmd(model_dirs, models_dir, host, port, project):
 @click.option("--target-url", required=True)
 @click.option("--host", default="0.0.0.0", show_default=True)
 @click.option("--port", default=5556, show_default=True)
-def run_watchman_cmd(project, machines, target_url, host, port):
+@click.option("--manifest", default=None,
+              help="path to a fleet build's fleet_manifest.json; GET / then "
+                   "also reports build progress (completed/pending) from it")
+def run_watchman_cmd(project, machines, target_url, host, port, manifest):
     """Serve the fleet-health aggregator."""
     from ..watchman import run_watchman
 
-    run_watchman(project, list(machines), target_url, host=host, port=port)
+    run_watchman(
+        project,
+        list(machines),
+        target_url,
+        host=host,
+        port=port,
+        manifest_path=manifest,
+    )
 
 
 @gordo.group("workflow")

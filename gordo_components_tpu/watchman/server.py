@@ -32,12 +32,20 @@ class WatchmanServer:
         target_url: Optional[str] = None,
         timeout: float = 5.0,
         max_poll_workers: int = 32,
+        manifest_path: Optional[str] = None,
     ):
         """``machines``: list of names served at ``target_url``, or an
         explicit ``{machine: base_url}`` map. Health polls fan out over a
         thread pool of ``max_poll_workers`` so a 1000-machine fleet with a
         few dead endpoints answers ``GET /`` in ~``timeout`` seconds, not
-        ``n_dead * timeout``."""
+        ``n_dead * timeout``.
+
+        ``manifest_path``: a fleet build's ``fleet_manifest.json``; when
+        given, ``GET /`` also reports build progress (completed/pending
+        counts and the pending names) read from the manifest — the
+        reference's later watchman evolution replaced HTTP polling with
+        k8s CRD status; the manifest is this rebuild's equivalent build
+        source of truth (rewritten atomically after every slice)."""
         if isinstance(machines, dict):
             self.machine_urls = dict(machines)
         else:
@@ -49,6 +57,7 @@ class WatchmanServer:
         self.project = project
         self.timeout = timeout
         self.max_poll_workers = max(1, int(max_poll_workers))
+        self.manifest_path = manifest_path
 
     def _check(self, machine: str, base_url: str) -> Dict:
         import requests
@@ -70,6 +79,27 @@ class WatchmanServer:
             "latency_ms": (time.perf_counter() - started) * 1000,
         }
 
+    def _build_progress(self) -> Optional[Dict]:
+        """Summary of the fleet build manifest, or an error record when the
+        path is set but unreadable (a monitor must see that the manifest is
+        gone, not a silently vanished field)."""
+        if not self.manifest_path:
+            return None
+        try:
+            with open(self.manifest_path) as fh:
+                manifest = json.load(fh)
+            pending = manifest.get("pending") or []
+            return {
+                "updated": manifest.get("updated"),
+                "n_completed": manifest.get("n_completed"),
+                "n_pending": manifest.get("n_pending"),
+                "pending": pending[:50],  # capped for 10k fleets
+            }
+        except (OSError, ValueError, AttributeError, TypeError) as exc:
+            # wrong-shaped JSON (top-level list, null pending) must degrade
+            # to an error field, not take the whole health view down
+            return {"error": f"manifest unreadable: {exc}"}
+
     def status(self) -> Dict:
         targets = sorted(self.machine_urls.items())
         workers = min(self.max_poll_workers, max(1, len(targets)))
@@ -77,11 +107,15 @@ class WatchmanServer:
             endpoints: List[Dict] = list(
                 pool.map(lambda mu: self._check(*mu), targets)
             )
-        return {
+        body = {
             "project-name": self.project,
             "ok": all(e["healthy"] for e in endpoints),
             "endpoints": endpoints,
         }
+        build = self._build_progress()
+        if build is not None:
+            body["build"] = build
+        return body
 
     def __call__(self, environ, start_response):
         request = Request(environ)
@@ -102,8 +136,11 @@ def build_watchman_app(
     project: str,
     machines: Union[Sequence[str], Dict[str, str]],
     target_url: Optional[str] = None,
+    manifest_path: Optional[str] = None,
 ) -> WatchmanServer:
-    return WatchmanServer(project, machines, target_url)
+    return WatchmanServer(
+        project, machines, target_url, manifest_path=manifest_path
+    )
 
 
 def run_watchman(
@@ -112,7 +149,14 @@ def run_watchman(
     target_url: Optional[str] = None,
     host: str = "0.0.0.0",
     port: int = 5556,
+    manifest_path: Optional[str] = None,
 ) -> None:
     from werkzeug.serving import run_simple
 
-    run_simple(host, port, build_watchman_app(project, machines, target_url))
+    run_simple(
+        host,
+        port,
+        build_watchman_app(
+            project, machines, target_url, manifest_path=manifest_path
+        ),
+    )

@@ -184,3 +184,45 @@ def test_influx_forwarder_with_injected_client():
     )
     forwarder.forward("mach-9", frame)
     assert written == [("anomaly", {"machine": "mach-9"}, 2)]
+
+
+def test_watchman_reports_build_progress(served, tmp_path):
+    """With a manifest path, GET / also reports fleet build progress (the
+    build-source-of-truth view that replaces per-endpoint polling for
+    not-yet-served machines); an unreadable manifest is surfaced as an
+    error field, never silently dropped."""
+    import json as _json
+
+    from werkzeug.test import Client as TestClient
+
+    manifest = tmp_path / "fleet_manifest.json"
+    manifest.write_text(_json.dumps({
+        "updated": "2026-07-30 00:00:00+0000",
+        "n_completed": 2,
+        "n_pending": 1,
+        "machines": {"mach-1": {"status": "completed"},
+                     "mach-2": {"status": "completed"}},
+        "pending": ["mach-3"],
+    }))
+    app = build_watchman_app("proj", ["mach-1"], target_url=served,
+                             manifest_path=str(manifest))
+    body = TestClient(app).get("/").get_json()
+    assert body["build"]["n_completed"] == 2
+    assert body["build"]["pending"] == ["mach-3"]
+
+    gone = build_watchman_app("proj", ["mach-1"], target_url=served,
+                              manifest_path=str(tmp_path / "missing.json"))
+    body = TestClient(gone).get("/").get_json()
+    assert "error" in body["build"]
+
+
+def test_watchman_wrong_shape_manifest_degrades(served, tmp_path):
+    from werkzeug.test import Client as TestClient
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2, 3]")  # valid JSON, wrong shape
+    app = build_watchman_app("proj", ["mach-1"], target_url=served,
+                             manifest_path=str(bad))
+    body = TestClient(app).get("/").get_json()
+    assert "error" in body["build"]
+    assert body["endpoints"], "health view must survive a bad manifest"
