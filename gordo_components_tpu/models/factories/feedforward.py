@@ -44,7 +44,7 @@ def hourglass_calc_dims(
     """Linearly interpolated layer dims from ``n_features`` down to
     ``n_features * compression_factor`` over ``encoding_layers`` layers.
 
-    Pinned golden values (tests/test_factories.py): ``(0.5, 3, 10) →
+    Pinned golden values (tests/test_models.py): ``(0.5, 3, 10) →
     (8, 7, 5)`` — the contract the reference's own unit tests assert.
     """
     if not 0 <= compression_factor <= 1:
