@@ -99,8 +99,7 @@ def test_patchtst_in_anomaly_pipeline(X):
     assert isinstance(round_tripped, DiffBasedAnomalyDetector)
 
 
-def test_patchtst_fleet_bucket():
-    """Transformer machines train in the fleet engine like any other kind."""
+def _fleet_bucket_history(attention_impl):
     config = {
         "DiffBasedAnomalyDetector": {
             "base_estimator": {
@@ -108,7 +107,8 @@ def test_patchtst_fleet_bucket():
                     "regressor": {"PatchTSTAutoEncoder": {
                         "lookback_window": 16, "patch_length": 8,
                         "d_model": 16, "n_heads": 2, "n_layers": 1,
-                        "epochs": 1, "batch_size": 32}},
+                        "epochs": 1, "batch_size": 32,
+                        "attention_impl": attention_impl}},
                     "transformer": "MinMaxScaler",
                 }
             }
@@ -123,7 +123,21 @@ def test_patchtst_fleet_bucket():
         MachineBatch(X=Xs, y=Xs.copy(), w=np.ones((2, 128), np.float32),
                      keys=jax.random.split(jax.random.PRNGKey(0), 2)),
     )
-    assert np.isfinite(np.asarray(result.loss_history)).all()
+    history = np.asarray(result.loss_history)
+    assert np.isfinite(history).all()
+    return history
+
+
+@pytest.mark.slow
+def test_patchtst_fleet_bucket_dense_and_flash_agree():
+    """Transformer machines train in the fleet engine like any other kind,
+    with either attention impl — and since dense and flash are the same
+    math, the vmapped training trajectories must MATCH numerically (a
+    mis-batched pallas grid dim or custom-VJP under vmap would train to a
+    finite but different loss and slip past a finiteness check)."""
+    dense = _fleet_bucket_history("dense")
+    flash = _fleet_bucket_history("flash")
+    np.testing.assert_allclose(flash, dense, rtol=1e-3, atol=1e-5)
 
 
 # ------------------------------------------------------------ ring attention
