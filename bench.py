@@ -8,7 +8,8 @@ numbers — BASELINE.json ``published: {}`` — so the anchors are measured):
   machines, full build per machine (scaler fits, k-fold masked CV,
   error-scaler fit, final fit) in ONE compiled vmap program.
 - ``lstm_ae_50tag`` (config 2): windowed LSTM reconstruction fleet.
-- ``lstm_forecast_100tag`` (config 3): LSTM one-step forecast fleet.
+- ``lstm_forecast_100tag`` (config 3): LSTM multi-step (3-step-ahead)
+  forecast fleet.
 - ``patchtst_bf16`` (config 5, scaled): PatchTST anomaly head with
   bfloat16 compute. The "10k-tag plant" is represented as 256 tags/machine
   by default so the driver-run bench stays inside its time budget; set
@@ -89,7 +90,9 @@ def _anomaly_config(estimator: str, kind: str, **kwargs) -> Dict[str, Any]:
     }
 
 
-def _configs(full: bool, epochs: int, machines: int) -> Dict[str, Dict[str, Any]]:
+def _configs(
+    full: bool, epochs: int, machines: int, machines_explicit: bool = False
+) -> Dict[str, Dict[str, Any]]:
     return {
         "dense_ae_10tag": {
             "model": _anomaly_config(
@@ -99,8 +102,11 @@ def _configs(full: bool, epochs: int, machines: int) -> Dict[str, Dict[str, Any]
                 batch_size=64,
             ),
             # FULL = the north-star fleet size (1000 machines, padded to the
-            # next power of two) built on however many chips are present
-            "machines": machines if not full else max(machines, 1024),
+            # next power of two) built on however many chips are present —
+            # unless the operator pinned BENCH_MACHINES explicitly (ADVICE r2)
+            "machines": (
+                machines if (not full or machines_explicit) else max(machines, 1024)
+            ),
             "rows": 864,  # 6 days at 10-min resolution
             "tags": 10,
             "n_splits": 3,
@@ -121,10 +127,13 @@ def _configs(full: bool, epochs: int, machines: int) -> Dict[str, Dict[str, Any]
             "n_splits": 2,
         },
         "lstm_forecast_100tag": {
+            # multi-step horizon (BASELINE config 3): direct 3-step-ahead
+            # forecast — window i targets row i+L-1+3
             "model": _anomaly_config(
                 "LSTMForecast",
                 "lstm_symmetric",
                 lookback_window=24,
+                horizon=3,
                 dims=[32],
                 epochs=max(2, epochs // 3),
                 batch_size=64,
@@ -272,15 +281,18 @@ def _bench_config(name: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def main() -> None:
-    if os.environ.get("BENCH_CPU", "0") == "1":
-        jax.config.update("jax_platforms", "cpu")
-    from gordo_components_tpu.utils.backend import require_live_backend
+    from gordo_components_tpu.utils.backend import (
+        pin_cpu_if_forced,
+        require_live_backend_or_cpu_fallback,
+    )
 
-    require_live_backend("bench.py")
-    machines = int(os.environ.get("BENCH_MACHINES", "128"))
+    degraded = pin_cpu_if_forced()
+    require_live_backend_or_cpu_fallback("bench.py")
+    machines_env = os.environ.get("BENCH_MACHINES")
+    machines = int(machines_env) if machines_env is not None else 128
     epochs = int(os.environ.get("BENCH_EPOCHS", "10"))
     full = os.environ.get("BENCH_FULL", "0") == "1"
-    configs = _configs(full, epochs, machines)
+    configs = _configs(full, epochs, machines, machines_explicit=machines_env is not None)
     only = os.environ.get("BENCH_CONFIGS")
     if only:
         keep = {k.strip() for k in only.split(",")}
@@ -317,6 +329,11 @@ def main() -> None:
         "device": device.device_kind,
         "configs": results,
     }
+    if degraded:
+        out["degraded"] = (
+            "accelerator tunnel down; measured on the CPU backend — "
+            "NOT comparable to TPU anchors in BASELINE.md"
+        )
     print(json.dumps(out))
 
 

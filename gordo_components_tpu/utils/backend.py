@@ -13,6 +13,9 @@ import threading
 from typing import Any, Callable, Optional, Tuple
 
 
+FORCED_CPU_ENV = "GORDO_FORCED_CPU"
+
+
 def require_live_backend(script_name: str, timeout_s: float = 120.0) -> None:
     """Exit fast (code 3, clear stderr message) when JAX backend init hangs
     or fails — the shared guard for driver-run benchmark scripts, which must
@@ -34,6 +37,81 @@ def require_live_backend(script_name: str, timeout_s: float = 120.0) -> None:
         )
     )
     sys.exit(3)
+
+
+def pin_cpu_if_forced() -> bool:
+    """Call FIRST in a bench ``main()``, before any backend init: when this
+    process is the forced-CPU fallback child (:func:`require_live_backend_or_
+    cpu_fallback` set :data:`FORCED_CPU_ENV`) or the operator set
+    ``BENCH_CPU=1``, pin the platform via ``jax.config`` — the
+    ``JAX_PLATFORMS`` env var alone is ignored once an accelerator plugin is
+    installed. Returns True when this run is the degraded tunnel-down
+    fallback (callers surface that honestly in their JSON output)."""
+    import os
+
+    import jax
+
+    forced = os.environ.get(FORCED_CPU_ENV, "0") == "1"
+    if forced or os.environ.get("BENCH_CPU", "0") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    return forced
+
+
+def require_live_backend_or_cpu_fallback(
+    script_name: str, timeout_s: float = 120.0, child_timeout_s: float = 3300.0
+) -> None:
+    """Like :func:`require_live_backend`, but NEVER fails the round on a
+    wedged accelerator tunnel: on a hung/failed probe it re-execs the current
+    script in a subprocess pinned to the CPU backend (same argv, env plus
+    :data:`FORCED_CPU_ENV`), forwards the child's stdout/stderr, and exits
+    with the child's return code. The child's JSON then carries an honest
+    ``"device": "cpu"`` — a degraded-but-parseable artifact instead of rc=3
+    (VERDICT r2 #1). Returns normally when the backend is live."""
+    import os
+    import subprocess
+    import sys
+
+    import jax
+
+    status, value = call_with_timeout(jax.devices, timeout_s)
+    if status == "ok":
+        return
+    if os.environ.get(FORCED_CPU_ENV, "0") == "1":
+        # CPU backend init cannot hang on a tunnel; something else is wrong —
+        # fail loudly rather than recurse
+        sys.stderr.write(
+            f"{script_name}: backend init failed even on the forced-CPU "
+            f"fallback: {value!r}\n"
+        )
+        sys.exit(3)
+    sys.stderr.write(
+        f"{script_name}: JAX backend init "
+        + (
+            f"failed ({value!r})"
+            if status == "error"
+            else f"hung for {timeout_s:.0f}s (accelerator tunnel down?)"
+        )
+        + "; re-running on the CPU backend so the round still gets an "
+        "honest, parseable measurement\n"
+    )
+    sys.stderr.flush()
+    env = dict(os.environ)
+    env[FORCED_CPU_ENV] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        # child inherits stdio: its progress streams live (a CPU bench run
+        # can take many minutes) and its JSON line lands on the same stdout
+        # the driver parses — no buffering of the whole run in memory
+        proc = subprocess.run(
+            [sys.executable] + sys.argv, env=env, timeout=child_timeout_s
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(
+            f"{script_name}: forced-CPU fallback timed out after "
+            f"{child_timeout_s:.0f}s\n"
+        )
+        sys.exit(3)
+    sys.exit(proc.returncode)
 
 
 def call_with_timeout(
