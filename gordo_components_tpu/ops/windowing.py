@@ -16,9 +16,14 @@ Given ``x`` with ``n`` rows and ``lookback_window = L``:
 - **Reconstruction** (LSTM autoencoder): window ``i`` targets its own last
   row ``x[i+L-1]``. Usable samples: ``n - L + 1``. Prediction row ``j``
   corresponds to input timestamp index ``j + L - 1``.
-- **Forecast**: window ``i`` targets the *next* row ``x[i+L]``. Usable
-  samples: ``n - L``. Prediction row ``j`` corresponds to input timestamp
-  index ``j + L``.
+- **Forecast** (``lookahead = k >= 1``, the direct multi-step horizon —
+  BASELINE.md config 3): window ``i`` targets the ``k``-th-ahead row
+  ``x[i+L-1+k]``. Usable samples: ``n - L + 1 - k``. Prediction row ``j``
+  corresponds to input timestamp index ``j + L - 1 + k``. ``k = 1`` is the
+  classic next-row forecast.
+- **Joint multi-step** (:func:`multi_step_targets`): window ``i`` targets
+  ALL of rows ``[i+L, i+L+k)`` — the ``(count, k, F)`` stacked variant for
+  models that predict the whole horizon jointly.
 
 ``window_output_index`` maps prediction rows back to input-row indices so
 the server/anomaly layers can attach the correct timestamps.
@@ -34,12 +39,13 @@ def n_windows(n_rows: int, lookback_window: int, lookahead: int = 0) -> int:
     """Number of usable windows for ``n_rows`` of input.
 
     ``lookahead=0`` → reconstruction (target = last row of window);
-    ``lookahead=1`` → one-step forecast (target = row after window).
+    ``lookahead=k >= 1`` → direct ``k``-step forecast (target = the
+    ``k``-th row after the window's last).
     """
     if lookback_window < 1:
         raise ValueError(f"lookback_window must be >= 1, got {lookback_window}")
-    if lookahead not in (0, 1):
-        raise ValueError(f"lookahead must be 0 or 1, got {lookahead}")
+    if not isinstance(lookahead, (int, np.integer)) or lookahead < 0:
+        raise ValueError(f"lookahead must be an int >= 0, got {lookahead}")
     return max(0, n_rows - lookback_window + 1 - lookahead)
 
 
@@ -87,9 +93,41 @@ def reconstruction_targets(x: jnp.ndarray, lookback_window: int) -> jnp.ndarray:
     return x[lookback_window - 1 :]
 
 
-def forecast_targets(x: jnp.ndarray, lookback_window: int) -> jnp.ndarray:
-    """Targets for the forecast contract: row ``i+L`` per window."""
-    return x[lookback_window:]
+def forecast_targets(
+    x: jnp.ndarray, lookback_window: int, lookahead: int = 1
+) -> jnp.ndarray:
+    """Targets for the direct ``k``-step forecast contract: row
+    ``i + L - 1 + lookahead`` per window (``lookahead=1`` → the classic
+    next-row forecast)."""
+    if lookahead < 1:
+        raise ValueError(
+            f"forecast lookahead must be >= 1, got {lookahead} "
+            "(use reconstruction_targets for lookahead=0)"
+        )
+    return x[lookback_window - 1 + lookahead :]
+
+
+def multi_step_targets(
+    x: jnp.ndarray, lookback_window: int, horizon: int
+) -> jnp.ndarray:
+    """Joint-horizon targets: ``(n, F) → (count, horizon, F)`` where window
+    ``i`` targets ALL of rows ``[i+L, i+L+horizon)`` and ``count =
+    n_windows(n, L, lookahead=horizon)`` — zips exactly with
+    ``sliding_windows(x, L, lookahead=horizon)``. The same static-gather
+    construction as :func:`sliding_windows`, so it fuses under jit."""
+    if horizon < 1:
+        raise ValueError(f"horizon must be >= 1, got {horizon}")
+    n = x.shape[0]
+    count = n_windows(n, lookback_window, horizon)
+    if count <= 0:
+        raise ValueError(
+            f"Need at least lookback_window+horizon={lookback_window + horizon} "
+            f"rows, got {n}"
+        )
+    idx = (
+        np.arange(count)[:, None] + lookback_window + np.arange(horizon)[None, :]
+    )
+    return x[idx]
 
 
 def window_output_index(

@@ -61,12 +61,61 @@ MANIFEST_FILE = "fleet_manifest.json"
 _CKPT_SUBDIR = ".slice_checkpoints"
 
 
+def _prepare_slice(
+    slice_items: List[dict],
+    n_padded: int,
+    n_features: int,
+    n_targets: int,
+    quantize_rows: bool,
+):
+    """Host-side ingest for one slice: provider fetch + padded stacked
+    assembly. Runs on the prefetch worker so slice ``s+1``'s data-lake reads
+    (the reference's I/O hot spot, SURVEY.md §4.1) overlap slice ``s``'s
+    device training + artifact writes. Peak host memory is therefore TWO
+    slices' data (double buffer), not one — still bounded and documented at
+    the slice_size knob.
+
+    Every shape input is an explicit argument (not a closure over bucket-loop
+    locals): the call runs on another thread, and late-bound locals would
+    silently go stale if a future ever crossed a bucket boundary (ADVICE r2).
+    """
+    fetch_started = time.perf_counter()
+    for item in slice_items:
+        if "X" in item:  # width probe already fetched it
+            continue
+        X_frame, y_frame = item["dataset"].get_data()
+        item["X"] = np.asarray(getattr(X_frame, "values", X_frame), np.float32)
+        item["y"] = np.asarray(getattr(y_frame, "values", y_frame), np.float32)
+        item["dataset_metadata"] = item["dataset"].get_metadata()
+
+    n_rows = max(len(item["X"]) for item in slice_items)
+    if quantize_rows:
+        # quantize the row axis so slices with slightly different history
+        # lengths share one (n_padded, n_rows, F) shape and the bucket
+        # reuses a single compiled executable; padded rows are zero-weight
+        # and masked everywhere (fold masks run on real-sample ranks)
+        n_rows = -(-n_rows // _ROW_QUANTUM) * _ROW_QUANTUM
+    X = np.zeros((n_padded, n_rows, n_features), np.float32)
+    y = np.zeros((n_padded, n_rows, n_targets), np.float32)
+    w = np.zeros((n_padded, n_rows), np.float32)
+    for i, item in enumerate(slice_items):
+        rows = len(item["X"])
+        # RIGHT-aligned by convention (rows end at the bucket's latest
+        # timestamp). CV correctness does not depend on placement: fold
+        # masks are computed on real-sample ranks
+        # (fleet.timeseries_fold_masks), invariant to where padding sits
+        X[i, n_rows - rows :] = item["X"]
+        y[i, n_rows - rows :] = item["y"]
+        w[i, n_rows - rows :] = 1.0
+    return X, y, w, n_rows, time.perf_counter() - fetch_started
+
+
 def _abstract_result(spec, n_machines, n_rows, n_features, n_targets):
     """Shape/dtype skeleton of a stacked slice result, WITHOUT running the
     program — the restore template for orbax (types round-trip exactly)."""
     import jax.numpy as jnp
 
-    from .fleet import make_machine_program
+    from .fleet import make_machine_program, prng_key_width
 
     program = jax.vmap(make_machine_program(spec, n_rows, n_features, n_targets))
     return jax.eval_shape(
@@ -74,7 +123,7 @@ def _abstract_result(spec, n_machines, n_rows, n_features, n_targets):
         jax.ShapeDtypeStruct((n_machines, n_rows, n_features), jnp.float32),
         jax.ShapeDtypeStruct((n_machines, n_rows, n_targets), jnp.float32),
         jax.ShapeDtypeStruct((n_machines, n_rows), jnp.float32),
-        jax.ShapeDtypeStruct((n_machines, 2), jnp.uint32),
+        jax.ShapeDtypeStruct((n_machines, prng_key_width()), jnp.uint32),
     )
 
 
@@ -539,56 +588,21 @@ def build_fleet(
                 n_padded,
                 n_features,
             )
-            def prepare_slice(slice_items):
-                """Host-side ingest for one slice: provider fetch + padded
-                stacked assembly. Runs on the prefetch worker so slice ``s+1``'s
-                data-lake reads (the reference's I/O hot spot, SURVEY.md §4.1)
-                overlap slice ``s``'s device training + artifact writes. Peak
-                host memory is therefore TWO slices' data (double buffer), not
-                one — still bounded and documented at the slice_size knob."""
-                fetch_started = time.perf_counter()
-                for item in slice_items:
-                    if "X" in item:  # width probe already fetched it
-                        continue
-                    X_frame, y_frame = item["dataset"].get_data()
-                    item["X"] = np.asarray(
-                        getattr(X_frame, "values", X_frame), np.float32
-                    )
-                    item["y"] = np.asarray(
-                        getattr(y_frame, "values", y_frame), np.float32
-                    )
-                    item["dataset_metadata"] = item["dataset"].get_metadata()
-
-                n_rows = max(len(item["X"]) for item in slice_items)
-                if len(slices) > 1:
-                    # quantize the row axis so slices with slightly different
-                    # history lengths share one (n_padded, n_rows, F) shape and
-                    # the bucket reuses a single compiled executable; padded
-                    # rows are zero-weight and masked everywhere (fold masks
-                    # run on real-sample ranks)
-                    n_rows = -(-n_rows // _ROW_QUANTUM) * _ROW_QUANTUM
-                X = np.zeros((n_padded, n_rows, n_features), np.float32)
-                y = np.zeros((n_padded, n_rows, n_targets), np.float32)
-                w = np.zeros((n_padded, n_rows), np.float32)
-                for i, item in enumerate(slice_items):
-                    rows = len(item["X"])
-                    # RIGHT-aligned by convention (rows end at the bucket's
-                    # latest timestamp). CV correctness does not depend on
-                    # placement: fold masks are computed on real-sample ranks
-                    # (fleet.timeseries_fold_masks), invariant to where padding
-                    # sits
-                    X[i, n_rows - rows :] = item["X"]
-                    y[i, n_rows - rows :] = item["y"]
-                    w[i, n_rows - rows :] = 1.0
-                return X, y, w, n_rows, time.perf_counter() - fetch_started
-
-            prepared = prefetcher.submit(prepare_slice, slices[0])
+            quantize_rows = len(slices) > 1
+            prepared = prefetcher.submit(
+                _prepare_slice,
+                slices[0], n_padded, n_features, n_targets, quantize_rows,
+            )
             for s, slice_items in enumerate(slices):
                 slice_started = time.perf_counter()
                 X, y, w, n_rows, fetch_s = prepared.result()
                 timer.add("data_fetch", fetch_s)
                 if s + 1 < len(slices):
-                    prepared = prefetcher.submit(prepare_slice, slices[s + 1])
+                    prepared = prefetcher.submit(
+                        _prepare_slice,
+                        slices[s + 1], n_padded, n_features, n_targets,
+                        quantize_rows,
+                    )
                 keys = jax.random.split(
                     jax.random.fold_in(jax.random.fold_in(master_key, b), s),
                     n_padded,

@@ -39,6 +39,7 @@ import numpy as np
 from ..models.train import make_fit_fn, make_predict_fn
 from ..ops import windowing
 from ..ops.scaling import ScalerParams
+from ..utils.cache import cached as _cached  # shared FIFO program memo
 from .mesh import fleet_sharding, pad_to_multiple
 
 _EPS = 1e-12
@@ -50,7 +51,7 @@ class FleetSpec(NamedTuple):
     module: Any  # flax module — shared architecture
     optimizer: Any  # optax transform
     loss: str
-    lookahead: Optional[int]  # None=flat, 0=reconstruction, 1=forecast
+    lookahead: Optional[int]  # None=flat, 0=reconstruction, k>=1=k-step forecast
     lookback_window: int
     scaler: str  # "minmax" | "standard" | "none"
     feature_range: Tuple[float, float]
@@ -74,7 +75,9 @@ class FleetSpec(NamedTuple):
 
 class MachineBatch(NamedTuple):
     """Stacked per-machine data: X (M,N,F) raw, y (M,N,T) raw, w (M,N) row
-    weights (0 on padding), keys (M,2) uint32 PRNG keys."""
+    weights (0 on padding), keys (M, key_width) uint32 raw PRNG keys —
+    ``key_width`` is impl-dependent (threefry 2, rbg 4); build keys with
+    ``jax.random.split`` and size avatars via :func:`prng_key_width`."""
 
     X: jnp.ndarray
     y: jnp.ndarray
@@ -255,7 +258,7 @@ def make_machine_program(
             targets = (
                 windowing.reconstruction_targets(ys, L)
                 if la == 0
-                else windowing.forecast_targets(ys, L)
+                else windowing.forecast_targets(ys, L, la)
             )
             target_idx = windowing.window_output_index(n_rows, L, la)
             window_w = windowing.sliding_windows(w[:, None], L, la)[:, :, 0]
@@ -434,23 +437,6 @@ _PROGRAM_CACHE: dict = {}
 _PROGRAM_CACHE_MAX = 128  # distinct (spec, shape, mesh) programs kept live
 
 
-def _cached(cache: dict, max_size: int, key, build):
-    """FIFO-bounded memo shared by the program and executable caches; an
-    unhashable key (exotic spec member) just builds uncached."""
-    try:
-        hit = cache.get(key)
-    except TypeError:
-        return build()
-    if hit is not None:
-        return hit
-    value = build()
-    if len(cache) >= max_size:  # FIFO bound — a long-lived builder seeing
-        # many distinct configs must not pin every compiled artifact forever
-        cache.pop(next(iter(cache)))
-    cache[key] = value
-    return value
-
-
 def fleet_program(
     spec: FleetSpec,
     n_rows: int,
@@ -484,6 +470,15 @@ _EXEC_CACHE: dict = {}
 _EXEC_CACHE_MAX = 64
 
 
+def prng_key_width() -> int:
+    """Trailing uint32 width of a raw PRNG key under the active impl
+    (threefry: 2, rbg: 4). AOT avatars must advertise the width
+    ``jax.random.split`` actually produces, or the strict executable
+    rejects every batch under a non-default ``jax_default_prng_impl``
+    (ADVICE r2)."""
+    return int(jax.eval_shape(jax.random.PRNGKey, 0).shape[-1])
+
+
 def fleet_executable(
     spec: FleetSpec,
     n_machines: int,
@@ -513,7 +508,7 @@ def fleet_executable(
             jax.ShapeDtypeStruct((n_machines, n_rows, n_features), jnp.float32),
             jax.ShapeDtypeStruct((n_machines, n_rows, n_targets), jnp.float32),
             jax.ShapeDtypeStruct((n_machines, n_rows), jnp.float32),
-            jax.ShapeDtypeStruct((n_machines, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((n_machines, prng_key_width()), jnp.uint32),
         )
         compiled = program.lower(*avatars).compile()
         try:

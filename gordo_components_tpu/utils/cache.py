@@ -1,0 +1,33 @@
+"""FIFO-bounded program memo shared by the fleet and single-machine paths.
+
+``jax.jit`` keys its trace cache on *function identity*: building a fresh
+jit wrapper per call (as a naive ``fit`` does) re-traces and re-compiles
+the same program every time. Both training paths therefore memoize their
+jitted callables on a value-based config key — the fleet in
+:mod:`gordo_components_tpu.parallel.fleet`, the single-machine estimators
+in :mod:`gordo_components_tpu.models.models` (VERDICT r2 #5: host-path CV
+paid k+1 identical traces per machine without this).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+def cached(cache: dict, max_size: int, key, build: Callable[[], T]) -> T:
+    """FIFO-bounded memo; an unhashable key (exotic config member) just
+    builds uncached."""
+    try:
+        hit = cache.get(key)
+    except TypeError:
+        return build()
+    if hit is not None:
+        return hit
+    value = build()
+    if len(cache) >= max_size:  # FIFO bound — a long-lived process seeing
+        # many distinct configs must not pin every compiled artifact forever
+        cache.pop(next(iter(cache)))
+    cache[key] = value
+    return value

@@ -69,6 +69,7 @@ def test_devices_available():
     assert jax.device_count() == 8, "conftest must provide 8 virtual devices"
 
 
+@pytest.mark.slow
 def test_fleet_trains_stacked_machines():
     spec, batch = _make_spec_and_batch(4)
     result = train_fleet_arrays(spec, batch)
@@ -89,6 +90,7 @@ def test_fleet_trains_stacked_machines():
     assert not np.allclose(k0, k1)
 
 
+@pytest.mark.slow
 def test_fleet_on_mesh_sharded():
     mesh = fleet_mesh()
     assert mesh.size == 8
@@ -111,6 +113,7 @@ def test_fleet_mesh_divisibility_enforced():
         train_fleet_arrays(spec, batch, mesh=mesh)
 
 
+@pytest.mark.slow
 def test_zero_weight_padding_machine_is_finite():
     """A fully-padded (weight-0) machine must not poison the bucket with
     NaNs — this is what makes machine-axis padding safe."""
@@ -141,6 +144,7 @@ def test_row_padding_masks():
     assert np.isfinite(np.asarray(result.loss_history)).all()
 
 
+@pytest.mark.slow
 def test_lstm_fleet_bucket():
     lstm_config = {
         "DiffBasedAnomalyDetector": {
@@ -170,6 +174,43 @@ def test_lstm_fleet_bucket():
     assert np.isfinite(np.asarray(result.loss_history)).all()
 
 
+@pytest.mark.slow
+def test_multi_step_forecast_fleet_bucket():
+    """A horizon=2 LSTMForecast fleet trains through the same compiled
+    program: spec.lookahead carries the horizon and window weights mask the
+    2-step-shifted targets (BASELINE config 3 inside the fleet path)."""
+    forecast_config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "TransformedTargetRegressor": {
+                    "regressor": {
+                        "Pipeline": {
+                            "steps": [
+                                "MinMaxScaler",
+                                {"LSTMForecast": {"kind": "lstm_symmetric",
+                                                  "lookback_window": 6,
+                                                  "horizon": 2,
+                                                  "dims": [8],
+                                                  "epochs": 1,
+                                                  "batch_size": 32}},
+                            ]
+                        }
+                    },
+                    "transformer": "MinMaxScaler",
+                }
+            }
+        }
+    }
+    spec, batch = _make_spec_and_batch(2, n_rows=128,
+                                       model_config=forecast_config,
+                                       n_splits=2)
+    assert spec.lookahead == 2 and spec.lookback_window == 6
+    result = train_fleet_arrays(spec, batch)
+    assert np.isfinite(np.asarray(result.loss_history)).all()
+    assert np.isfinite(np.asarray(result.cv_scores)).all()
+
+
+@pytest.mark.slow
 def test_build_fleet_end_to_end(tmp_path):
     mesh = fleet_mesh()
     machines = [
@@ -211,6 +252,7 @@ def test_build_fleet_end_to_end(tmp_path):
     assert dirs2 == dirs
 
 
+@pytest.mark.slow
 def test_fleet_pipeline_shape_predicts_raw_space(tmp_path):
     """Config WITHOUT TransformedTargetRegressor: the fleet must train
     against raw targets (Pipeline.fit passes y through untransformed), so
@@ -249,6 +291,7 @@ def test_fleet_pipeline_shape_predicts_raw_space(tmp_path):
     assert np.isfinite(np.ravel(frame["total-anomaly-score"].values)).all()
 
 
+@pytest.mark.slow
 def test_fleet_short_machine_gets_real_thresholds():
     """A machine much shorter than the bucket must still get finite nonzero
     thresholds and honest per-machine CV: fold boundaries are computed on
@@ -376,6 +419,7 @@ def test_provide_saved_model_rejects_cross_val_only(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_fleet_heterogeneous_buckets(tmp_path):
     """Machines with different tag counts land in different buckets but one
     build_fleet call handles all of them."""
@@ -391,6 +435,7 @@ def test_fleet_heterogeneous_buckets(tmp_path):
     assert wide.predict(np.zeros((4, 4), np.float32)).shape == (4, 4)
 
 
+@pytest.mark.slow
 def test_fleet_slice_checkpoint_resume(tmp_path, monkeypatch):
     """A build killed mid-bucket loses only the in-flight slice: completed
     slices' artifacts + registry keys are already on disk, and the resume
@@ -564,6 +609,7 @@ def test_negative_slice_size_rejected(tmp_path):
         build_fleet(machines, str(tmp_path / "o"), n_splits=2, slice_size=-1)
 
 
+@pytest.mark.slow
 def test_fleet_executable_formats_and_placement():
     """fleet_executable AOT-compiles once per (spec, shape, mesh) and
     put_fleet_batch coerces host dtypes (float64 data, typed PRNG keys)
@@ -596,6 +642,7 @@ def test_fleet_executable_formats_and_placement():
     assert np.isfinite(np.asarray(result2.loss_history)).all()
 
 
+@pytest.mark.slow
 def test_per_machine_evaluation_n_splits(tmp_path):
     """A machine's ``evaluation: {n_splits: N}`` (reference Machine
     semantics) overrides build_fleet's global — machines with different CV

@@ -64,7 +64,45 @@ class TestWindowing:
         with pytest.raises(ValueError):
             n_windows(10, 0)
         with pytest.raises(ValueError):
-            n_windows(10, 2, lookahead=2)
+            n_windows(10, 2, lookahead=-1)
+        with pytest.raises(ValueError):
+            n_windows(10, 2, lookahead=1.5)
+
+    def test_multi_step_forecast_contract(self):
+        # GOLDEN (BASELINE config 3): lookahead=k targets the k-th-ahead
+        # row x[i+L-1+k]; window count shrinks by k-1 vs one-step
+        from gordo_components_tpu.ops.windowing import window_output_index
+
+        x = np.arange(24, dtype=np.float32).reshape(12, 2)
+        L, k = 4, 3
+        w = np.asarray(sliding_windows(x, L, lookahead=k))
+        t = np.asarray(forecast_targets(x, L, lookahead=k))
+        assert len(w) == len(t) == n_windows(12, L, lookahead=k) == 12 - L + 1 - k
+        for i in range(len(t)):
+            np.testing.assert_array_equal(x[i + L - 1 + k], t[i])
+            assert w[i, -1, 0] == x[i + L - 1, 0]
+        np.testing.assert_array_equal(
+            window_output_index(12, L, lookahead=k), np.arange(len(t)) + L - 1 + k
+        )
+        with pytest.raises(ValueError):
+            forecast_targets(x, L, lookahead=0)
+
+    def test_multi_step_joint_targets(self):
+        # joint variant: window i targets ALL of rows [i+L, i+L+k)
+        from gordo_components_tpu.ops.windowing import multi_step_targets
+
+        x = np.arange(24, dtype=np.float32).reshape(12, 2)
+        L, k = 4, 3
+        w = np.asarray(sliding_windows(x, L, lookahead=k))
+        t = np.asarray(multi_step_targets(x, L, k))
+        assert t.shape == (len(w), k, 2)
+        for i in range(len(w)):
+            for s in range(k):
+                np.testing.assert_array_equal(x[i + L + s], t[i, s])
+        with pytest.raises(ValueError):
+            multi_step_targets(x, L, 0)
+        with pytest.raises(ValueError):
+            multi_step_targets(np.zeros((4, 2), np.float32), 4, 1)
 
 
 class TestScaling:
