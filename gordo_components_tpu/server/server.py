@@ -122,6 +122,25 @@ class _Machine:
     def tag_list(self) -> Optional[List[str]]:
         return self.metadata.get("dataset", {}).get("tag_list")
 
+    @property
+    def target_tag_list(self) -> Optional[List[str]]:
+        return self.metadata.get("dataset", {}).get("target_tag_list")
+
+    @property
+    def target_columns(self) -> Optional[List[int]]:
+        """Input-column index of each target tag, when the build metadata
+        shows targets as a strict subset/permutation of input tags — how
+        both scoring paths know which input columns a ``target_tag_list``
+        machine's residuals compare against. ``None`` when targets equal
+        inputs (the common reconstruction case) or can't be mapped."""
+        tags, targets = self.tag_list, self.target_tag_list
+        if not tags or not targets or targets == tags:
+            return None
+        try:
+            return [tags.index(t) for t in targets]
+        except ValueError:  # a target tag outside the inputs: unmappable
+            return None
+
 
 def scan_models_root(models_root: str) -> Dict[str, str]:
     """``{subdir_name: path}`` for every immediate subdir that looks like a
@@ -169,7 +188,11 @@ class _ServerState:
         # one device-resident pytree + one jitted program (engine.py);
         # anything the engine can't lift falls back to model.anomaly
         self.engine = ServingEngine(
-            {name: machine.model for name, machine in machines.items()}
+            {name: machine.model for name, machine in machines.items()},
+            target_cols={
+                name: machine.target_columns
+                for name, machine in machines.items()
+            },
         )
 
 
@@ -537,7 +560,13 @@ class ModelServer:
         lifted into it, else the host path (``model.anomaly``)."""
         if state.engine.can_score(machine.name):
             return state.engine.anomaly(machine.name, X)
-        frame = machine.model.anomaly(X)
+        cols = machine.target_columns
+        if cols is None:
+            frame = machine.model.anomaly(X)
+        elif hasattr(X, "iloc"):  # DataFrame from ?start&end fetch
+            frame = machine.model.anomaly(X, y=X.iloc[:, cols])
+        else:
+            frame = machine.model.anomaly(X, y=np.asarray(X)[:, cols])
         return ScoreResult(
             model_input=frame["model-input"].values,
             model_output=frame["model-output"].values,
