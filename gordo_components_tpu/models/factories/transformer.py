@@ -50,6 +50,10 @@ class MultiHeadSelfAttention(nn.Module):
       blocks rotate via ICI neighbor hops (SURVEY.md §6.7 long-context
       path). Same parameters, exact same math — pinned by
       tests/test_transformer.py.
+    - ``"ring_flash"`` — ring across devices with the Pallas blockwise
+      kernel as each hop's local update: per-hop scores stay in VMEM too,
+      so the sharded long-context path never materializes scores in HBM
+      at any level. Exact; parity pinned alongside ring.
 
     Attention-weight dropout applies on the dense path (weights are
     materialized there); the flash and ring paths cannot drop weights they
@@ -75,9 +79,13 @@ class MultiHeadSelfAttention(nn.Module):
         q = nn.DenseGeneral((self.n_heads, head_dim), dtype=dtype, name="query")(x)
         k = nn.DenseGeneral((self.n_heads, head_dim), dtype=dtype, name="key")(x)
         v = nn.DenseGeneral((self.n_heads, head_dim), dtype=dtype, name="value")(x)
-        if self.attention_impl == "ring":
+        if self.attention_impl in ("ring", "ring_flash"):
             mesh = Mesh(np.asarray(jax.devices()), (self.ring_axis,))
-            out = ring_attention(q, k, v, mesh=mesh, axis_name=self.ring_axis)
+            out = ring_attention(
+                q, k, v, mesh=mesh, axis_name=self.ring_axis,
+                block_impl="flash" if self.attention_impl == "ring_flash"
+                else "dense",
+            )
         elif self.attention_impl == "flash":
             out = flash_attention(q, k, v)
         elif self.attention_impl == "dense":
@@ -96,7 +104,7 @@ class MultiHeadSelfAttention(nn.Module):
         else:
             raise ValueError(
                 f"Unknown attention_impl {self.attention_impl!r}; "
-                "use 'dense', 'flash', or 'ring'"
+                "use 'dense', 'flash', 'ring', or 'ring_flash'"
             )
         return nn.DenseGeneral(
             self.d_model, axis=(-2, -1), dtype=dtype, name="out"
@@ -231,25 +239,25 @@ def patchtst(
     stride = stride or max(1, patch_length // 2)
     ff_dim = ff_dim or 2 * d_model
     n_features_out = n_features_out or n_features
-    if attention_impl not in ("dense", "flash", "ring"):
+    if attention_impl not in ("dense", "flash", "ring", "ring_flash"):
         raise ValueError(
             f"Unknown attention_impl {attention_impl!r}; "
-            "use 'dense', 'flash', or 'ring'"
+            "use 'dense', 'flash', 'ring', or 'ring_flash'"
         )
     if d_model % n_heads != 0:
         raise ValueError(
             f"d_model ({d_model}) must be divisible by n_heads ({n_heads})"
         )
-    if attention_impl == "ring":
+    if attention_impl in ("ring", "ring_flash"):
         n_patches = (lookback_window - patch_length) // stride + 1
         n_devices = jax.device_count()
         if n_patches % n_devices != 0:
             raise ValueError(
-                f"attention_impl='ring' shards the patch axis over "
-                f"{n_devices} device(s), but {n_patches} patches do not "
-                f"divide evenly; pick lookback_window/patch_length/stride "
-                "so (lookback_window - patch_length)//stride + 1 is a "
-                "multiple of the device count"
+                f"attention_impl={attention_impl!r} shards the patch axis "
+                f"over {n_devices} device(s), but {n_patches} patches do "
+                "not divide evenly; pick lookback_window/patch_length/"
+                "stride so (lookback_window - patch_length)//stride + 1 is "
+                "a multiple of the device count"
             )
     module = PatchTSTModule(
         n_features_out=n_features_out,

@@ -41,13 +41,26 @@ def dense_attention(
     return jnp.einsum("...hqk,...khd->...qhd", weights, v)
 
 
-def _ring_attention_sharded(q, k, v, *, axis_name: str, scale: float):
+def _ring_attention_sharded(
+    q, k, v, *, axis_name: str, scale: float, block_impl: str = "dense"
+):
     """Per-shard body: q/k/v are this device's sequence block
-    ``(batch, block, heads, head_dim)``."""
+    ``(batch, block, heads, head_dim)``.
+
+    ``block_impl`` picks the per-hop update:
+
+    - ``"dense"`` — einsum scores for the local (q_block, k_block) pair
+      (materialized per hop, O(block²) HBM);
+    - ``"flash"`` — the Pallas blockwise kernel
+      (:func:`~gordo_components_tpu.ops.flash_attention.flash_block_with_lse`):
+      the hop's scores stay in VMEM tiles and only its ``(out, lse)`` pair
+      enters the ring merge, so the sharded long-context path is
+      HBM-score-free end to end. Both merges are the same exact
+      online-softmax fold; parity is pinned in tests/test_transformer.py.
+    """
     n_devices = jax.lax.psum(1, axis_name)
 
-    def fold(carry, _):
-        acc, m, l, k_blk, v_blk = carry
+    def hop_dense(k_blk, v_blk, m, l, acc):
         logits = jnp.einsum("...qhd,...khd->...hqk", q, k_blk) * scale
         blk_max = jnp.max(logits, axis=-1)  # (..., h, q)
         new_m = jnp.maximum(m, blk_max)
@@ -59,6 +72,45 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, scale: float):
             acc * jnp.swapaxes(correction, -1, -2)[..., None]
             + jnp.einsum("...hqk,...khd->...qhd", p, v_blk)
         )
+        return new_m, l, acc
+
+    def hop_flash(k_blk, v_blk, m, l, acc):
+        from .flash_attention import flash_block_with_lse
+
+        *batch_shape, q_len, heads, head_dim = q.shape
+        bh = heads
+        for dim in batch_shape:
+            bh *= int(dim)
+
+        def to3d(a):
+            return jnp.moveaxis(a, -2, -3).reshape(bh, a.shape[-3], head_dim)
+
+        out3, lse3 = flash_block_with_lse(
+            to3d(q), to3d(k_blk), to3d(v_blk), scale, 128, 128,
+            frozenset((axis_name,)),
+        )
+        # hop result folds into the carry as one pre-reduced block whose
+        # "max" is its lse and whose normalizer mass is exp(lse - new_m):
+        # out3 is normalized, so its unnormalized sum is out3 * exp(lse)
+        hop_out = jnp.moveaxis(
+            out3.reshape(*batch_shape, heads, q_len, head_dim), -3, -2
+        )  # (..., q, h, d)
+        hop_lse = lse3.reshape(*batch_shape, heads, q_len)  # (..., h, q)
+        new_m = jnp.maximum(m, hop_lse)
+        correction = jnp.exp(m - new_m)
+        hop_w = jnp.exp(hop_lse - new_m)  # (..., h, q)
+        l = l * correction + hop_w
+        acc = (
+            acc * jnp.swapaxes(correction, -1, -2)[..., None]
+            + hop_out * jnp.swapaxes(hop_w, -1, -2)[..., None]
+        )
+        return new_m, l, acc
+
+    hop = hop_flash if block_impl == "flash" else hop_dense
+
+    def fold(carry, _):
+        acc, m, l, k_blk, v_blk = carry
+        new_m, l, acc = hop(k_blk, v_blk, m, l, acc)
         # rotate K/V one hop around the ring
         perm = [(i, (i + 1) % n_devices) for i in range(n_devices)]
         k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
@@ -91,17 +143,27 @@ def ring_attention(
     mesh: Mesh,
     axis_name: Optional[str] = None,
     scale: Optional[float] = None,
+    block_impl: str = "dense",
 ) -> jnp.ndarray:
     """Exact attention with the sequence axis sharded over ``mesh``.
 
     q/k/v: ``(batch, seq, heads, head_dim)`` with ``seq`` divisible by the
     mesh size. Communication is ``n_devices − 1`` neighbor hops of one K/V
     block each — the ring pattern that rides ICI links on TPU topologies.
+
+    ``block_impl="flash"`` runs each hop's local attention as the Pallas
+    blockwise kernel, so per-hop scores never materialize in HBM either —
+    the fully HBM-score-free long-context path (ring across devices, flash
+    within each device).
     """
     if axis_name is None:
         axis_name = mesh.axis_names[0]
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if block_impl not in ("dense", "flash"):
+        raise ValueError(
+            f"Unknown block_impl {block_impl!r}; use 'dense' or 'flash'"
+        )
     n = mesh.shape[axis_name]
     if q.shape[1] % n != 0:
         raise ValueError(
@@ -110,9 +172,18 @@ def ring_attention(
         )
     spec = PartitionSpec(None, axis_name)  # shard seq axis; replicate batch
     sharded = jax.shard_map(
-        partial(_ring_attention_sharded, axis_name=axis_name, scale=scale),
+        partial(
+            _ring_attention_sharded,
+            axis_name=axis_name,
+            scale=scale,
+            block_impl=block_impl,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call inside a shard_map body trips the vma checker's
+        # interpreter (mixed varying axes in its internal dynamic_slice);
+        # correctness of the flash composition is pinned by parity tests
+        check_vma=block_impl != "flash",
     )
     return sharded(q, k, v)

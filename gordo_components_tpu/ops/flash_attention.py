@@ -36,12 +36,38 @@ from __future__ import annotations
 
 import functools
 import math
+import warnings
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+_warned_interpret_on_accelerator = False
+
+
+def _interpret_mode() -> bool:
+    """Whether to run the Pallas kernel in interpret mode (everywhere but
+    TPU). On CPU that is the intended test path; on a non-TPU *accelerator*
+    (e.g. GPU) interpret mode is orders of magnitude slower than
+    ``dense_attention``, so warn once rather than silently crawl (ADVICE
+    r2) — callers who see the warning should use ``attention_impl='dense'``
+    off-TPU."""
+    global _warned_interpret_on_accelerator
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return False
+    if backend != "cpu" and not _warned_interpret_on_accelerator:
+        _warned_interpret_on_accelerator = True
+        warnings.warn(
+            f"flash_attention: Pallas TPU kernel running in INTERPRET mode "
+            f"on the {backend!r} backend — this is far slower than "
+            "attention_impl='dense'; flash is TPU-only",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+    return True
 
 # finite stand-in for -inf in the masked-score/online-max recurrence:
 # genuine -inf turns the first block's ``exp(s - m)`` into exp(-inf + inf)
@@ -103,8 +129,14 @@ def _fwd_kernel(
         lse_ref[0] = jnp.broadcast_to(lse.T, lse_ref.shape[1:])
 
 
-def _flash_fwd_3d(q3, k3, v3, scale: float, block_q: int, block_k: int):
-    """q3/k3/v3: ``(BH, S, D)`` → ``(out (BH, S, D), lse (BH, S))``."""
+def _flash_fwd_3d(
+    q3, k3, v3, scale: float, block_q: int, block_k: int, vma=None
+):
+    """q3/k3/v3: ``(BH, S, D)`` → ``(out (BH, S, D), lse (BH, S))``.
+
+    ``vma``: mesh axes the operands vary over, required when the kernel
+    runs inside a ``shard_map`` body (the ring composition) — pallas_call
+    must declare its outputs' varying axes there."""
     bh, seq, d = q3.shape
     # a common multiple of BOTH block sizes: padding to max() alone leaves
     # trailing key blocks unvisited when block_k does not divide it
@@ -136,8 +168,8 @@ def _flash_fwd_3d(q3, k3, v3, scale: float, block_q: int, block_k: int):
             pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, 0, qi)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_pad, d_pad), q3.dtype),
-            jax.ShapeDtypeStruct((bh, 8, s_pad), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_pad, d_pad), q3.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, 8, s_pad), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -147,14 +179,19 @@ def _flash_fwd_3d(q3, k3, v3, scale: float, block_q: int, block_k: int):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
-        interpret=jax.default_backend() != "tpu",
+        interpret=_interpret_mode(),
     )(q3, k3, v3)
     return out[:, :seq, :d], lse8[:, 0, :seq]
 
 
-def _bwd_3d(scale, block_k, res, do):
+def _bwd_3d(scale, block_k, res, do, dlse=None):
     """Blockwise flash backward (pure JAX, exact): scan over key blocks
-    using the saved logsumexp; peak memory O(S x block_k)."""
+    using the saved logsumexp; peak memory O(S x block_k).
+
+    ``dlse``: optional cotangent of the logsumexp output (the ring
+    composition differentiates through per-hop lse values in its merge);
+    its score-gradient contribution is ``p * dlse`` (since
+    ``∂lse_i/∂s_ij = p_ij``), and it never touches ``dv``."""
     q3, k3, v3, out, lse = res
     bh, seq, d = q3.shape
     qf = q3.astype(jnp.float32)
@@ -176,7 +213,10 @@ def _bwd_3d(scale, block_k, res, do):
         p = jnp.exp(s - lse[..., None]) * mask  # (BH, S, bk)
         dv_b = jnp.einsum("bqk,bqd->bkd", p, dof)
         dp = jnp.einsum("bqd,bkd->bqk", dof, v_b)
-        ds = p * (dp - d_i[..., None]) * scale
+        dresid = dp - d_i[..., None]
+        if dlse is not None:
+            dresid = dresid + dlse[..., None]
+        ds = p * dresid * scale
         dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_b)
         dk_b = jnp.einsum("bqk,bqd->bkd", ds, qf)
         return dq_acc, (dk_b, dv_b)
@@ -205,6 +245,32 @@ def _flash_3d_bwd(scale, block_q, block_k, res, do):
 
 
 _flash_3d.defvjp(_flash_3d_fwd, _flash_3d_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_block_with_lse(q3, k3, v3, scale, block_q, block_k, vma=None):
+    """``(BH, S, D)`` q/k/v → ``(out (BH, S, D), lse (BH, S))`` — the Pallas
+    forward with the per-row logsumexp exposed, differentiable in BOTH
+    outputs. This is the per-hop update for
+    :func:`gordo_components_tpu.ops.attention.ring_attention`'s flash
+    composition: the ring merge needs each hop's lse to fold partial
+    softmaxes exactly, and gradients must flow through that merge.
+    ``vma``: the shard_map mesh axes the operands vary over (see
+    :func:`_flash_fwd_3d`)."""
+    return _flash_fwd_3d(q3, k3, v3, scale, block_q, block_k, vma=vma)
+
+
+def _flash_lse_fwd(q3, k3, v3, scale, block_q, block_k, vma=None):
+    out, lse = _flash_fwd_3d(q3, k3, v3, scale, block_q, block_k, vma=vma)
+    return (out, lse), (q3, k3, v3, out, lse)
+
+
+def _flash_lse_bwd(scale, block_q, block_k, vma, res, cotangents):
+    do, dlse = cotangents
+    return _bwd_3d(scale, block_k, res, do, dlse=dlse)
+
+
+flash_block_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(

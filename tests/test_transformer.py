@@ -39,6 +39,7 @@ def test_patchtst_factory_spec():
 
 
 # --------------------------------------------------------------- estimators
+@pytest.mark.slow
 def test_patchtst_autoencoder_contract(X):
     L = 24
     m = PatchTSTAutoEncoder(lookback_window=L, patch_length=8, d_model=16,
@@ -50,6 +51,7 @@ def test_patchtst_autoencoder_contract(X):
     assert m.history_[-1] < m.history_[0]
 
 
+@pytest.mark.slow
 def test_patchtst_forecast_contract(X):
     L = 16
     m = PatchTSTForecast(lookback_window=L, patch_length=8, d_model=16,
@@ -58,6 +60,7 @@ def test_patchtst_forecast_contract(X):
     assert m.predict(X).shape == (len(X) - L, X.shape[1])
 
 
+@pytest.mark.slow
 def test_patchtst_dropout_and_state_round_trip(X, tmp_path):
     m = PatchTSTAutoEncoder(lookback_window=16, patch_length=8, d_model=16,
                             n_heads=2, n_layers=1, dropout=0.2, epochs=1,
@@ -69,6 +72,7 @@ def test_patchtst_dropout_and_state_round_trip(X, tmp_path):
     np.testing.assert_allclose(loaded.predict(X), m.predict(X), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_patchtst_in_anomaly_pipeline(X):
     definition = {
         "DiffBasedAnomalyDetector": {
@@ -99,16 +103,22 @@ def test_patchtst_in_anomaly_pipeline(X):
     assert isinstance(round_tripped, DiffBasedAnomalyDetector)
 
 
-def _fleet_bucket_history(attention_impl):
+def _fleet_bucket_history(
+    attention_impl, lookback=16, stride=None, mesh=None, n_machines=2
+):
+    patchtst = {
+        "lookback_window": lookback, "patch_length": 8,
+        "d_model": 16, "n_heads": 2, "n_layers": 1,
+        "epochs": 1, "batch_size": 32,
+        "attention_impl": attention_impl,
+    }
+    if stride is not None:
+        patchtst["stride"] = stride
     config = {
         "DiffBasedAnomalyDetector": {
             "base_estimator": {
                 "TransformedTargetRegressor": {
-                    "regressor": {"PatchTSTAutoEncoder": {
-                        "lookback_window": 16, "patch_length": 8,
-                        "d_model": 16, "n_heads": 2, "n_layers": 1,
-                        "epochs": 1, "batch_size": 32,
-                        "attention_impl": attention_impl}},
+                    "regressor": {"PatchTSTAutoEncoder": patchtst},
                     "transformer": "MinMaxScaler",
                 }
             }
@@ -117,11 +127,13 @@ def _fleet_bucket_history(attention_impl):
     probe = pipeline_from_definition(config)
     spec = _spec_for(_analyze_model(probe), 3, 3, 1)
     rng = np.random.default_rng(0)
-    Xs = rng.normal(size=(2, 128, 3)).astype(np.float32)
+    Xs = rng.normal(size=(n_machines, 128, 3)).astype(np.float32)
     result = train_fleet_arrays(
         spec,
-        MachineBatch(X=Xs, y=Xs.copy(), w=np.ones((2, 128), np.float32),
-                     keys=jax.random.split(jax.random.PRNGKey(0), 2)),
+        MachineBatch(X=Xs, y=Xs.copy(),
+                     w=np.ones((n_machines, 128), np.float32),
+                     keys=jax.random.split(jax.random.PRNGKey(0), n_machines)),
+        mesh=mesh,
     )
     history = np.asarray(result.loss_history)
     assert np.isfinite(history).all()
@@ -140,6 +152,29 @@ def test_patchtst_fleet_bucket_dense_and_flash_agree():
     np.testing.assert_allclose(flash, dense, rtol=1e-3, atol=1e-5)
 
 
+@pytest.mark.slow
+def test_patchtst_fleet_bucket_ring_matches_dense():
+    """VERDICT r2 #7: ring attention INSIDE the fleet program. The module's
+    shard_map over the patch axis composes with the fleet's vmap — and with
+    the fleet's mesh-sharded jit over the same 8 devices — and the math is
+    exact: training trajectories must match dense (a silently-wrong
+    collective would train to a finite but different loss)."""
+    # 64-lookback / stride 8 → 8 patches = the 8-device ring exactly
+    dense = _fleet_bucket_history("dense", lookback=64, stride=8)
+    ring = _fleet_bucket_history("ring", lookback=64, stride=8)
+    np.testing.assert_allclose(ring, dense, rtol=1e-3, atol=1e-5)
+
+    # machine axis sharded over the SAME devices the patch ring rotates on
+    mesh = fleet_mesh(8)
+    dense_m = _fleet_bucket_history(
+        "dense", lookback=64, stride=8, mesh=mesh, n_machines=8
+    )
+    ring_m = _fleet_bucket_history(
+        "ring", lookback=64, stride=8, mesh=mesh, n_machines=8
+    )
+    np.testing.assert_allclose(ring_m, dense_m, rtol=1e-3, atol=1e-5)
+
+
 # ------------------------------------------------------------ ring attention
 def test_ring_attention_matches_dense():
     rng = np.random.default_rng(0)
@@ -153,6 +188,72 @@ def test_ring_attention_matches_dense():
         np.asarray(dense_attention(q, k, v)),
         atol=2e-5,
     )
+
+
+def test_ring_flash_composition_matches_dense():
+    """VERDICT r2 #8: the Pallas block kernel as the per-hop update inside
+    the ring scan — the sharded long-context path with NO HBM-materialized
+    scores at any level. Forward and all three gradients must match dense
+    on the 8-device mesh."""
+    rng = np.random.default_rng(3)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, 64, 4, 16)), jnp.float32)
+        for _ in range(3)
+    )
+    mesh = fleet_mesh(8, axis_name="seq")
+    np.testing.assert_allclose(
+        np.asarray(ring_attention(q, k, v, mesh, block_impl="flash")),
+        np.asarray(dense_attention(q, k, v)),
+        atol=2e-5,
+    )
+
+    def loss_rf(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, block_impl="flash") ** 2)
+
+    def loss_dn(q, k, v):
+        return jnp.sum(dense_attention(q, k, v) ** 2)
+
+    g_rf = jax.jit(jax.grad(loss_rf, argnums=(0, 1, 2)))(q, k, v)
+    g_dn = jax.jit(jax.grad(loss_dn, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_rf, g_dn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    with pytest.raises(ValueError, match="block_impl"):
+        ring_attention(q, k, v, mesh, block_impl="nope")
+
+
+@pytest.mark.slow
+def test_patchtst_ring_flash_kind_trains():
+    """attention_impl='ring_flash' plugs into the factory/estimator path:
+    a tiny PatchTST with the composed kernel trains to a finite loss and
+    matches the plain-ring trajectory (same math, different block engine)."""
+    from gordo_components_tpu.models.register import get_factory
+
+    rng = np.random.default_rng(4)
+    lookback, patch = 64, 8
+    xw = jnp.asarray(rng.normal(size=(4, lookback, 3)), jnp.float32)
+    losses = {}
+    for impl in ("ring", "ring_flash"):
+        spec = get_factory("patchtst")(
+            n_features=3, lookback_window=lookback, patch_length=patch,
+            stride=patch, d_model=16, n_heads=2, n_layers=1,
+            attention_impl=impl,
+        )
+        params = spec.module.init(
+            jax.random.PRNGKey(1), xw[:1], deterministic=True
+        )["params"]
+
+        def loss_fn(p, x):
+            out = spec.module.apply({"params": p}, x, deterministic=True)
+            return jnp.mean(out * out)
+
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params, xw)
+        assert np.isfinite(float(loss))
+        assert np.isfinite(
+            np.concatenate([np.ravel(g) for g in jax.tree_util.tree_leaves(grads)])
+        ).all()
+        losses[impl] = float(loss)
+    np.testing.assert_allclose(losses["ring"], losses["ring_flash"], rtol=1e-5)
 
 
 def test_ring_attention_nondivisible_rejected():
@@ -203,6 +304,7 @@ def _ring_factory_kwargs():
     )
 
 
+@pytest.mark.slow
 def test_patchtst_ring_forward_matches_dense_same_params():
     """SAME weights, long-window forward: the ring-sharded encoder must
     reproduce the dense encoder exactly (both impls share one param tree)."""
@@ -221,6 +323,7 @@ def test_patchtst_ring_forward_matches_dense_same_params():
     )
 
 
+@pytest.mark.slow
 def test_patchtst_ring_estimator_trains_and_predicts():
     """attention_impl threads through the estimator: fit + predict run the
     ring path under jit on the 8-virtual-device mesh."""
@@ -263,6 +366,7 @@ def test_patchtst_d_model_heads_divisibility_rejected():
         get_factory("patchtst")(n_features=3, d_model=18, n_heads=4)
 
 
+@pytest.mark.slow
 def test_patchtst_remat_same_values_and_grads():
     """remat=True recomputes encoder activations on backward (HBM lever for
     plant-scale configs) without changing outputs or gradients."""
