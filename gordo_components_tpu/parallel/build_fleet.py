@@ -67,6 +67,7 @@ def _prepare_slice(
     n_features: int,
     n_targets: int,
     quantize_rows: bool,
+    span: Optional[Tuple[int, int]] = None,
 ):
     """Host-side ingest for one slice: provider fetch + padded stacked
     assembly. Runs on the prefetch worker so slice ``s+1``'s data-lake reads
@@ -75,12 +76,24 @@ def _prepare_slice(
     slices' data (double buffer), not one — still bounded and documented at
     the slice_size knob.
 
+    ``span=(lo, hi)``: assemble only machine rows ``[lo, hi)`` of the padded
+    slice — the multi-host streaming-ingest path, where each process
+    fetches ONLY its own machines' data (the machine axis is sharded over
+    processes) and the assembled block becomes this process's shard of the
+    global batch. The default covers the whole slice (single-host). NOTE:
+    the returned row count is the LOCAL maximum; multi-host callers must
+    exchange it for the global maximum before building global arrays (done
+    on the main thread — collectives must never run on the prefetch worker,
+    or two processes could order them differently and deadlock).
+
     Every shape input is an explicit argument (not a closure over bucket-loop
     locals): the call runs on another thread, and late-bound locals would
     silently go stale if a future ever crossed a bucket boundary (ADVICE r2).
     """
+    lo, hi = span if span is not None else (0, n_padded)
+    local_items = slice_items[lo:min(hi, len(slice_items))]
     fetch_started = time.perf_counter()
-    for item in slice_items:
+    for item in local_items:
         if "X" in item:  # width probe already fetched it
             continue
         X_frame, y_frame = item["dataset"].get_data()
@@ -88,17 +101,17 @@ def _prepare_slice(
         item["y"] = np.asarray(getattr(y_frame, "values", y_frame), np.float32)
         item["dataset_metadata"] = item["dataset"].get_metadata()
 
-    n_rows = max(len(item["X"]) for item in slice_items)
+    n_rows = max((len(item["X"]) for item in local_items), default=1)
     if quantize_rows:
         # quantize the row axis so slices with slightly different history
         # lengths share one (n_padded, n_rows, F) shape and the bucket
         # reuses a single compiled executable; padded rows are zero-weight
         # and masked everywhere (fold masks run on real-sample ranks)
         n_rows = -(-n_rows // _ROW_QUANTUM) * _ROW_QUANTUM
-    X = np.zeros((n_padded, n_rows, n_features), np.float32)
-    y = np.zeros((n_padded, n_rows, n_targets), np.float32)
-    w = np.zeros((n_padded, n_rows), np.float32)
-    for i, item in enumerate(slice_items):
+    X = np.zeros((hi - lo, n_rows, n_features), np.float32)
+    y = np.zeros((hi - lo, n_rows, n_targets), np.float32)
+    w = np.zeros((hi - lo, n_rows), np.float32)
+    for i, item in enumerate(local_items):
         rows = len(item["X"])
         # RIGHT-aligned by convention (rows end at the bucket's latest
         # timestamp). CV correctness does not depend on placement: fold
@@ -108,6 +121,61 @@ def _prepare_slice(
         y[i, n_rows - rows :] = item["y"]
         w[i, n_rows - rows :] = 1.0
     return X, y, w, n_rows, time.perf_counter() - fetch_started
+
+
+def _local_machine_span(mesh, n_padded: int) -> Tuple[int, int]:
+    """Contiguous ``[lo, hi)`` of machine indices this process's devices own
+    under :func:`~gordo_components_tpu.parallel.mesh.fleet_sharding` for a
+    padded machine axis of ``n_padded`` — derived from the sharding itself,
+    never from assumptions about device ordering."""
+    from .mesh import fleet_sharding
+
+    starts, stops = [], []
+    for dev, idx in fleet_sharding(mesh).devices_indices_map(
+        (n_padded,)
+    ).items():
+        if dev.process_index != jax.process_index():
+            continue
+        sl = idx[0]
+        starts.append(0 if sl.start is None else sl.start)
+        stops.append(n_padded if sl.stop is None else sl.stop)
+    if not starts:
+        raise ValueError(
+            "This process owns no devices in the fleet mesh — every "
+            "participating process must contribute devices"
+        )
+    lo, hi = min(starts), max(stops)
+    owned = sum(stop - start for start, stop in zip(starts, stops))
+    if owned != hi - lo:
+        # interleaved per-process devices (a custom mesh not in
+        # jax.devices() order) would make the min/max span cover OTHER
+        # processes' machines — fail loudly instead of fetching and
+        # assembling the wrong shard
+        raise ValueError(
+            "This process's fleet-mesh shards are not contiguous "
+            f"(owns {owned} of span [{lo}, {hi})); build the mesh with "
+            "parallel.distributed.global_fleet_mesh() so each process's "
+            "devices are adjacent on the machine axis"
+        )
+    return lo, hi
+
+
+def _gather_local_block(result):
+    """Pull THIS process's contiguous machine block of a globally-sharded
+    stacked result to host numpy (``jax.device_get`` on the whole tree
+    would fault on non-addressable shards)."""
+
+    def pull(a):
+        if not hasattr(a, "addressable_shards"):
+            return np.asarray(a)
+        seen = {}
+        for s in a.addressable_shards:
+            start = s.index[0].start or 0
+            if start not in seen:
+                seen[start] = np.asarray(s.data)
+        return np.concatenate([seen[k] for k in sorted(seen)], axis=0)
+
+    return jax.tree_util.tree_map(pull, result)
 
 
 def _abstract_result(spec, n_machines, n_rows, n_features, n_targets):
@@ -253,10 +321,16 @@ def _write_manifest(
     """Fleet completion bitmap (SURVEY.md §6.4): one JSON file in the output
     dir recording which machines are done, rewritten atomically after every
     slice — a monitor (or a resuming build) reads fleet progress without
-    scanning the registry."""
+    scanning the registry. Multi-host: each non-zero process writes its own
+    ``fleet_manifest.p{i}.json`` (its machine shard) so concurrent writers
+    on shared storage never clobber each other; a monitor unions the files."""
     import os
     import tempfile
 
+    manifest_file = MANIFEST_FILE
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        stem, ext = os.path.splitext(MANIFEST_FILE)
+        manifest_file = f"{stem}.p{jax.process_index()}{ext}"
     os.makedirs(output_dir, exist_ok=True)
     payload = {
         "updated": time.strftime("%Y-%m-%d %H:%M:%S%z"),
@@ -269,7 +343,7 @@ def _write_manifest(
     try:
         with os.fdopen(fd, "w") as fh:
             json.dump(payload, fh, indent=2)
-        os.replace(tmp, os.path.join(output_dir, MANIFEST_FILE))
+        os.replace(tmp, os.path.join(output_dir, manifest_file))
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -465,6 +539,20 @@ def build_fleet(
     finishes — a killed build loses at most one in-flight slice, and the
     resume pass skips everything already registered. ``slice_size=None``
     trains each bucket in a single program call (round-1 behavior).
+
+    **Multi-host** (``jax.process_count() > 1`` with a
+    :func:`~gordo_components_tpu.parallel.distributed.global_fleet_mesh`):
+    every process runs the same deterministic bucketing, but fetches ONLY
+    its own machines' data (the slice prefetcher assembles the process-local
+    shard, overlapping the previous slice's training as on one host), the
+    shards become one global batch via
+    ``jax.make_array_from_process_local_data``, and after training each
+    process writes only its own machines' artifacts + registry keys.
+    Requires ``output_dir``/``model_register_dir`` on storage shared by all
+    processes (the reference's shared-volume assumption) so resume scans
+    agree; each process's return value covers cached + its own machines.
+    Slice checkpointing is host-local and therefore disabled multi-host —
+    the per-machine registry resume covers restarts.
     """
     import os
 
@@ -475,6 +563,19 @@ def build_fleet(
         # invalid value errors even on a fully-cached (no-op) build
         raise ValueError(
             f"slice_size must be a positive integer or None, got {slice_size!r}"
+        )
+    multihost = jax.process_count() > 1
+    if multihost:
+        if mesh is None:
+            raise ValueError(
+                "multi-host fleet builds need a global mesh "
+                "(parallel.distributed.global_fleet_mesh())"
+            )
+        logger.info(
+            "Multi-host fleet build: process %d/%d fetches and writes only "
+            "its own machine shard; slice checkpointing disabled",
+            jax.process_index(),
+            jax.process_count(),
         )
 
     timer = PhaseTimer()
@@ -589,9 +690,11 @@ def build_fleet(
                 n_features,
             )
             quantize_rows = len(slices) > 1
+            span = _local_machine_span(mesh, n_padded) if multihost else None
             prepared = prefetcher.submit(
                 _prepare_slice,
                 slices[0], n_padded, n_features, n_targets, quantize_rows,
+                span,
             )
             for s, slice_items in enumerate(slices):
                 slice_started = time.perf_counter()
@@ -601,36 +704,90 @@ def build_fleet(
                     prepared = prefetcher.submit(
                         _prepare_slice,
                         slices[s + 1], n_padded, n_features, n_targets,
-                        quantize_rows,
+                        quantize_rows, span,
                     )
                 keys = jax.random.split(
                     jax.random.fold_in(jax.random.fold_in(master_key, b), s),
                     n_padded,
                 )
 
+                if multihost:
+                    # main thread only (see _prepare_slice): agree on the
+                    # global row width, then lift the process-local shards
+                    # into one global batch — ingest stayed process-local
+                    # and overlapped, only this assembly is synchronous
+                    from jax.experimental import multihost_utils
+
+                    from .mesh import fleet_sharding
+
+                    n_rows_global = int(
+                        multihost_utils.process_allgather(
+                            np.asarray([n_rows])
+                        ).max()
+                    )
+                    if n_rows_global != n_rows:
+                        # leading pad keeps every machine right-aligned
+                        pad = ((0, 0), (n_rows_global - n_rows, 0))
+                        X = np.pad(X, pad + ((0, 0),))
+                        y = np.pad(y, pad + ((0, 0),))
+                        w = np.pad(w, pad)
+                        n_rows = n_rows_global
+                    sharding = fleet_sharding(mesh)
+                    lo, hi = span
+                    batch = MachineBatch(
+                        X=jax.make_array_from_process_local_data(sharding, X),
+                        y=jax.make_array_from_process_local_data(sharding, y),
+                        w=jax.make_array_from_process_local_data(sharding, w),
+                        keys=jax.make_array_from_process_local_data(
+                            sharding, np.asarray(keys)[lo:hi]
+                        ),
+                    )
+                else:
+                    batch = MachineBatch(X=X, y=y, w=w, keys=keys)
+
                 ckpt_key = checkpointer.slice_key(slice_items)
-                result = checkpointer.try_restore(
-                    ckpt_key,
-                    lambda: _abstract_result(
-                        spec, n_padded, n_rows, n_features, n_targets
-                    ),
+                result = (
+                    None
+                    if multihost  # host-local orbax ckpt can't cover a
+                    # globally-sharded result; registry resume suffices
+                    else checkpointer.try_restore(
+                        ckpt_key,
+                        lambda: _abstract_result(
+                            spec, n_padded, n_rows, n_features, n_targets
+                        ),
+                    )
                 )
                 if result is None:
                     with timer.phase("train"), device_trace(profile_dir):
-                        result = train_fleet_arrays(
-                            spec, MachineBatch(X=X, y=y, w=w, keys=keys), mesh=mesh
+                        result = train_fleet_arrays(spec, batch, mesh=mesh)
+                        result = (
+                            _gather_local_block(result)
+                            if multihost
+                            else jax.device_get(result)
                         )
-                        result = jax.device_get(result)
-                    # async: orbax writes in the background while the artifact
-                    # loop below runs; finalize() below joins + deletes
-                    checkpointer.save_async(ckpt_key, result)
+                    if not multihost:
+                        # async: orbax writes in the background while the
+                        # artifact loop below runs; finalize() joins + deletes
+                        checkpointer.save_async(ckpt_key, result)
                 slice_duration = time.perf_counter() - slice_started
+
+                if multihost:
+                    lo, hi = span
+                    # this process's machines only; result rows are the
+                    # local block, so indices shift by lo
+                    indexed_items = [
+                        (i - lo, item)
+                        for i, item in enumerate(slice_items)
+                        if lo <= i < hi
+                    ]
+                else:
+                    indexed_items = list(enumerate(slice_items))
 
                 with timer.phase("artifacts"):
                     # ---- per-machine artifacts (same format as the single path),
                     # written before the next slice trains so a kill loses at most
                     # the in-flight slice ------------------------------------------
-                    for i, item in enumerate(slice_items):
+                    for i, item in indexed_items:
                         machine = item["machine"]
                         model = pipeline_from_definition(machine.model_config)
                         _install_result(
@@ -687,9 +844,10 @@ def build_fleet(
                         manifest,
                         [name for name in (m.name for m, *_ in pending) if name not in manifest],
                     )
-                with timer.phase("checkpoint_wait"):
-                    # artifacts durable → join the async save and drop the ckpt
-                    checkpointer.finalize(ckpt_key)
+                if not multihost:
+                    with timer.phase("checkpoint_wait"):
+                        # artifacts durable → join the async save, drop the ckpt
+                        checkpointer.finalize(ckpt_key)
                 for item in slice_items:  # free before the next slice fetches
                     item.pop("X", None)
                     item.pop("y", None)

@@ -82,18 +82,36 @@ class WatchmanServer:
     def _build_progress(self) -> Optional[Dict]:
         """Summary of the fleet build manifest, or an error record when the
         path is set but unreadable (a monitor must see that the manifest is
-        gone, not a silently vanished field)."""
+        gone, not a silently vanished field).
+
+        Multi-host builds write one manifest per process
+        (``fleet_manifest.json`` + ``fleet_manifest.p<i>.json`` siblings —
+        see build_fleet._write_manifest); the union is the fleet view:
+        completed machines are the union of every file's, and a machine is
+        pending only while NO process has completed it."""
         if not self.manifest_path:
             return None
+        import glob
+        import os
+
+        stem, ext = os.path.splitext(self.manifest_path)
+        paths = [self.manifest_path] + sorted(glob.glob(f"{stem}.p*{ext}"))
         try:
-            with open(self.manifest_path) as fh:
-                manifest = json.load(fh)
-            pending = manifest.get("pending") or []
+            completed: Dict = {}
+            pending: set = set()
+            updated = None
+            for path in paths:
+                with open(path) as fh:
+                    manifest = json.load(fh)
+                completed.update(manifest.get("machines") or {})
+                pending |= set(manifest.get("pending") or [])
+                updated = max(updated or "", manifest.get("updated") or "")
+            still_pending = sorted(pending - set(completed))
             return {
-                "updated": manifest.get("updated"),
-                "n_completed": manifest.get("n_completed"),
-                "n_pending": manifest.get("n_pending"),
-                "pending": pending[:50],  # capped for 10k fleets
+                "updated": updated or None,
+                "n_completed": len(completed),
+                "n_pending": len(still_pending),
+                "pending": still_pending[:50],  # capped for 10k fleets
             }
         except (OSError, ValueError, AttributeError, TypeError) as exc:
             # wrong-shaped JSON (top-level list, null pending) must degrade

@@ -1,12 +1,16 @@
-"""Child process for the 2-process distributed fleet test (test_aux.py).
+"""Child process for the 2-process distributed fleet tests (test_aux.py).
 
 Run as: python multihost_child.py <process_id> <num_processes> <port>
+        python multihost_child.py <process_id> <num_processes> <port> --build <dir>
 
-Each process joins the jax.distributed runtime (Gloo over localhost),
-spans a global fleet mesh over BOTH processes' virtual CPU devices, and
-runs a sharded fleet train step where its process only holds its own
-machines' data — the real multi-host layout (SURVEY.md §2.3): machine
-shards are process-local, collectives cross the process boundary.
+Each process joins the jax.distributed runtime (Gloo over localhost) and
+spans a global fleet mesh over BOTH processes' virtual CPU devices. The
+default mode runs a sharded fleet train step where each process only holds
+its own machines' data. ``--build`` runs the FULL ``build_fleet`` pipeline
+multi-host: sliced buckets, process-local streaming ingest through the
+prefetcher, global-batch assembly, and per-process artifact writes
+(SURVEY.md §2.3: machine shards are process-local, collectives cross the
+process boundary).
 """
 
 import os
@@ -19,6 +23,71 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
+
+
+def build_mode(output_dir: str) -> None:
+    """Multi-host build_fleet: 16 machines, slice_size=8 → one bucket in two
+    slices of 8 (each process ingests + trains + writes 4 machines per
+    slice). Prints this process's built machine names for the parent to
+    union-check."""
+    from gordo_components_tpu.parallel import FleetMachineConfig, build_fleet
+    from gordo_components_tpu.parallel.distributed import global_fleet_mesh
+
+    mesh = global_fleet_mesh()
+    model_config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "Pipeline": {
+                    "steps": [
+                        "MinMaxScaler",
+                        {
+                            "DenseAutoEncoder": {
+                                "kind": "feedforward_hourglass",
+                                "epochs": 1,
+                                "batch_size": 16,
+                            }
+                        },
+                    ]
+                }
+            }
+        }
+    }
+    machines = [
+        FleetMachineConfig(
+            name=f"mh-{i:02d}",
+            model_config=model_config,
+            data_config={
+                "type": "RandomDataset",
+                "train_start_date": "2023-01-01T00:00:00+00:00",
+                "train_end_date": "2023-01-03T00:00:00+00:00",
+                "tag_list": [f"mh{i}-a", f"mh{i}-b", f"mh{i}-c"],
+            },
+        )
+        for i in range(16)
+    ]
+    registry = os.path.join(output_dir, "registry")
+    results = build_fleet(
+        machines,
+        os.path.join(output_dir, "models"),
+        model_register_dir=registry,
+        mesh=mesh,
+        n_splits=1,
+        slice_size=8,
+    )
+    # every artifact this process wrote must be loadable and score finitely
+    from gordo_components_tpu.serializer import load
+
+    for name, model_dir in sorted(results.items()):
+        model = load(model_dir)
+        X = np.random.default_rng(3).normal(size=(24, 3)).astype(np.float32)
+        frame = model.anomaly(X)
+        assert np.isfinite(
+            np.ravel(frame["total-anomaly-score"].values)
+        ).all(), name
+    print(
+        f"built@{jax.process_index()}: {','.join(sorted(results))}",
+        flush=True,
+    )
 
 
 def main() -> None:
@@ -35,6 +104,10 @@ def main() -> None:
         process_id=pid,
     )
     assert jax.process_count() == nproc
+
+    if len(sys.argv) >= 6 and sys.argv[4] == "--build":
+        build_mode(sys.argv[5])
+        return
 
     from jax.sharding import NamedSharding, PartitionSpec
 

@@ -134,6 +134,34 @@ def test_watchman_aggregates_health(served):
     assert watchman.get("/nope").status_code == 404
 
 
+def test_watchman_unions_multihost_manifests(tmp_path):
+    """Multi-host builds write fleet_manifest.json + fleet_manifest.p<i>.json
+    siblings; watchman's build-progress view must union them — a machine is
+    pending only while NO process has completed it."""
+    import json
+
+    from werkzeug.test import Client as TestClient
+
+    main = tmp_path / "fleet_manifest.json"
+    main.write_text(json.dumps({
+        "updated": "2026-01-01 00:00:00+0000",
+        "machines": {"m-0": {"status": "completed"}},
+        "pending": ["m-1"],
+    }))
+    (tmp_path / "fleet_manifest.p1.json").write_text(json.dumps({
+        "updated": "2026-01-01 00:00:05+0000",
+        "machines": {"m-1": {"status": "completed"}},
+        "pending": ["m-0"],
+    }))
+    app = build_watchman_app("proj", [], target_url="http://127.0.0.1:9",
+                             manifest_path=str(main))
+    body = TestClient(app).get("/").get_json()
+    progress = body["build"]
+    assert progress["n_completed"] == 2
+    assert progress["n_pending"] == 0 and progress["pending"] == []
+    assert progress["updated"] == "2026-01-01 00:00:05+0000"
+
+
 def test_client_predict_frame_parquet(served):
     """predict_frame POSTs a client-held DataFrame as parquet and returns a
     timestamp-indexed scored frame."""
