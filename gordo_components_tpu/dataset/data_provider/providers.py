@@ -151,6 +151,11 @@ class InfluxDataProvider(GordoBaseDataProvider):
     reference's InfluxDataProvider. The ``influxdb`` client is optional and
     not shipped in this image, so instantiation is allowed (configs must
     round-trip) but reads raise with a clear message until it is installed.
+
+    **Status: experimental.** Tested only against an injected fake client
+    (the image has no influxdb package or server); treat real-InfluxDB
+    behavior as unvalidated until exercised against one (README notes the
+    same).
     """
 
     def __init__(

@@ -90,6 +90,7 @@ def test_anomaly_detects_injected_spike(fitted, frame):
     assert spiked[100] > np.median(spiked) * 3
 
 
+@pytest.mark.slow
 def test_anomaly_tail_alignment_lstm(frame):
     L = 8
     det = DiffBasedAnomalyDetector(

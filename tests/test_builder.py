@@ -40,6 +40,7 @@ ANOMALY_CONFIG = {
 }
 
 
+@pytest.mark.slow
 def test_build_model_metadata_contract():
     model, meta = build_model("machine-1", MODEL_CONFIG, DATA_CONFIG,
                               metadata={"owner": "team-x"})
@@ -63,6 +64,7 @@ def test_build_model_anomaly_detector_cv():
     assert meta["model"]["cross_validation"]["n_splits"] == 3
 
 
+@pytest.mark.slow
 def test_build_model_cv_modes():
     _, meta = build_model("m", MODEL_CONFIG, DATA_CONFIG,
                           evaluation_config={"cv_mode": "build_only"})

@@ -121,6 +121,7 @@ def test_cli_build_exit_codes(runner, tmp_path):
     assert result.exit_code in (64, 2)
 
 
+@pytest.mark.slow
 def test_cli_fleet_build(runner, tmp_path):
     config_file = tmp_path / "fleet.yaml"
     config_file.write_text(yaml.safe_dump(FLEET_YAML))

@@ -41,6 +41,7 @@ def test_entry_scoring_semantics():
     assert jnp.allclose(total2, 2.0 * total1, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_in_process():
     assert jax.device_count() >= 8, "conftest must provision 8 virtual devices"
     graft_entry.dryrun_multichip(8)

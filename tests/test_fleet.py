@@ -325,6 +325,7 @@ def test_fleet_cache_key_includes_eval_config():
     assert base != fleet
 
 
+@pytest.mark.slow
 def test_fleet_standard_scaler_options_honored():
     config = {
         "Pipeline": {
@@ -349,6 +350,7 @@ def test_fleet_standard_scaler_options_honored():
     )
 
 
+@pytest.mark.slow
 def test_fleet_target_scaler_independent_of_input_scaler():
     """TTR transformer with NO input scaler: targets must still be
     minmax-scaled (the target scaler kind comes from the transformer, not

@@ -170,6 +170,7 @@ def both_builds(tmp_path_factory):
     return single_dir, fleet_dirs["parity-m"]
 
 
+@pytest.mark.slow
 def test_anomaly_outputs_close(both_builds):
     single_dir, fleet_dir = both_builds
     single = load(single_dir)
@@ -212,6 +213,7 @@ def test_anomaly_outputs_close(both_builds):
         assert tot[anomalous_rows].mean() > 3 * tot[healthy].mean()
 
 
+@pytest.mark.slow
 def test_cv_scores_comparable(both_builds):
     single_dir, fleet_dir = both_builds
     meta_s = load_metadata(single_dir)["model"]["cross_validation"]
@@ -233,6 +235,7 @@ def test_cv_scores_comparable(both_builds):
         assert abs(s - f) < tol, f"{name} diverges: {s} vs {f}"
 
 
+@pytest.mark.slow
 def test_thresholds_same_scale(both_builds):
     single_dir, fleet_dir = both_builds
     meta_s = load_metadata(single_dir)["model"]

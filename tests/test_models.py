@@ -235,6 +235,7 @@ def test_multi_step_forecast_horizon(X):
         m.set_params(horizon=0)  # same contract as the constructor
 
 
+@pytest.mark.slow
 def test_lstm_dropout_trains(X):
     m = LSTMAutoEncoder(kind="lstm_hourglass", lookback_window=4,
                         encoding_layers=1, dropout=0.3, epochs=2, batch_size=64)
@@ -355,6 +356,7 @@ def test_state_round_trip(X):
     assert m2.history_ == m.history_
 
 
+@pytest.mark.slow
 def test_set_params_routes_factory_kwargs(X):
     m = LSTMAutoEncoder(kind="lstm_symmetric", lookback_window=4, dims=(8,))
     m.set_params(lookback_window=6, dims=(4,), epochs=2, batch_size=64)
