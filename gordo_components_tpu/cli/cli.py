@@ -59,6 +59,17 @@ def gordo(log_level: str, debug_nans: bool):
         level=log_level.upper(),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    import os
+
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        # pin via jax.config too: with an accelerator plugin installed the
+        # env var alone is unreliable (observed on this rig: a JAX_PLATFORMS
+        # =cpu child still initialized the TPU plugin and hung on its dead
+        # tunnel); the config update must land before first backend init
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
     if debug_nans:
         import jax
 
@@ -134,15 +145,42 @@ def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
                    "slice's artifacts + registry keys land as it finishes, "
                    "so a killed build loses at most one slice; 0 disables "
                    "slicing (whole bucket per program call)")
+@click.option("--coordinator-address", envvar="GORDO_COORDINATOR", default=None,
+              help="multi-host: jax.distributed coordinator host:port — run "
+                   "the SAME command on every host; each fetches and writes "
+                   "only its own machine shard (requires shared storage for "
+                   "output/registry dirs). Omit for cluster autodetection "
+                   "(TPU pod metadata) or single-host builds")
+@click.option("--num-processes", envvar="GORDO_NUM_PROCESSES", default=None,
+              type=int, help="multi-host: total process count")
+@click.option("--process-id", envvar="GORDO_PROCESS_ID", default=None,
+              type=int, help="multi-host: this host's process index")
 def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
-                    n_splits, seed, slice_size):
-    """Build an entire fleet in one process: machines are bucketed and
-    trained as vmapped programs sharded over the device mesh."""
+                    n_splits, seed, slice_size, coordinator_address,
+                    num_processes, process_id):
+    """Build an entire fleet: machines are bucketed and trained as vmapped
+    programs sharded over the device mesh. With ``--coordinator-address``
+    (or on a TPU pod with autodetectable cluster metadata plus explicit
+    ``--num-processes``), the build runs multi-host — every process ingests
+    and writes only its own machine shard."""
     from ..dataset.dataset import InsufficientDataError
     from ..parallel import FleetMachineConfig, build_fleet, fleet_mesh
     from ..workflow import NormalizedConfig
 
     try:
+        multihost = coordinator_address is not None or num_processes is not None
+        if multihost:
+            # must run BEFORE anything touches the XLA backend
+            from ..parallel.distributed import (
+                global_fleet_mesh,
+                initialize_multihost,
+            )
+
+            initialize_multihost(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
         config = NormalizedConfig(_load_config(machine_config, "machine-config"))
         machines = [
             FleetMachineConfig(
@@ -154,7 +192,12 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
             )
             for machine in config.machines
         ]
-        mesh = fleet_mesh(n_devices)
+        if multihost and n_devices is not None:
+            logger.warning(
+                "--n-devices is ignored in multi-host mode: the global "
+                "fleet mesh spans every device of every process"
+            )
+        mesh = global_fleet_mesh() if multihost else fleet_mesh(n_devices)
         results = build_fleet(
             machines,
             output_dir,

@@ -55,6 +55,62 @@ def test_bench_emits_valid_json_with_split_measurements(tmp_path):
     assert cfg["machines_per_hour_serial"] <= cfg["machines_per_hour"]
 
 
+def test_all_bench_configs_build_specs():
+    """Every bench config (incl. the TPU-only plant shape, which no CPU run
+    ever trains) must at least parse into a pipeline and a fleet spec —
+    catching config typos long before a one-shot TPU run."""
+    import sys
+
+    sys.path.insert(0, _REPO_ROOT)
+    import bench
+
+    from gordo_components_tpu.parallel.build_fleet import (
+        _analyze_model,
+        _spec_for,
+    )
+    from gordo_components_tpu.serializer import pipeline_from_definition
+
+    configs = bench._configs(full=False, epochs=2, machines=2)
+    assert "plant_10ktag_bf16" in configs
+    for name, cfg in configs.items():
+        probe = pipeline_from_definition(cfg["model"])
+        tags = cfg["tags"]
+        spec = _spec_for(_analyze_model(probe), tags, tags, cfg["n_splits"])
+        assert spec.lookback_window >= 1, name
+    plant = configs["plant_10ktag_bf16"]
+    assert plant["tags"] == 10_000 and plant.get("tpu_only")
+
+
+def test_bench_failed_config_does_not_redden_artifact(monkeypatch, capsys):
+    """A config that raises (plant-scale OOM on a small chip) must record an
+    error and leave the artifact parseable with the headline intact."""
+    import sys
+
+    sys.path.insert(0, _REPO_ROOT)
+    import bench
+
+    real = bench._bench_config
+
+    def exploding(name, cfg):
+        if name != "dense_ae_10tag":
+            raise RuntimeError("synthetic OOM")
+        return real(name, cfg)
+
+    monkeypatch.setattr(bench, "_bench_config", exploding)
+    monkeypatch.setenv("BENCH_CPU", "1")
+    monkeypatch.setenv("BENCH_MACHINES", "2")
+    monkeypatch.setenv("BENCH_EPOCHS", "2")
+    monkeypatch.setenv(
+        "BENCH_CONFIGS", "dense_ae_10tag,lstm_ae_50tag"
+    )
+    bench.main()
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["value"] > 0
+    assert payload["configs"]["lstm_ae_50tag"] == {
+        "error": "RuntimeError: synthetic OOM"
+    }
+
+
 _FALLBACK_SCRIPT = """
 import json, os, sys
 from gordo_components_tpu.utils import backend
@@ -93,6 +149,35 @@ def test_bench_falls_back_to_cpu_when_probe_hangs(tmp_path):
     payload = json.loads(proc.stdout.strip().splitlines()[-1])
     assert payload == {"platform": "cpu", "forced": True}
     assert "re-running on the CPU backend" in proc.stderr
+
+
+@pytest.mark.slow
+def test_bench_degraded_mode_runs_headline_only(tmp_path):
+    """The tunnel-down fallback must fit the driver's budget: it measures
+    the headline dense fleet, skips the MXU-workload configs (hours on
+    CPU), and says so in the degraded field."""
+    from gordo_components_tpu.utils.backend import FORCED_CPU_ENV
+
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        env={
+            "PATH": "/usr/bin:/bin",
+            "HOME": str(tmp_path),
+            FORCED_CPU_ENV: "1",
+            "BENCH_MACHINES": "2",
+            "BENCH_EPOCHS": "2",
+            "JAX_PLATFORMS": "cpu",
+        },
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert list(payload["configs"]) == ["dense_ae_10tag"]
+    assert "skipped MXU-workload configs" in payload["degraded"]
+    assert payload["device"] == "cpu"
 
 
 @pytest.mark.slow

@@ -139,6 +139,49 @@ def test_cli_fleet_build(runner, tmp_path):
         load(model_dir)
 
 
+def _jax_cache_dir():
+    import jax as _jax
+
+    return _jax.config.jax_compilation_cache_dir
+
+
+@pytest.mark.slow
+def test_cli_fleet_build_multihost_flags(tmp_path):
+    """--coordinator-address wires jax.distributed init + the global fleet
+    mesh into fleet-build. Run as a 1-process 'cluster' in a subprocess
+    (distributed init is process-global state pytest must not inherit)."""
+    import socket
+    import subprocess
+    import sys
+
+    config_file = tmp_path / "fleet.yaml"
+    config_file.write_text(yaml.safe_dump(FLEET_YAML))
+    out = str(tmp_path / "models")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.run(
+        [sys.executable, "-m", "gordo_components_tpu.cli", "fleet-build",
+         "--machine-config", str(config_file), "--output-dir", out,
+         "--n-splits", "0",
+         "--coordinator-address", f"127.0.0.1:{port}",
+         "--num-processes", "1", "--process-id", "0"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+             # subprocesses don't inherit conftest's jax.config cache setting
+             "JAX_COMPILATION_CACHE_DIR": _jax_cache_dir()},
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    dirs = json.loads(proc.stdout)
+    assert set(dirs) == {"fm-1", "fm-2"}
+    for model_dir in dirs.values():
+        load(model_dir)
+
+
 def test_cli_workflow_generate(runner, tmp_path):
     config_file = tmp_path / "fleet.yaml"
     config_file.write_text(yaml.safe_dump(FLEET_YAML))

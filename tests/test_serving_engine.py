@@ -241,6 +241,42 @@ def test_target_subset_parity():
     assert "subset" in blind.stats()["host_path_machines"]["sub"]
 
 
+@pytest.mark.slow
+def test_patchtst_machine_lifts_into_engine():
+    """The transformer kind serves through the stacked engine like any zoo
+    model — parity with its host anomaly path."""
+    config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "TransformedTargetRegressor": {
+                    "regressor": {
+                        "PatchTSTAutoEncoder": {
+                            "lookback_window": 16, "patch_length": 8,
+                            "d_model": 16, "n_heads": 2, "n_layers": 1,
+                            "epochs": 1, "batch_size": 16,
+                        }
+                    },
+                    "transformer": "MinMaxScaler",
+                }
+            }
+        }
+    }
+    model, X = _fit(config, n_rows=96, seed=13)
+    engine = ServingEngine({"pt": model})
+    assert engine.can_score("pt"), engine.stats()["host_path_machines"]
+    scored = engine.anomaly("pt", X)
+    frame = model.anomaly(X)
+    assert len(scored.total_anomaly_score) == len(X) - 16 + 1
+    np.testing.assert_allclose(
+        scored.model_output, frame["model-output"].values, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        scored.total_anomaly_score,
+        np.ravel(frame["total-anomaly-score"].values),
+        atol=1e-3,
+    )
+
+
 def test_unsupported_model_is_skipped():
     class Opaque:
         def predict(self, X):
