@@ -420,8 +420,35 @@ def main() -> None:
             )
         print(json.dumps(out))
         return
-    headline_name = next(
-        (k for k in ok_names if configs[k].get("headline")), ok_names[0]
+    headline_candidates = [k for k in ok_names if configs[k].get("headline")]
+    if not headline_candidates and any(
+        v.get("headline") for v in configs.values()
+    ):
+        # the headline config ran and FAILED: report that, never silently
+        # substitute another config's rate under the same metric name
+        # (a driver compares "value" against the dense-config anchors)
+        device = jax.devices()[0]
+        out = {
+            "metric": "machines_trained_per_hour",
+            "value": 0,
+            "unit": (
+                "machines/hour (HEADLINE CONFIG FAILED — see "
+                "configs.dense_ae_10tag.error; other configs measured)"
+            ),
+            "vs_baseline": 0,
+            "device": device.device_kind,
+            "configs": results,
+        }
+        if degraded:
+            out["degraded"] = (
+                "accelerator tunnel down; measured on the CPU backend"
+            )
+        print(json.dumps(out))
+        return
+    # no config carries the headline flag only when BENCH_CONFIGS restricted
+    # the set — the operator picked the config, and the unit string names it
+    headline_name = (
+        headline_candidates[0] if headline_candidates else ok_names[0]
     )
     headline = results[headline_name]
     device = jax.devices()[0]

@@ -169,6 +169,13 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
 
     try:
         multihost = coordinator_address is not None or num_processes is not None
+        if process_id is not None and not multihost:
+            # a bare process index would silently run a FULL single-host
+            # build on every host — duplicated training and racing writes
+            raise click.UsageError(
+                "--process-id requires --coordinator-address and/or "
+                "--num-processes"
+            )
         if multihost:
             # must run BEFORE anything touches the XLA backend
             from ..parallel.distributed import (

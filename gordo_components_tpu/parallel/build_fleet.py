@@ -759,7 +759,12 @@ def build_fleet(
                 )
                 if result is None:
                     with timer.phase("train"), device_trace(profile_dir):
-                        result = train_fleet_arrays(spec, batch, mesh=mesh)
+                        # donate: the placed batch is never reused after the
+                        # call, so XLA may overlay intermediates on its HBM —
+                        # the peak-memory lever for plant-scale buckets
+                        result = train_fleet_arrays(
+                            spec, batch, mesh=mesh, donate=True
+                        )
                         result = (
                             _gather_local_block(result)
                             if multihost

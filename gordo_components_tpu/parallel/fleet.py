@@ -443,26 +443,36 @@ def fleet_program(
     n_features: int,
     n_targets: int,
     mesh=None,
+    donate: bool = False,
 ):
     """The jitted vmap-over-machines program for one bucket shape, cached so
     repeated calls with the same spec/shape reuse the traced+compiled
     executable (``jax.jit`` keys on function identity — without this cache
-    every ``train_fleet_arrays`` call would re-trace)."""
+    every ``train_fleet_arrays`` call would re-trace).
+
+    ``donate=True`` donates the batch buffers to the executable: XLA may
+    reuse their HBM for intermediates, roughly halving peak memory for
+    plant-scale buckets whose ``(M, N, F)`` data approaches the chip limit.
+    The inputs are consumed — callers must not touch them after the call
+    (the builder's slice loop never does; benchmarks re-execute on the same
+    buffers and must keep the default)."""
 
     def build():
         program = jax.vmap(
             make_machine_program(spec, n_rows, n_features, n_targets)
         )
+        donate_argnums = (0, 1, 2, 3) if donate else ()
         if mesh is None:
-            return jax.jit(program)
+            return jax.jit(program, donate_argnums=donate_argnums)
         shard = fleet_sharding(mesh)
         return jax.jit(
             program,
             in_shardings=(shard, shard, shard, shard),
             out_shardings=shard,
+            donate_argnums=donate_argnums,
         )
 
-    key = (spec, n_rows, n_features, n_targets, mesh)
+    key = (spec, n_rows, n_features, n_targets, mesh, donate)
     return _cached(_PROGRAM_CACHE, _PROGRAM_CACHE_MAX, key, build)
 
 
@@ -486,6 +496,7 @@ def fleet_executable(
     n_features: int,
     n_targets: int,
     mesh=None,
+    donate: bool = False,
 ):
     """AOT-compiled fleet executable + its input formats, cached by
     (spec, shape, mesh).
@@ -503,7 +514,9 @@ def fleet_executable(
     ``device_put``).
     """
     def build():
-        program = fleet_program(spec, n_rows, n_features, n_targets, mesh=mesh)
+        program = fleet_program(
+            spec, n_rows, n_features, n_targets, mesh=mesh, donate=donate
+        )
         avatars = (
             jax.ShapeDtypeStruct((n_machines, n_rows, n_features), jnp.float32),
             jax.ShapeDtypeStruct((n_machines, n_rows, n_targets), jnp.float32),
@@ -517,7 +530,7 @@ def fleet_executable(
             formats = None
         return compiled, formats
 
-    key = (spec, n_machines, n_rows, n_features, n_targets, mesh)
+    key = (spec, n_machines, n_rows, n_features, n_targets, mesh, donate)
     return _cached(_EXEC_CACHE, _EXEC_CACHE_MAX, key, build)
 
 
@@ -549,6 +562,7 @@ def train_fleet_arrays(
     spec: FleetSpec,
     batch: MachineBatch,
     mesh=None,
+    donate: bool = False,
 ) -> MachineResult:
     """Train a stacked bucket of machines; returns stacked results.
 
@@ -560,6 +574,12 @@ def train_fleet_arrays(
     Host arrays are device-placed layout-matched via the AOT executable
     (:func:`fleet_executable`); keys uint32 dtype aside, any float inputs
     are accepted as-is.
+
+    ``donate=True`` lets XLA reuse the device-placed batch's HBM for
+    intermediates (the placed copies are consumed; the caller's host
+    arrays are untouched) — the peak-memory lever for plant-scale buckets;
+    see :func:`fleet_program`. On backends without donation support (CPU)
+    XLA ignores it with a warning.
     """
     n_machines, n_rows, n_features = batch.X.shape
     n_targets = batch.y.shape[2]
@@ -570,7 +590,8 @@ def train_fleet_arrays(
             "(build_fleet does this automatically)"
         )
     compiled, formats = fleet_executable(
-        spec, n_machines, n_rows, n_features, n_targets, mesh=mesh
+        spec, n_machines, n_rows, n_features, n_targets, mesh=mesh,
+        donate=donate,
     )
     placed = put_fleet_batch(batch, formats)
     return compiled(placed.X, placed.y, placed.w, placed.keys)

@@ -81,34 +81,75 @@ def test_all_bench_configs_build_specs():
     assert plant["tags"] == 10_000 and plant.get("tpu_only")
 
 
+_FAKE_RESULT = {
+    "machines_per_hour": 1000.0,
+    "machines_per_hour_serial": 990.0,
+    "vs_single_machine": 2.0,
+    "shape": "2x864x10",
+    "n_splits": 3,
+    "exec_s": 0.01,
+    "ingest_s": 0.001,
+    "ingest_mb": 0.1,
+    "ingest_mbps": 100.0,
+    "compile_s": 1.0,
+    "single_machine_s": 0.02,
+    "program_tflops": 0.0,
+    "mfu_vs_bf16_peak": None,
+    "peak_hbm_gb": None,
+}
+
+
 def test_bench_failed_config_does_not_redden_artifact(monkeypatch, capsys):
     """A config that raises (plant-scale OOM on a small chip) must record an
-    error and leave the artifact parseable with the headline intact."""
+    error and leave the artifact parseable with the headline intact.
+    (_bench_config is stubbed — this tests the error-isolation logic, not a
+    real measurement, so it stays in the fast tier.)"""
     import sys
 
     sys.path.insert(0, _REPO_ROOT)
     import bench
 
-    real = bench._bench_config
-
-    def exploding(name, cfg):
+    def stubbed(name, cfg):
         if name != "dense_ae_10tag":
             raise RuntimeError("synthetic OOM")
-        return real(name, cfg)
+        return dict(_FAKE_RESULT)
 
-    monkeypatch.setattr(bench, "_bench_config", exploding)
+    monkeypatch.setattr(bench, "_bench_config", stubbed)
     monkeypatch.setenv("BENCH_CPU", "1")
-    monkeypatch.setenv("BENCH_MACHINES", "2")
-    monkeypatch.setenv("BENCH_EPOCHS", "2")
     monkeypatch.setenv(
         "BENCH_CONFIGS", "dense_ae_10tag,lstm_ae_50tag"
     )
     bench.main()
     payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert payload["value"] > 0
+    assert payload["value"] == 1000.0
     assert payload["configs"]["lstm_ae_50tag"] == {
         "error": "RuntimeError: synthetic OOM"
     }
+
+
+def test_bench_failed_headline_reports_zero_not_substitute(monkeypatch, capsys):
+    """If the HEADLINE config fails, the artifact must say so with value=0 —
+    never silently relabel another config's rate as the headline metric."""
+    import sys
+
+    sys.path.insert(0, _REPO_ROOT)
+    import bench
+
+    def stubbed(name, cfg):
+        if name == "dense_ae_10tag":
+            raise RuntimeError("synthetic headline OOM")
+        return dict(_FAKE_RESULT)
+
+    monkeypatch.setattr(bench, "_bench_config", stubbed)
+    monkeypatch.setenv("BENCH_CPU", "1")
+    monkeypatch.setenv(
+        "BENCH_CONFIGS", "dense_ae_10tag,lstm_ae_50tag"
+    )
+    bench.main()
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["value"] == 0
+    assert "HEADLINE CONFIG FAILED" in payload["unit"]
+    assert payload["configs"]["lstm_ae_50tag"]["machines_per_hour"] == 1000.0
 
 
 _FALLBACK_SCRIPT = """

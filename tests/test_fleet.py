@@ -106,6 +106,24 @@ def test_fleet_on_mesh_sharded():
     )
 
 
+@pytest.mark.filterwarnings("ignore:Some donated buffers were not usable")
+def test_fleet_donation_matches_undonated():
+    """donate=True (the build_fleet path — XLA may overlay intermediates on
+    the batch's HBM) must be numerically identical to the undonated program
+    and compile as a SEPARATE cached executable."""
+    spec, batch = _make_spec_and_batch(2)
+    plain = train_fleet_arrays(spec, batch)
+    donated = train_fleet_arrays(spec, batch, donate=True)
+    np.testing.assert_allclose(
+        np.asarray(donated.loss_history), np.asarray(plain.loss_history),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(donated.total_threshold), np.asarray(plain.total_threshold),
+        rtol=1e-5,
+    )
+
+
 def test_fleet_mesh_divisibility_enforced():
     mesh = fleet_mesh()
     spec, batch = _make_spec_and_batch(3)
