@@ -433,7 +433,12 @@ def main() -> None:
             "value": 0,
             "unit": (
                 "machines/hour (HEADLINE CONFIG FAILED — see "
-                "configs.dense_ae_10tag.error; other configs measured)"
+                + ", ".join(
+                    f"configs.{k}.error"
+                    for k, v in configs.items()
+                    if v.get("headline") and k not in ok_names
+                )
+                + "; other configs measured)"
             ),
             "vs_baseline": 0,
             "device": device.device_kind,

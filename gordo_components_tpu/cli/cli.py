@@ -267,16 +267,37 @@ def run_server_cmd(model_dirs, models_dir, host, port, project):
 
 
 @gordo.command("run-watchman")
-@click.option("--project", required=True)
-@click.option("--machine", "machines", multiple=True, required=True)
-@click.option("--target-url", required=True)
+@click.option("--project", default=None)
+@click.option("--machine", "machines", multiple=True)
+@click.option("--target-url", default=None)
 @click.option("--host", default="0.0.0.0", show_default=True)
 @click.option("--port", default=5556, show_default=True)
 @click.option("--manifest", default=None,
               help="path to a fleet build's fleet_manifest.json; GET / then "
-                   "also reports build progress (completed/pending) from it")
-def run_watchman_cmd(project, machines, target_url, host, port, manifest):
-    """Serve the fleet-health aggregator."""
+                   "also reports build progress (completed/pending) from it "
+                   "(multi-host sibling manifests are unioned)")
+@click.option("--watch", is_flag=True, default=False,
+              help="no HTTP: follow the fleet manifest(s), print one JSON "
+                   "progress line per interval, exit 0 when every machine "
+                   "is completed (the reference's CRD-status evolution of "
+                   "watchman)")
+@click.option("--interval", default=5.0, show_default=True,
+              help="--watch poll interval in seconds")
+def run_watchman_cmd(project, machines, target_url, host, port, manifest,
+                     watch, interval):
+    """Serve the fleet-health aggregator (or follow a build with --watch)."""
+    if watch:
+        if not manifest:
+            raise click.UsageError("--watch requires --manifest")
+        from ..watchman import watch_build_progress
+
+        watch_build_progress(manifest, interval_s=interval)
+        return
+    if not (project and machines and target_url):
+        raise click.UsageError(
+            "--project, --machine, and --target-url are required "
+            "(or use --watch --manifest)"
+        )
     from ..watchman import run_watchman
 
     run_watchman(
@@ -303,8 +324,12 @@ def workflow_group():
               help="emit the single-Job TPU fleet spec instead of "
                    "pod-per-machine Argo")
 @click.option("--tpu-chips", default=16, show_default=True)
+@click.option("--tpu-hosts", default=1, show_default=True,
+              help="(with --tpu) >1 emits the multi-host layout: an "
+                   "Indexed Job (one pod per host) + headless coordinator "
+                   "Service wiring fleet-build's jax.distributed flags")
 def workflow_generate_cmd(machine_config, output_file, image, parallelism,
-                          tpu_mode, tpu_chips):
+                          tpu_mode, tpu_chips, tpu_hosts):
     """Fleet YAML -> Argo Workflow (reference-compatible) or TPU Job spec."""
     from ..workflow import generate_argo_workflow, generate_tpu_job
     from ..workflow.workflow_generator import validate_generated
@@ -312,7 +337,9 @@ def workflow_generate_cmd(machine_config, output_file, image, parallelism,
     try:
         config = _load_config(machine_config, "machine-config")
         if tpu_mode:
-            manifest = generate_tpu_job(config, image=image, tpu_chips=tpu_chips)
+            manifest = generate_tpu_job(
+                config, image=image, tpu_chips=tpu_chips, hosts=tpu_hosts
+            )
         else:
             manifest = generate_argo_workflow(
                 config, image=image, parallelism=parallelism

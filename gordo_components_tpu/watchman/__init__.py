@@ -1,3 +1,15 @@
-from .server import WatchmanServer, build_watchman_app, run_watchman
+from .server import (
+    WatchmanServer,
+    build_watchman_app,
+    read_build_progress,
+    run_watchman,
+    watch_build_progress,
+)
 
-__all__ = ["WatchmanServer", "build_watchman_app", "run_watchman"]
+__all__ = [
+    "WatchmanServer",
+    "build_watchman_app",
+    "read_build_progress",
+    "run_watchman",
+    "watch_build_progress",
+]

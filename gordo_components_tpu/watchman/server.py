@@ -80,43 +80,9 @@ class WatchmanServer:
         }
 
     def _build_progress(self) -> Optional[Dict]:
-        """Summary of the fleet build manifest, or an error record when the
-        path is set but unreadable (a monitor must see that the manifest is
-        gone, not a silently vanished field).
-
-        Multi-host builds write one manifest per process
-        (``fleet_manifest.json`` + ``fleet_manifest.p<i>.json`` siblings —
-        see build_fleet._write_manifest); the union is the fleet view:
-        completed machines are the union of every file's, and a machine is
-        pending only while NO process has completed it."""
         if not self.manifest_path:
             return None
-        import glob
-        import os
-
-        stem, ext = os.path.splitext(self.manifest_path)
-        paths = [self.manifest_path] + sorted(glob.glob(f"{stem}.p*{ext}"))
-        try:
-            completed: Dict = {}
-            pending: set = set()
-            updated = None
-            for path in paths:
-                with open(path) as fh:
-                    manifest = json.load(fh)
-                completed.update(manifest.get("machines") or {})
-                pending |= set(manifest.get("pending") or [])
-                updated = max(updated or "", manifest.get("updated") or "")
-            still_pending = sorted(pending - set(completed))
-            return {
-                "updated": updated or None,
-                "n_completed": len(completed),
-                "n_pending": len(still_pending),
-                "pending": still_pending[:50],  # capped for 10k fleets
-            }
-        except (OSError, ValueError, AttributeError, TypeError) as exc:
-            # wrong-shaped JSON (top-level list, null pending) must degrade
-            # to an error field, not take the whole health view down
-            return {"error": f"manifest unreadable: {exc}"}
+        return read_build_progress(self.manifest_path)
 
     def status(self) -> Dict:
         targets = sorted(self.machine_urls.items())
@@ -148,6 +114,70 @@ class WatchmanServer:
             json.dumps(body), status=status, mimetype="application/json"
         )
         return response(environ, start_response)
+
+
+def read_build_progress(manifest_path: str, pending_cap: int = 50) -> Dict:
+    """Unioned fleet-build progress from the manifest file(s), or an error
+    record when the path is set but unreadable (a monitor must see that the
+    manifest is gone, not a silently vanished field).
+
+    Multi-host builds write one manifest per process
+    (``fleet_manifest.json`` + ``fleet_manifest.p<i>.json`` siblings — see
+    build_fleet._write_manifest); the union is the fleet view: completed
+    machines are the union of every file's, and a machine is pending only
+    while NO process has completed it. Shared by the HTTP view and the CLI
+    ``run-watchman --watch`` follower."""
+    import glob
+    import os
+
+    stem, ext = os.path.splitext(manifest_path)
+    paths = [manifest_path] + sorted(glob.glob(f"{stem}.p*{ext}"))
+    try:
+        completed: Dict = {}
+        pending: set = set()
+        updated = None
+        for path in paths:
+            with open(path) as fh:
+                manifest = json.load(fh)
+            completed.update(manifest.get("machines") or {})
+            pending |= set(manifest.get("pending") or [])
+            updated = max(updated or "", manifest.get("updated") or "")
+        still_pending = sorted(pending - set(completed))
+        return {
+            "updated": updated or None,
+            "n_completed": len(completed),
+            "n_pending": len(still_pending),
+            "pending": still_pending[:pending_cap],  # capped for 10k fleets
+        }
+    except (OSError, ValueError, AttributeError, TypeError) as exc:
+        # wrong-shaped JSON (top-level list, null pending) must degrade
+        # to an error field, not take the whole health view down
+        return {"error": f"manifest unreadable: {exc}"}
+
+
+def watch_build_progress(
+    manifest_path: str,
+    interval_s: float = 5.0,
+    emit=print,
+    sleep=time.sleep,
+    max_iterations: Optional[int] = None,
+) -> bool:
+    """CRD-style build follower (the reference eventually replaced watchman
+    HTTP polling with k8s CRD status — SURVEY §3 watchman row): emit one
+    JSON progress line per interval from the manifest file(s), returning
+    True once every machine is completed, False if ``max_iterations``
+    elapsed first. No HTTP anywhere — this reads the same files the build
+    writes atomically."""
+    i = 0
+    while True:
+        progress = read_build_progress(manifest_path)
+        emit(json.dumps(progress))
+        if not progress.get("error") and progress.get("n_pending") == 0:
+            return True
+        i += 1
+        if max_iterations is not None and i >= max_iterations:
+            return False
+        sleep(interval_s)
 
 
 def build_watchman_app(

@@ -137,6 +137,21 @@ spec:
 
 _TPU_JOB_TEMPLATE = _ENV.from_string(
     """\
+{% if hosts > 1 -%}
+apiVersion: v1
+kind: Service
+metadata:
+  name: {{ project }}-fleet-coord
+  labels: {app: gordo-fleet-builder, project: {{ project }}}
+spec:
+  # the k8s API's headless marker is the literal STRING "None" (yaml null
+  # would mean "unset" and get a ClusterIP allocated, killing the per-pod
+  # DNS names the coordinator address depends on)
+  clusterIP: "None"
+  selector: {app: gordo-fleet-builder, project: {{ project }}}
+  ports: [{port: 6000, name: coordinator}]
+---
+{% endif -%}
 apiVersion: batch/v1
 kind: Job
 metadata:
@@ -144,9 +159,24 @@ metadata:
   labels: {app: gordo-fleet-builder, project: {{ project }}}
 spec:
   backoffLimit: 3
+{% if hosts > 1 %}
+  # one indexed pod per TPU host: every pod runs the SAME fleet-build
+  # command, joins the jax.distributed runtime at pod 0, and trains/writes
+  # only its own machine shard (output/registry dirs must be shared
+  # storage). Restart semantics match single-host: the per-machine
+  # registry resume makes retries idempotent.
+  completionMode: Indexed
+  completions: {{ hosts }}
+  parallelism: {{ hosts }}
+{% endif %}
   template:
+    metadata:
+      labels: {app: gordo-fleet-builder, project: {{ project }}}
     spec:
       restartPolicy: Never
+{% if hosts > 1 %}
+      subdomain: {{ project }}-fleet-coord
+{% endif %}
       containers:
         - name: fleet-builder
           image: {{ image }}
@@ -154,6 +184,17 @@ spec:
           args: [fleet-build, --machine-config, /config/machines.yaml,
                  --output-dir, {{ output_dir }},
                  --model-register-dir, {{ register_dir }}]
+{% if hosts > 1 %}
+          env:
+            - name: GORDO_NUM_PROCESSES
+              value: "{{ hosts }}"
+            - name: GORDO_PROCESS_ID
+              valueFrom:
+                fieldRef:
+                  fieldPath: "metadata.annotations['batch.kubernetes.io/job-completion-index']"
+            - name: GORDO_COORDINATOR
+              value: "{{ project }}-fleet-build-0.{{ project }}-fleet-coord:6000"
+{% endif %}
           resources:
             limits: {"google.com/tpu": {{ tpu_chips }}}
 ---
@@ -240,17 +281,27 @@ def generate_tpu_job(
     output_dir: str = "/gordo/models",
     register_dir: str = "/gordo/registry",
     tpu_chips: int = 16,
+    hosts: int = 1,
 ) -> str:
     """TPU-native emitter: one fleet-build Job + one multi-model server
-    Deployment for the entire fleet."""
+    Deployment for the entire fleet.
+
+    ``hosts > 1`` emits the multi-host layout: a headless coordinator
+    Service plus an Indexed Job (one pod per TPU host) whose pods derive
+    ``GORDO_PROCESS_ID`` from their completion index and join the
+    jax.distributed runtime at pod 0 — the k8s wiring for
+    ``fleet-build --coordinator-address``."""
     if not isinstance(config, NormalizedConfig):
         config = NormalizedConfig(config)
+    if hosts < 1:
+        raise ValueError(f"hosts must be >= 1, got {hosts}")
     return _TPU_JOB_TEMPLATE.render(
         project=config.project_name,
         image=image,
         output_dir=output_dir,
         register_dir=register_dir,
         tpu_chips=tpu_chips,
+        hosts=hosts,
     )
 
 

@@ -162,6 +162,73 @@ def test_watchman_unions_multihost_manifests(tmp_path):
     assert progress["updated"] == "2026-01-01 00:00:05+0000"
 
 
+def test_watch_build_progress_follows_to_completion(tmp_path):
+    """The CRD-style follower re-reads the manifest(s) each tick and exits
+    as soon as the union shows nothing pending."""
+    import json
+
+    from gordo_components_tpu.watchman import watch_build_progress
+
+    main = tmp_path / "fleet_manifest.json"
+    main.write_text(json.dumps({
+        "machines": {"m-0": {"status": "completed"}},
+        "pending": ["m-1"],
+    }))
+    lines = []
+    ticks = {"n": 0}
+
+    def fake_sleep(_):
+        # the build "finishes" between tick 1 and 2 (another process's
+        # sibling manifest appears)
+        ticks["n"] += 1
+        if ticks["n"] == 2:
+            (tmp_path / "fleet_manifest.p1.json").write_text(json.dumps({
+                "machines": {"m-1": {"status": "completed"}},
+                "pending": ["m-0"],
+            }))
+
+    done = watch_build_progress(
+        str(main), interval_s=0, emit=lines.append, sleep=fake_sleep,
+        max_iterations=10,
+    )
+    assert done is True
+    last = json.loads(lines[-1])
+    assert last["n_pending"] == 0 and last["n_completed"] == 2
+    assert json.loads(lines[0])["n_pending"] == 1
+
+    # an unreadable manifest never reports success
+    assert watch_build_progress(
+        str(tmp_path / "missing.json"), interval_s=0,
+        emit=lines.append, sleep=lambda _: None, max_iterations=2,
+    ) is False
+
+
+def test_cli_watchman_watch_mode(tmp_path):
+    """gordo run-watchman --watch --manifest follows a completed build and
+    exits 0 with JSON progress lines; --watch without --manifest errors."""
+    import json
+
+    from click.testing import CliRunner
+
+    from gordo_components_tpu.cli.cli import gordo
+
+    manifest = tmp_path / "fleet_manifest.json"
+    manifest.write_text(json.dumps({
+        "machines": {"m-0": {"status": "completed"}}, "pending": [],
+    }))
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo, ["run-watchman", "--watch", "--manifest", str(manifest)]
+    )
+    assert result.exit_code == 0, result.output
+    assert json.loads(result.output.strip().splitlines()[-1])["n_pending"] == 0
+
+    result = runner.invoke(gordo, ["run-watchman", "--watch"])
+    assert result.exit_code != 0
+    result = runner.invoke(gordo, ["run-watchman"])
+    assert result.exit_code != 0
+
+
 def test_client_predict_frame_parquet(served):
     """predict_frame POSTs a client-held DataFrame as parquet and returns a
     timestamp-indexed scored frame."""

@@ -108,6 +108,32 @@ def test_tpu_job_golden():
     assert limits["google.com/tpu"] == 16
 
 
+def test_tpu_job_multihost_golden():
+    """hosts>1 emits the Indexed-Job multi-host layout: headless coordinator
+    Service + indexed fleet-build pods wired to fleet-build's
+    jax.distributed env vars."""
+    manifest = generate_tpu_job(FLEET_YAML, tpu_chips=8, hosts=4)
+    validate_generated(manifest)
+    documents = [d for d in yaml.safe_load_all(manifest) if d]
+    kinds = [d["kind"] for d in documents]
+    assert kinds == ["Service", "Job", "Deployment"]
+    svc, job = documents[0], documents[1]
+    # k8s headless marker is the literal string "None" (yaml null = unset)
+    assert svc["spec"]["clusterIP"] == "None"
+    assert job["spec"]["completionMode"] == "Indexed"
+    assert job["spec"]["completions"] == 4
+    assert job["spec"]["parallelism"] == 4
+    pod = job["spec"]["template"]["spec"]
+    assert pod["subdomain"] == svc["metadata"]["name"]
+    env = {e["name"]: e for e in pod["containers"][0]["env"]}
+    assert env["GORDO_NUM_PROCESSES"]["value"] == "4"
+    assert "job-completion-index" in str(env["GORDO_PROCESS_ID"])
+    assert svc["metadata"]["name"] in env["GORDO_COORDINATOR"]["value"]
+
+    with pytest.raises(ValueError, match="hosts"):
+        generate_tpu_job(FLEET_YAML, hosts=0)
+
+
 def test_globals_dataset_deep_merge():
     """A machine overriding one nested data_provider key keeps the global
     provider's sibling keys (deep merge, machine wins per key)."""
