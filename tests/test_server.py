@@ -125,6 +125,50 @@ def test_anomaly_prediction(client):
     assert isinstance(body["total-threshold"], float)
 
 
+@pytest.mark.slow
+def test_forecast_machine_serves_over_http(tmp_path):
+    """A multi-step forecast machine end-to-end over the REST surface: the
+    response honors the horizon contract (n - L + 1 - k rows) and the
+    machine serves via the stacked engine, not the slow host path."""
+    forecast_model = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "TransformedTargetRegressor": {
+                    "regressor": {
+                        "Pipeline": {
+                            "steps": [
+                                "MinMaxScaler",
+                                {"LSTMForecast": {"kind": "lstm_symmetric",
+                                                  "lookback_window": 6,
+                                                  "horizon": 2,
+                                                  "dims": [8],
+                                                  "epochs": 1,
+                                                  "batch_size": 16}},
+                            ]
+                        }
+                    },
+                    "transformer": "MinMaxScaler",
+                }
+            }
+        }
+    }
+    model_dir = provide_saved_model(
+        "machine-f", forecast_model, DATA_CONFIG, str(tmp_path / "fc"),
+        evaluation_config={"n_splits": 2},
+    )
+    fc_client = Client(build_app({"machine-f": model_dir}, project="proj"))
+    X = np.random.default_rng(1).normal(size=(20, 3)).tolist()
+    response = _post(fc_client, "/gordo/v0/proj/machine-f/anomaly/prediction",
+                     {"X": X})
+    assert response.status_code == 200
+    data = response.get_json()["data"]
+    assert len(data["total-anomaly-score"]) == 20 - 6 + 1 - 2
+    # the engine lifted it — /metrics shows no host-path machines
+    metrics = fc_client.get("/metrics").get_json()
+    assert metrics["engine"]["machines"] == 1
+    assert metrics["engine"]["host_path_machines"] == {}
+
+
 def test_anomaly_with_server_side_fetch(client):
     response = client.post(
         "/gordo/v0/proj/machine-a/anomaly/prediction"
