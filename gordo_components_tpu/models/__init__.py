@@ -20,6 +20,7 @@ from .models import (
     DenseAutoEncoder,
     LSTMAutoEncoder,
     LSTMForecast,
+    MultiStepForecast,
     PatchTSTAutoEncoder,
     PatchTSTForecast,
     KerasAutoEncoder,
@@ -39,6 +40,7 @@ __all__ = [
     "DenseAutoEncoder",
     "LSTMAutoEncoder",
     "LSTMForecast",
+    "MultiStepForecast",
     "PatchTSTAutoEncoder",
     "PatchTSTForecast",
     "KerasAutoEncoder",

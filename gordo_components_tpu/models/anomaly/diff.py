@@ -55,8 +55,29 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         self.tag_thresholds_: Optional[np.ndarray] = None
         self.total_threshold_: Optional[float] = None
 
+    def _reject_joint_horizon(self) -> None:
+        """Joint multi-step forecasters emit ``horizon × F`` values per
+        window; diff scoring compares one row per timestamp — reject with
+        a clear error instead of an obscure broadcast failure downstream
+        (the fleet builder and serving engine carry the same gate)."""
+        from ..analysis import analyze_model  # lazy: analysis imports diff
+
+        try:
+            est = analyze_model(self).estimator
+        except ValueError:
+            return  # exotic graph the analyzer can't walk — let the host
+            # path's own shape errors surface naturally
+        if getattr(est, "joint_horizon", False):
+            raise ValueError(
+                "DiffBasedAnomalyDetector scores one row per timestamp; "
+                f"{type(est).__name__} predicts the whole horizon jointly "
+                "— use LSTMForecast(horizon=k) (direct k-step) for anomaly "
+                "configs"
+            )
+
     # -- estimator API -------------------------------------------------------
     def fit(self, X, y=None, **kwargs) -> "DiffBasedAnomalyDetector":
+        self._reject_joint_horizon()
         self.base_estimator.fit(X, y, **kwargs)
         return self
 
@@ -75,6 +96,7 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         the pooled out-of-fold residuals — exactly the reference's recipe."""
         from sklearn.model_selection import TimeSeriesSplit
 
+        self._reject_joint_horizon()
         X_arr = np.asarray(getattr(X, "values", X), dtype=np.float32)
         y_arr = X_arr if y is None else np.asarray(
             getattr(y, "values", y), dtype=np.float32

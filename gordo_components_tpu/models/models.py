@@ -404,6 +404,48 @@ class LSTMForecast(BaseFlaxEstimator):
         return super().set_params(**params)
 
 
+class MultiStepForecast(LSTMForecast):
+    """JOINT multi-step forecast: window → ALL of rows ``t+1..t+horizon``
+    predicted together (the other reading of BASELINE config 3's
+    "multi-step horizon"; :class:`LSTMForecast` with ``horizon=k`` is the
+    direct k-th-ahead variant). The model head emits ``horizon ×
+    n_features`` values per window, trained against
+    :func:`~gordo_components_tpu.ops.windowing.multi_step_targets`
+    flattened to 2-D, so any zoo kind works unchanged. ``predict`` returns
+    the flat ``(count, horizon·F)`` sklearn shape; :meth:`predict_steps`
+    reshapes to ``(count, horizon, F)``.
+
+    Standalone estimator (sklearn API): the diff-based anomaly head scores
+    one row per timestamp, so it pairs with the direct-horizon forecasters,
+    not this joint one — the fleet builder and serving engine reject it
+    with a clear error instead of mis-scoring.
+    """
+
+    joint_horizon = True  # gates: fleet/_spec_for and the serving engine
+    # reject this class with a clear error instead of mis-scoring
+
+    def __init__(
+        self, kind: str = "lstm_symmetric", horizon: int = 2, **kwargs: Any
+    ):
+        super().__init__(kind, horizon=horizon, **kwargs)
+
+    def _prepare_targets(self, y: np.ndarray) -> np.ndarray:
+        stacked = np.asarray(
+            windowing.multi_step_targets(y, self.lookback_window, self.horizon)
+        )  # (count, horizon, F)
+        return stacked.reshape(stacked.shape[0], -1)
+
+    def _make_spec(self, n_features: int, n_features_out: int):
+        # widen the head: joint horizon = horizon × target width outputs
+        return super()._make_spec(n_features, n_features_out * self.horizon)
+
+    def predict_steps(self, X) -> np.ndarray:
+        """``(count, horizon, F)`` view of :meth:`predict` — step ``s`` of
+        row ``j`` forecasts input row ``j + lookback_window + s``."""
+        flat = self.predict(X)
+        return flat.reshape(flat.shape[0], self.horizon, -1)
+
+
 class PatchTSTAutoEncoder(LSTMAutoEncoder):
     """Window → window's own last row via the PatchTST transformer kind —
     the rebuild's new model family (BASELINE.md config 5); same windowing

@@ -195,6 +195,13 @@ def _abstract_result(spec, n_machines, n_rows, n_features, n_targets):
     )
 
 
+def _leaf_size(a) -> int:
+    """Element count without materializing (np.asarray on a non-addressable
+    global array would fail)."""
+    size = getattr(a, "size", None)
+    return int(size) if size is not None else int(np.asarray(a).size)
+
+
 class _SliceCheckpointer:
     """Orbax-backed async checkpoint of each slice's stacked training result
     (SURVEY.md §6.4: async checkpoint of the stacked fleet pytree).
@@ -204,14 +211,23 @@ class _SliceCheckpointer:
     window between "training finished" and "every artifact + registry key
     durable": a resume restores the trained pytree instead of retraining the
     slice. Checkpoints are deleted once their slice's artifacts are all
-    written — steady state leaves nothing behind."""
+    written — steady state leaves nothing behind.
 
-    def __init__(self, output_dir: str):
+    **Multi-host** (``mesh`` spanning processes): save/restore are orbax
+    COLLECTIVES over the globally-sharded result — every process writes and
+    reads its own shards (checkpoint dir on shared storage), the restore
+    template carries fleet-axis ``NamedSharding``s, and deletion happens on
+    process 0 only after a cross-process barrier confirms every process's
+    slice artifacts are durable."""
+
+    def __init__(self, output_dir: str, mesh=None):
         import orbax.checkpoint as ocp
 
         self._root = os.path.abspath(os.path.join(output_dir, _CKPT_SUBDIR))
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         self._ocp = ocp
+        self._mesh = mesh
+        self._multihost = jax.process_count() > 1
 
     @staticmethod
     def slice_key(slice_items: List[dict]) -> str:
@@ -233,22 +249,49 @@ class _SliceCheckpointer:
 
     # orbax refuses zero-size arrays (e.g. cv_scores with CV off); stand in
     # a 1-element placeholder on save and rebuild the empty array on restore
-    @staticmethod
-    def _shrink(tree):
+    def _shrink(self, tree):
+        if self._multihost and self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self._mesh, PartitionSpec())
+
+            def placeholder(a):
+                # a GLOBAL replicated array, not host numpy: the collective
+                # save expects every leaf to be a jax.Array whose shards
+                # each process can write
+                return jax.device_put(np.zeros((1,), a.dtype), repl)
+
+        else:
+
+            def placeholder(a):
+                return np.zeros((1,), np.asarray(a).dtype)
+
         return jax.tree_util.tree_map(
-            lambda a: (
-                np.zeros((1,), np.asarray(a).dtype)
-                if np.asarray(a).size == 0
-                else a
-            ),
-            tree,
+            lambda a: placeholder(a) if _leaf_size(a) == 0 else a, tree
         )
 
-    @staticmethod
-    def _shrink_abstract(abstract):
+    def _shrink_abstract(self, abstract):
+        """Placeholder zero-size leaves, and — multi-host — attach the
+        fleet-axis sharding to every real leaf (orbax restores each process's
+        shards directly) and replicate the placeholders."""
+        if self._mesh is None or not self._multihost:
+            return jax.tree_util.tree_map(
+                lambda s: (
+                    jax.ShapeDtypeStruct((1,), s.dtype) if 0 in s.shape else s
+                ),
+                abstract,
+            )
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .mesh import FLEET_AXIS
+
+        shard = NamedSharding(self._mesh, PartitionSpec(FLEET_AXIS))
+        repl = NamedSharding(self._mesh, PartitionSpec())
         return jax.tree_util.tree_map(
             lambda s: (
-                jax.ShapeDtypeStruct((1,), s.dtype) if 0 in s.shape else s
+                jax.ShapeDtypeStruct((1,), s.dtype, sharding=repl)
+                if 0 in s.shape
+                else jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=shard)
             ),
             abstract,
         )
@@ -266,10 +309,25 @@ class _SliceCheckpointer:
     def try_restore(self, key: str, abstract_fn):
         """``abstract_fn`` is a thunk: building the restore template costs a
         full eval_shape trace of the training program, so it only runs when
-        a finalized checkpoint actually exists."""
+        a finalized checkpoint actually exists.
+
+        Multi-host: all processes must take the SAME branch (restore is a
+        collective; one process retraining while others restore would
+        deadlock the training collectives), so existence is agreed by
+        allgather first, and a restore failure then raises instead of
+        silently diverging — the job-level retry handles it."""
         path = self.path(key)
-        if not os.path.isdir(path):  # orbax finalizes via atomic rename, so
-            # a crashed mid-save leaves only a *-tmp dir, never this path
+        exists = os.path.isdir(path)  # orbax finalizes via atomic rename, so
+        # a crashed mid-save leaves only a *-tmp dir, never this path
+        if self._multihost:
+            from jax.experimental import multihost_utils
+
+            exists = bool(
+                multihost_utils.process_allgather(
+                    np.asarray([exists])
+                ).all()
+            )
+        if not exists:
             return None
         abstract = abstract_fn()
         try:
@@ -287,6 +345,9 @@ class _SliceCheckpointer:
             )
             return result
         except Exception as exc:
+            if self._multihost:
+                raise  # diverging (one process retrains, others restored)
+                # would deadlock the fleet collectives — fail the job loudly
             logger.warning(
                 "Slice checkpoint %s unreadable (%s); retraining", path, exc
             )
@@ -301,10 +362,19 @@ class _SliceCheckpointer:
 
     def finalize(self, key: str) -> None:
         """Wait for the async save, then drop the checkpoint — the slice's
-        artifacts are durable now, so the registry is the source of truth."""
+        artifacts are durable now, so the registry is the source of truth.
+        Multi-host: a cross-process barrier first (every process's slice
+        artifacts must be durable before ANY copy of the checkpoint dies),
+        then process 0 alone deletes from the shared dir."""
         import shutil
 
         self._ckptr.wait_until_finished()
+        if self._multihost:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"slice-durable-{key}")
+            if jax.process_index() != 0:
+                return
         shutil.rmtree(self.path(key), ignore_errors=True)
 
     def close(self) -> None:
@@ -312,6 +382,8 @@ class _SliceCheckpointer:
 
         self._ckptr.wait_until_finished()
         self._ckptr.close()
+        if self._multihost and jax.process_index() != 0:
+            return
         shutil.rmtree(self._root, ignore_errors=True)
 
 
@@ -416,6 +488,12 @@ def _spec_for(
     n_splits: int,
 ) -> FleetSpec:
     est = analyzed.estimator
+    if getattr(est, "joint_horizon", False):
+        raise ValueError(
+            "MultiStepForecast (joint horizon) is single-machine only: the "
+            "fleet program's target/weight math assumes one target row per "
+            "window — use LSTMForecast(horizon=k) for fleet builds"
+        )
     model_spec = est._make_spec(n_features, n_targets)
     kind, feature_range, scaler_options = _scaler_kind(analyzed.input_scaler)
     t_kind, t_range, t_options = _scaler_kind(analyzed.target_scaler)
@@ -551,8 +629,9 @@ def build_fleet(
     Requires ``output_dir``/``model_register_dir`` on storage shared by all
     processes (the reference's shared-volume assumption) so resume scans
     agree; each process's return value covers cached + its own machines.
-    Slice checkpointing is host-local and therefore disabled multi-host —
-    the per-machine registry resume covers restarts.
+    Slice checkpoints are orbax COLLECTIVES over the sharded result (each
+    process writes/reads its own shards), layered on the per-machine
+    registry resume.
     """
     import os
 
@@ -573,7 +652,7 @@ def build_fleet(
             )
         logger.info(
             "Multi-host fleet build: process %d/%d fetches and writes only "
-            "its own machine shard; slice checkpointing disabled",
+            "its own machine shard; slice checkpoints are collective",
             jax.process_index(),
             jax.process_count(),
         )
@@ -655,7 +734,7 @@ def build_fleet(
         buckets.setdefault(sig, []).append(item)
 
     master_key = jax.random.PRNGKey(seed)
-    checkpointer = _SliceCheckpointer(output_dir)
+    checkpointer = _SliceCheckpointer(output_dir, mesh=mesh)
     prefetcher = ThreadPoolExecutor(
         max_workers=1, thread_name_prefix="fleet-prefetch"
     )
@@ -746,16 +825,11 @@ def build_fleet(
                     batch = MachineBatch(X=X, y=y, w=w, keys=keys)
 
                 ckpt_key = checkpointer.slice_key(slice_items)
-                result = (
-                    None
-                    if multihost  # host-local orbax ckpt can't cover a
-                    # globally-sharded result; registry resume suffices
-                    else checkpointer.try_restore(
-                        ckpt_key,
-                        lambda: _abstract_result(
-                            spec, n_padded, n_rows, n_features, n_targets
-                        ),
-                    )
+                result = checkpointer.try_restore(
+                    ckpt_key,
+                    lambda: _abstract_result(
+                        spec, n_padded, n_rows, n_features, n_targets
+                    ),
                 )
                 if result is None:
                     with timer.phase("train"), device_trace(profile_dir):
@@ -765,15 +839,16 @@ def build_fleet(
                         result = train_fleet_arrays(
                             spec, batch, mesh=mesh, donate=True
                         )
-                        result = (
-                            _gather_local_block(result)
-                            if multihost
-                            else jax.device_get(result)
-                        )
-                    if not multihost:
-                        # async: orbax writes in the background while the
-                        # artifact loop below runs; finalize() joins + deletes
-                        checkpointer.save_async(ckpt_key, result)
+                        if not multihost:
+                            result = jax.device_get(result)
+                    # async: orbax writes in the background while the
+                    # artifact loop below runs (multi-host: a COLLECTIVE
+                    # save of the sharded result); finalize() joins + deletes
+                    checkpointer.save_async(ckpt_key, result)
+                if multihost:
+                    # restored or trained, the result is globally sharded:
+                    # pull only this process's machine block to host
+                    result = _gather_local_block(result)
                 slice_duration = time.perf_counter() - slice_started
 
                 if multihost:
@@ -849,10 +924,10 @@ def build_fleet(
                         manifest,
                         [name for name in (m.name for m, *_ in pending) if name not in manifest],
                     )
-                if not multihost:
-                    with timer.phase("checkpoint_wait"):
-                        # artifacts durable → join the async save, drop the ckpt
-                        checkpointer.finalize(ckpt_key)
+                with timer.phase("checkpoint_wait"):
+                    # artifacts durable → join the async save, drop the ckpt
+                    # (multi-host: barrier, then process 0 deletes)
+                    checkpointer.finalize(ckpt_key)
                 for item in slice_items:  # free before the next slice fetches
                     item.pop("X", None)
                     item.pop("y", None)

@@ -73,6 +73,7 @@ _SHORT_NAMES: Dict[str, str] = {
         "DenseAutoEncoder",
         "LSTMAutoEncoder",
         "LSTMForecast",
+        "MultiStepForecast",
         "PatchTSTAutoEncoder",
         "PatchTSTForecast",
         "KerasAutoEncoder",

@@ -312,6 +312,13 @@ class ServingEngine:
                 est = analyzed.estimator
                 if est.params_ is None:
                     raise ValueError("estimator is not fitted")
+                if getattr(est, "joint_horizon", False):
+                    raise ValueError(
+                        "joint multi-step forecast emits horizon x F values "
+                        "per window; the anomaly engine scores one row per "
+                        "timestamp — use the direct-horizon LSTMForecast "
+                        "for anomaly serving"
+                    )
                 n_features = int(est.n_features_)
                 n_targets = int(est.n_features_out_)
                 tcols = target_cols.get(name)

@@ -90,6 +90,54 @@ def build_mode(output_dir: str) -> None:
     )
 
 
+def ckpt_roundtrip_mode(ckpt_dir: str) -> None:
+    """Collective slice-checkpoint round-trip: save a globally-sharded tree
+    (plus a zero-size leaf), restore it through the sharded template, and
+    verify every process gets ITS shards back bit-exact."""
+    from jax.experimental import multihost_utils
+
+    from gordo_components_tpu.parallel.build_fleet import _SliceCheckpointer
+    from gordo_components_tpu.parallel.distributed import global_fleet_mesh
+    from gordo_components_tpu.parallel.mesh import fleet_sharding
+
+    mesh = global_fleet_mesh()
+    sharding = fleet_sharding(mesh)
+    n = mesh.size
+    local = jax.local_device_count()
+    pid = jax.process_index()
+    full = (np.arange(n * 4, dtype=np.float32) * 2.5).reshape(n, 4)
+    lo, hi = pid * local, (pid + 1) * local
+    tree = {
+        "real": jax.make_array_from_process_local_data(sharding, full[lo:hi]),
+        "empty": np.zeros((n, 0, 4), np.float32),
+    }
+    ckpt = _SliceCheckpointer(ckpt_dir, mesh=mesh)
+    key = "roundtrip"
+    ckpt.save_async(key, tree)
+    ckpt._ckptr.wait_until_finished()
+
+    def abstract_fn():
+        return {
+            "real": jax.ShapeDtypeStruct((n, 4), np.float32),
+            "empty": jax.ShapeDtypeStruct((n, 0, 4), np.float32),
+        }
+
+    restored = ckpt.try_restore(key, abstract_fn)
+    assert restored is not None
+    for shard in restored["real"].addressable_shards:
+        start = shard.index[0].start or 0
+        np.testing.assert_array_equal(
+            np.asarray(shard.data), full[start : start + shard.data.shape[0]]
+        )
+    assert restored["empty"].shape == (n, 0, 4)
+    ckpt.finalize(key)
+    multihost_utils.sync_global_devices("roundtrip-finalized")
+    assert not os.path.isdir(ckpt.path(key)), "finalize must drop the ckpt"
+    # a missing checkpoint is agreed collectively -> both return None
+    assert ckpt.try_restore("never-saved", abstract_fn) is None
+    print(f"ckpt-roundtrip@{pid} OK", flush=True)
+
+
 def main() -> None:
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 
@@ -107,6 +155,9 @@ def main() -> None:
 
     if len(sys.argv) >= 6 and sys.argv[4] == "--build":
         build_mode(sys.argv[5])
+        return
+    if len(sys.argv) >= 6 and sys.argv[4] == "--ckpt-roundtrip":
+        ckpt_roundtrip_mode(sys.argv[5])
         return
 
     from jax.sharding import NamedSharding, PartitionSpec

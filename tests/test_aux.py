@@ -248,6 +248,24 @@ def test_two_process_build_fleet_sliced(tmp_path):
     )
 
 
+@pytest.mark.slow
+def test_two_process_checkpoint_roundtrip(tmp_path):
+    """Collective orbax slice checkpoints: two processes save a sharded
+    tree, restore through the sharded template (each process its own
+    shards, bit-exact), and finalize with the barrier+proc-0 delete."""
+    out = str(tmp_path / "ckpt")
+    codes, outputs = _run_two_process_children(
+        ["--ckpt-roundtrip", out], timeout=180
+    )
+    if any(c != 0 for c in codes):  # possible port race — one retry
+        codes, outputs = _run_two_process_children(
+            ["--ckpt-roundtrip", str(tmp_path / "ckpt2")], timeout=180
+        )
+    assert all(c == 0 for c in codes), "children failed:\n" + "\n".join(outputs)
+    assert any("ckpt-roundtrip@0 OK" in o for o in outputs)
+    assert any("ckpt-roundtrip@1 OK" in o for o in outputs)
+
+
 # ------------------------------------------------------------ backend probe
 def test_call_with_timeout_paths():
     import time as _time

@@ -236,6 +236,78 @@ def test_multi_step_forecast_horizon(X):
 
 
 @pytest.mark.slow
+def test_joint_multi_step_forecast(X):
+    """MultiStepForecast predicts rows t+1..t+k JOINTLY: output width is
+    horizon x F, predict_steps() unflattens, and a perfectly-learnable
+    signal shows step s of row j targeting input row j+L+s (golden)."""
+    from gordo_components_tpu.models import MultiStepForecast
+
+    L, k = 6, 3
+    m = MultiStepForecast(kind="lstm_symmetric", lookback_window=L, horizon=k,
+                          dims=(8,), epochs=1, batch_size=32)
+    m.fit(X)
+    count = len(X) - L + 1 - k
+    flat = m.predict(X)
+    assert flat.shape == (count, k * X.shape[1])
+    steps = m.predict_steps(X)
+    assert steps.shape == (count, k, X.shape[1])
+    np.testing.assert_allclose(steps.reshape(count, -1), flat, rtol=1e-6)
+    assert np.isfinite(flat).all()
+    assert isinstance(m.score(X), float)
+
+    # the golden target contract (what training aligns to)
+    targets = m._prepare_targets(np.asarray(X))
+    assert targets.shape == (count, k * X.shape[1])
+    np.testing.assert_array_equal(
+        targets[0].reshape(k, X.shape[1]), np.asarray(X)[L : L + k]
+    )
+
+    # round-trips: pickle and definition
+    import pickle
+
+    restored = pickle.loads(pickle.dumps(m))
+    np.testing.assert_allclose(restored.predict(X), flat, rtol=1e-6)
+
+    from gordo_components_tpu.serializer import pipeline_from_definition
+
+    built = pipeline_from_definition(
+        {"MultiStepForecast": {"kind": "lstm_symmetric", "lookback_window": L,
+                               "horizon": k, "dims": [8], "epochs": 1,
+                               "batch_size": 32}}
+    )
+    assert built.horizon == k and built.joint_horizon
+
+
+def test_joint_multi_step_rejected_by_fleet_and_engine(X):
+    """The joint forecaster is single-machine-only: fleet spec derivation
+    and the serving engine must reject it loudly, never mis-score."""
+    from gordo_components_tpu.models import MultiStepForecast
+    from gordo_components_tpu.models.analysis import analyze_model
+    from gordo_components_tpu.parallel.build_fleet import _spec_for
+    from gordo_components_tpu.server.engine import ServingEngine
+
+    m = MultiStepForecast(kind="lstm_symmetric", lookback_window=6, horizon=2,
+                          dims=(8,), epochs=1, batch_size=32)
+    m.fit(X)
+    with pytest.raises(ValueError, match="single-machine only"):
+        _spec_for(analyze_model(m), X.shape[1], X.shape[1], 1)
+    engine = ServingEngine({"joint": m})
+    assert not engine.can_score("joint")
+    assert "joint" in engine.stats()["host_path_machines"]
+
+    # the anomaly head carries the same gate (clear error, not an obscure
+    # broadcast failure mid-scoring)
+    from gordo_components_tpu.models.anomaly import DiffBasedAnomalyDetector
+
+    det = DiffBasedAnomalyDetector(base_estimator=MultiStepForecast(
+        kind="lstm_symmetric", lookback_window=6, horizon=2, dims=(8,),
+        epochs=1, batch_size=32))
+    with pytest.raises(ValueError, match="jointly"):
+        det.fit(X)
+    with pytest.raises(ValueError, match="jointly"):
+        det.cross_validate(X, n_splits=2)
+
+
 def test_lstm_dropout_trains(X):
     m = LSTMAutoEncoder(kind="lstm_hourglass", lookback_window=4,
                         encoding_layers=1, dropout=0.3, epochs=2, batch_size=64)
