@@ -90,6 +90,30 @@ def build_mode(output_dir: str) -> None:
     )
 
 
+def build_crash_mode(output_dir: str) -> None:
+    """Run build_mode but die (every process) immediately after the FIRST
+    slice's collective checkpoint save completes — before any artifact
+    lands. The follow-up normal build must then RESTORE that slice instead
+    of retraining (kill-mid-build resume, multi-host edition)."""
+    import importlib
+
+    # NB: `from ..parallel import build_fleet` would bind the FUNCTION the
+    # package re-exports, not the module
+    bf = importlib.import_module("gordo_components_tpu.parallel.build_fleet")
+
+    orig = bf._SliceCheckpointer.save_async
+
+    def save_then_die(self, key, result):
+        orig(self, key, result)
+        self._ckptr.wait_until_finished()  # the ckpt must be durable —
+        # that's the crash window this test pins
+        print("crashed-after-checkpoint", flush=True)
+        os._exit(17)
+
+    bf._SliceCheckpointer.save_async = save_then_die
+    build_mode(output_dir)
+
+
 def ckpt_roundtrip_mode(ckpt_dir: str) -> None:
     """Collective slice-checkpoint round-trip: save a globally-sharded tree
     (plus a zero-size leaf), restore it through the sharded template, and
@@ -153,8 +177,14 @@ def main() -> None:
     )
     assert jax.process_count() == nproc
 
+    import logging
+
+    logging.basicConfig(level=logging.INFO)  # parents assert on INFO lines
     if len(sys.argv) >= 6 and sys.argv[4] == "--build":
         build_mode(sys.argv[5])
+        return
+    if len(sys.argv) >= 6 and sys.argv[4] == "--build-crash":
+        build_crash_mode(sys.argv[5])
         return
     if len(sys.argv) >= 6 and sys.argv[4] == "--ckpt-roundtrip":
         ckpt_roundtrip_mode(sys.argv[5])

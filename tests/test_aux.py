@@ -249,6 +249,43 @@ def test_two_process_build_fleet_sliced(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_kill_mid_build_restores_from_checkpoint(tmp_path):
+    """Multi-host crash-resume end-to-end: every process dies right after
+    the first slice's COLLECTIVE checkpoint lands (before any artifact);
+    the re-run must restore that slice from the checkpoint instead of
+    retraining, and still produce the whole fleet."""
+    out_dir = str(tmp_path / "mhcrash")
+
+    codes, outputs = _run_two_process_children(
+        ["--build-crash", out_dir], timeout=300
+    )
+    if not all(c == 17 for c in codes):  # possible port race — one retry
+        out_dir = str(tmp_path / "mhcrash-retry")
+        codes, outputs = _run_two_process_children(
+            ["--build-crash", out_dir], timeout=300
+        )
+    assert all(c == 17 for c in codes), "\n".join(outputs)
+    assert all("crashed-after-checkpoint" in o for o in outputs)
+    # nothing was built, but the slice checkpoint survived
+    assert not os.path.isdir(os.path.join(out_dir, "models")) or not any(
+        name.startswith("mh-")
+        for name in os.listdir(os.path.join(out_dir, "models"))
+    )
+    ckpt_root = os.path.join(out_dir, "models", ".slice_checkpoints")
+    assert os.path.isdir(ckpt_root) and os.listdir(ckpt_root)
+
+    # resume: the normal build restores slice 0 and completes the fleet
+    codes, outputs = _run_two_process_children(["--build", out_dir],
+                                               timeout=300)
+    assert all(c == 0 for c in codes), "\n".join(outputs)
+    assert any("Restored slice checkpoint" in o for o in outputs)
+    for i in range(16):
+        assert os.path.isdir(os.path.join(out_dir, "models", f"mh-{i:02d}"))
+    # steady state: checkpoints cleaned up after artifacts landed
+    assert not os.listdir(ckpt_root) if os.path.isdir(ckpt_root) else True
+
+
+@pytest.mark.slow
 def test_two_process_checkpoint_roundtrip(tmp_path):
     """Collective orbax slice checkpoints: two processes save a sharded
     tree, restore through the sharded template (each process its own
