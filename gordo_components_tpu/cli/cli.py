@@ -232,7 +232,11 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
 @click.option("--host", default="0.0.0.0", show_default=True)
 @click.option("--port", default=5555, show_default=True)
 @click.option("--project", default="project", show_default=True)
-def run_server_cmd(model_dirs, models_dir, host, port, project):
+@click.option("--shard-fleet", is_flag=True, default=False,
+              help="shard every bucket's stacked params over all local "
+                   "devices (HBM capacity mode for fleets whose stacked "
+                   "weights exceed one chip; adds per-request gather hops)")
+def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet):
     """Serve built model(s) over REST."""
     import os
 
@@ -258,12 +262,12 @@ def run_server_cmd(model_dirs, models_dir, host, port, project):
         )
     if len(resolved) == 1 and not models_dir:
         run_server(next(iter(resolved.values())), host=host, port=port,
-                   project=project)
+                   project=project, shard_fleet=shard_fleet)
     else:
         # models_dir servers stay reload-capable (POST /reload picks up
         # machines a fleet build adds to the tree after startup)
         run_server(resolved, host=host, port=port, project=project,
-                   models_root=models_dir)
+                   models_root=models_dir, shard_fleet=shard_fleet)
 
 
 @gordo.command("run-watchman")

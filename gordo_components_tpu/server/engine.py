@@ -119,7 +119,15 @@ class _Item:
 
 
 class _Bucket:
-    """One architecture's stacked machines + compiled score programs."""
+    """One architecture's stacked machines + compiled score programs.
+
+    ``mesh``: optional 1-D device mesh — the stacked machine axis shards
+    over it (machine count padded to a mesh multiple by repeating entry 0,
+    which is never dispatched under a padded index). This is the HBM
+    CAPACITY mode for plant-scale fleets whose stacked params exceed one
+    chip; the per-request gather of one machine's slice costs ICI hops, so
+    latency-critical small fleets should keep the default (single-device,
+    replicated)."""
 
     def __init__(
         self,
@@ -128,34 +136,49 @@ class _Bucket:
         lookahead: Optional[int],
         entries: List[_MachineEntry],
         max_batch: int,
+        mesh=None,
     ):
         self.apply_fn = apply_fn
         self.lookback = lookback
         self.lookahead = lookahead
         self.max_batch = max_batch
-        self.names = [e.name for e in entries]
+        self.mesh = mesh
+        self.names = [e.name for e in entries]  # REAL machines only — padding
+        # below must never surface in warmup/dispatch name lists
         self.n_features = int(np.atleast_1d(entries[0].sx.scale).shape[0])
-        self.stacked = jax.device_put(
-            {
-                "params": jax.tree_util.tree_map(
-                    lambda *leaves: jnp.stack(leaves), *[e.params for e in entries]
-                ),
-                "sx": ScalerParams(
-                    scale=jnp.stack([e.sx.scale for e in entries]),
-                    offset=jnp.stack([e.sx.offset for e in entries]),
-                ),
-                "sy": ScalerParams(
-                    scale=jnp.stack([e.sy.scale for e in entries]),
-                    offset=jnp.stack([e.sy.offset for e in entries]),
-                ),
-                "es": ScalerParams(
-                    scale=jnp.stack([e.es.scale for e in entries]),
-                    offset=jnp.stack([e.es.offset for e in entries]),
-                ),
-                "tcols": jnp.stack(
-                    [jnp.asarray(e.tcols, jnp.int32) for e in entries]
-                ),
-            }
+        self._fleet_sharding = None
+        if mesh is not None:
+            from ..parallel.mesh import fleet_sharding, pad_to_multiple
+
+            self._fleet_sharding = fleet_sharding(mesh)
+            # pad with entry 0 so the machine axis shards evenly; padded
+            # rows are unreachable (dispatch uses real indices only)
+            n_pad = pad_to_multiple(len(entries), mesh.size)
+            entries = entries + [entries[0]] * (n_pad - len(entries))
+        stacked = {
+            "params": jax.tree_util.tree_map(
+                lambda *leaves: jnp.stack(leaves), *[e.params for e in entries]
+            ),
+            "sx": ScalerParams(
+                scale=jnp.stack([e.sx.scale for e in entries]),
+                offset=jnp.stack([e.sx.offset for e in entries]),
+            ),
+            "sy": ScalerParams(
+                scale=jnp.stack([e.sy.scale for e in entries]),
+                offset=jnp.stack([e.sy.offset for e in entries]),
+            ),
+            "es": ScalerParams(
+                scale=jnp.stack([e.es.scale for e in entries]),
+                offset=jnp.stack([e.es.offset for e in entries]),
+            ),
+            "tcols": jnp.stack(
+                [jnp.asarray(e.tcols, jnp.int32) for e in entries]
+            ),
+        }
+        self.stacked = (
+            jax.device_put(stacked)
+            if self._fleet_sharding is None
+            else jax.device_put(stacked, self._fleet_sharding)
         )
         self._programs: Dict[Tuple[int, int], Any] = {}
         self._cond = threading.Condition()
@@ -197,7 +220,18 @@ class _Bucket:
             total = jnp.linalg.norm(scaled, axis=-1)
             return x_tail, pred_raw, scaled, total
 
-        program = jax.jit(jax.vmap(score_one, in_axes=(None, 0, 0)))
+        vmapped = jax.vmap(score_one, in_axes=(None, 0, 0))
+        if self._fleet_sharding is None:
+            program = jax.jit(vmapped)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            replicated = NamedSharding(self.mesh, PartitionSpec())
+            program = jax.jit(
+                vmapped,
+                in_shardings=(self._fleet_sharding, replicated, replicated),
+                out_shardings=replicated,
+            )
         self._programs[key] = program
         return program
 
@@ -283,6 +317,12 @@ class ServingEngine:
     with ``n_targets != n_features`` and no mapping here cannot be lifted
     (the engine would not know which input columns its residuals score
     against) and falls back to the host path.
+
+    ``mesh``: optional 1-D device mesh — every bucket's stacked machine
+    axis shards over it, so a plant-scale fleet whose stacked params
+    exceed one chip's HBM serves from the whole pod (capacity mode; see
+    ``_Bucket``). Scoring results are numerically identical to the
+    single-device engine (parity-tested on the virtual mesh).
     """
 
     def __init__(
@@ -292,7 +332,9 @@ class ServingEngine:
         min_rows_bucket: int = 64,
         max_rows_dispatch: int = 8192,
         target_cols: Optional[Dict[str, Optional[List[int]]]] = None,
+        mesh=None,
     ):
+        self.mesh = mesh
         self.max_batch = max_batch
         self.min_rows_bucket = min_rows_bucket
         # row-bucket cap: requests beyond this score in overlapping chunks
@@ -394,6 +436,7 @@ class ServingEngine:
                 lookahead=est0.lookahead,
                 entries=[entry for _, entry in members],
                 max_batch=max_batch,
+                mesh=mesh,
             )
             self._buckets.append(bucket)
             for i, (_, entry) in enumerate(members):

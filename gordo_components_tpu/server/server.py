@@ -179,11 +179,19 @@ class _ServerState:
 
     __slots__ = ("machines", "single", "engine")
 
-    def __init__(self, machines: Dict[str, _Machine]):
+    def __init__(self, machines: Dict[str, _Machine], shard_fleet: bool = False):
         self.machines = machines
         self.single = (
             next(iter(machines.values())) if len(machines) == 1 else None
         )
+        mesh = None
+        if shard_fleet:
+            # capacity mode: stacked params shard over every local device
+            # (fleets whose weights exceed one chip's HBM) at the cost of
+            # per-request gather hops — see engine._Bucket
+            from ..parallel.mesh import fleet_mesh
+
+            mesh = fleet_mesh()
         # stacked TPU scoring: machines sharing an architecture serve from
         # one device-resident pytree + one jitted program (engine.py);
         # anything the engine can't lift falls back to model.anomaly
@@ -193,6 +201,7 @@ class _ServerState:
                 name: machine.target_columns
                 for name, machine in machines.items()
             },
+            mesh=mesh,
         )
 
 
@@ -208,11 +217,14 @@ class ModelServer:
         model_dirs: Union[str, Dict[str, str]],
         project: str = "project",
         models_root: Optional[str] = None,
+        shard_fleet: bool = False,
     ):
         """``models_root``: optional directory whose immediate subdirs are
         model dirs; enables ``POST /reload`` so machines built AFTER server
         start (a fleet build appending to the same tree) become servable
-        without a restart."""
+        without a restart. ``shard_fleet``: shard every bucket's stacked
+        params over all local devices (HBM capacity mode)."""
+        self.shard_fleet = shard_fleet
         if isinstance(model_dirs, str):
             machine = _Machine("default", model_dirs)
             machine.name = machine.metadata.get("name", "default")
@@ -229,7 +241,7 @@ class ModelServer:
         # under their metadata name rather than their dir basename)
         self._pinned = dict(machines) if models_root else {}
         self._reload_lock = threading.Lock()
-        self._state = _ServerState(machines)
+        self._state = _ServerState(machines, shard_fleet=shard_fleet)
         self.latency = _Latency()
         logger.info(
             "ModelServer serving %d model(s): %s",
@@ -299,7 +311,7 @@ class ModelServer:
                         machines[name] = current
             removed = sorted(set(state.machines) - set(machines))
             if added or removed or refreshed:
-                new_state = _ServerState(machines)
+                new_state = _ServerState(machines, shard_fleet=self.shard_fleet)
                 # warm new/changed bucket programs BEFORE publishing the
                 # generation: the old state serves meanwhile, so no request
                 # ever races the compile (the reload POST waits instead)
@@ -619,9 +631,13 @@ def build_app(
     model_dirs: Union[str, Dict[str, str]],
     project: str = "project",
     models_root: Optional[str] = None,
+    shard_fleet: bool = False,
 ) -> ModelServer:
     """App factory (reference: ``server.build_app``)."""
-    return ModelServer(model_dirs, project=project, models_root=models_root)
+    return ModelServer(
+        model_dirs, project=project, models_root=models_root,
+        shard_fleet=shard_fleet,
+    )
 
 
 def run_server(
@@ -630,6 +646,7 @@ def run_server(
     port: int = 5555,
     project: str = "project",
     models_root: Optional[str] = None,
+    shard_fleet: bool = False,
 ) -> None:
     """Serve with werkzeug's multithreaded server.
 
@@ -646,7 +663,10 @@ def run_server(
     """
     from werkzeug.serving import run_simple
 
-    app = build_app(model_dirs, project=project, models_root=models_root)
+    app = build_app(
+        model_dirs, project=project, models_root=models_root,
+        shard_fleet=shard_fleet,
+    )
     # compile each bucket's scoring program BEFORE accepting traffic: the
     # first request must pay dispatch (ms), not XLA compile (tens of s).
     # Best-effort — one broken bucket must not keep the healthy machines

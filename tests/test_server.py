@@ -126,6 +126,23 @@ def test_anomaly_prediction(client):
 
 
 @pytest.mark.slow
+def test_shard_fleet_server_parity(model_dirs):
+    """build_app(shard_fleet=True) serves from mesh-sharded stacked params
+    with responses identical to the default engine (capacity mode)."""
+    sharded = Client(build_app(model_dirs, project="proj", shard_fleet=True))
+    plain = Client(build_app(model_dirs, project="proj"))
+    X = np.random.default_rng(3).normal(size=(12, 3)).tolist()
+    a = _post(sharded, "/gordo/v0/proj/machine-a/anomaly/prediction",
+              {"X": X}).get_json()["data"]
+    b = _post(plain, "/gordo/v0/proj/machine-a/anomaly/prediction",
+              {"X": X}).get_json()["data"]
+    np.testing.assert_allclose(
+        a["total-anomaly-score"], b["total-anomaly-score"], atol=1e-4
+    )
+    np.testing.assert_allclose(a["model-output"], b["model-output"], atol=1e-5)
+
+
+@pytest.mark.slow
 def test_forecast_machine_serves_over_http(tmp_path):
     """A multi-step forecast machine end-to-end over the REST surface: the
     response honors the horizon contract (n - L + 1 - k rows) and the
