@@ -27,7 +27,8 @@ faster than target).
 Env overrides: BENCH_SERVE_MACHINES (100), BENCH_SERVE_ROWS (144 = one day
 at 10-min resolution), BENCH_SERVE_TAGS (10), BENCH_SERVE_REQUESTS (200),
 BENCH_CPU (0 — force the CPU backend, e.g. when the accelerator tunnel is
-down).
+down), BENCH_SERVE_SHARD (0 — shard stacked params over all devices, the
+HBM capacity mode; measures the gather-hop latency cost vs replicated).
 """
 
 from __future__ import annotations
@@ -88,7 +89,12 @@ def build_engine(n_machines: int, rows: int, tags: int):
             est.params_,
         )
         models[f"machine-{i:04d}"] = model
-    return ServingEngine(models)
+    mesh = None
+    if os.environ.get("BENCH_SERVE_SHARD", "0") == "1":
+        from gordo_components_tpu.parallel.mesh import fleet_mesh
+
+        mesh = fleet_mesh()
+    return ServingEngine(models, mesh=mesh)
 
 
 def main() -> None:
@@ -148,9 +154,17 @@ def main() -> None:
     idxs_dev = jax.device_put(np.asarray([idx], np.int32))
     jax.block_until_ready(program(bucket.stacked, idxs_dev, xs_dev))
     n_pipe = max(n_requests, 100)
+    shard_mode = engine.mesh is not None
     started = time.perf_counter()
-    outs = [program(bucket.stacked, idxs_dev, xs_dev) for _ in range(n_pipe)]
-    jax.block_until_ready(outs)
+    if shard_mode:
+        # sharded executions carry collectives; un-awaited pipelining would
+        # interleave their in-process rendezvous (CPU backend) — await each
+        # dispatch, so this number includes the per-call gather cost
+        for _ in range(n_pipe):
+            jax.block_until_ready(program(bucket.stacked, idxs_dev, xs_dev))
+    else:
+        outs = [program(bucket.stacked, idxs_dev, xs_dev) for _ in range(n_pipe)]
+        jax.block_until_ready(outs)
     device_ms = (time.perf_counter() - started) / n_pipe * 1000.0
 
     # -- sustained concurrent load (micro-batching path) --------------------
@@ -181,6 +195,7 @@ def main() -> None:
         "concurrent_rps": round(throughput, 1),
         "compiled_programs": stats["compiled_programs"],
         "max_dispatch_batch": stats["max_dispatch_batch"],
+        "shard_mesh_devices": stats["shard_mesh_devices"],
     }
     if degraded:
         result["degraded"] = (

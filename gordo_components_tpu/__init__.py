@@ -15,4 +15,4 @@ file-path granularity) as a brand-new JAX/Flax/pjit-first design:
 - dataset windowing is a static-shape gather that XLA fuses on-device.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"

@@ -33,6 +33,7 @@ operator can see WHICH machines serve via the slow path (VERDICT r2 weak
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import threading
@@ -49,6 +50,12 @@ from ..ops import windowing
 from ..ops.scaling import ScalerParams
 
 logger = logging.getLogger(__name__)
+
+# ONE lock per PROCESS for sharded dispatches: collective rendezvous (CPU
+# backend) aborts the process if two sharded executions interleave, and the
+# hazard spans engine GENERATIONS (a /reload warms a new engine while the
+# old one serves) — so the lock cannot live on the engine instance
+_SHARD_DISPATCH_LOCK = threading.Lock()
 
 
 def _round_up_pow2(n: int, minimum: int = 1) -> int:
@@ -137,11 +144,16 @@ class _Bucket:
         entries: List[_MachineEntry],
         max_batch: int,
         mesh=None,
+        dispatch_lock: Optional[threading.Lock] = None,
     ):
         self.apply_fn = apply_fn
         self.lookback = lookback
         self.lookahead = lookahead
         self.max_batch = max_batch
+        # shard mode: sharded executions contain collectives whose
+        # in-process rendezvous (CPU backend) must not interleave across
+        # concurrent dispatches — the engine hands every bucket ONE lock
+        self._dispatch_lock = dispatch_lock
         self.mesh = mesh
         self.names = [e.name for e in entries]  # REAL machines only — padding
         # below must never surface in warmup/dispatch name lists
@@ -155,24 +167,28 @@ class _Bucket:
             # rows are unreachable (dispatch uses real indices only)
             n_pad = pad_to_multiple(len(entries), mesh.size)
             entries = entries + [entries[0]] * (n_pad - len(entries))
+        # stack on the HOST (entries are device_get numpy): capacity mode
+        # exists for fleets that do NOT fit one chip, so the stacked tree
+        # must never materialize on a single device — the sharded
+        # device_put below streams each shard straight to its device
         stacked = {
             "params": jax.tree_util.tree_map(
-                lambda *leaves: jnp.stack(leaves), *[e.params for e in entries]
+                lambda *leaves: np.stack(leaves), *[e.params for e in entries]
             ),
             "sx": ScalerParams(
-                scale=jnp.stack([e.sx.scale for e in entries]),
-                offset=jnp.stack([e.sx.offset for e in entries]),
+                scale=np.stack([e.sx.scale for e in entries]),
+                offset=np.stack([e.sx.offset for e in entries]),
             ),
             "sy": ScalerParams(
-                scale=jnp.stack([e.sy.scale for e in entries]),
-                offset=jnp.stack([e.sy.offset for e in entries]),
+                scale=np.stack([e.sy.scale for e in entries]),
+                offset=np.stack([e.sy.offset for e in entries]),
             ),
             "es": ScalerParams(
-                scale=jnp.stack([e.es.scale for e in entries]),
-                offset=jnp.stack([e.es.offset for e in entries]),
+                scale=np.stack([e.es.scale for e in entries]),
+                offset=np.stack([e.es.offset for e in entries]),
             ),
-            "tcols": jnp.stack(
-                [jnp.asarray(e.tcols, jnp.int32) for e in entries]
+            "tcols": np.stack(
+                [np.asarray(e.tcols, np.int32) for e in entries]
             ),
         }
         self.stacked = (
@@ -283,9 +299,10 @@ class _Bucket:
             )
             xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
             program = self._program(rows, kb)
-            x_tail, pred, scaled, total = jax.device_get(
-                program(self.stacked, idxs, xs)
-            )
+            with self._dispatch_lock or contextlib.nullcontext():
+                x_tail, pred, scaled, total = jax.device_get(
+                    program(self.stacked, idxs, xs)
+                )
             self.dispatch_count += 1
             self.request_count += k
             self.max_batch_seen = max(self.max_batch_seen, k)
@@ -335,6 +352,11 @@ class ServingEngine:
         mesh=None,
     ):
         self.mesh = mesh
+        # the PROCESS-global lock in shard mode (see its definition): all
+        # buckets of all engine generations serialize sharded dispatches
+        self._shard_dispatch_lock = (
+            _SHARD_DISPATCH_LOCK if mesh is not None else None
+        )
         self.max_batch = max_batch
         self.min_rows_bucket = min_rows_bucket
         # row-bucket cap: requests beyond this score in overlapping chunks
@@ -437,6 +459,7 @@ class ServingEngine:
                 entries=[entry for _, entry in members],
                 max_batch=max_batch,
                 mesh=mesh,
+                dispatch_lock=self._shard_dispatch_lock,
             )
             self._buckets.append(bucket)
             for i, (_, entry) in enumerate(members):
@@ -562,4 +585,7 @@ class ServingEngine:
             # machines serving via the ~100x slower host path, with WHY —
             # the operator-facing slow set (VERDICT r2 weak #5)
             "host_path_machines": dict(sorted(self.skipped.items())),
+            # 0 = single-device replicated (latency mode); >0 = stacked
+            # params sharded over that many devices (capacity mode)
+            "shard_mesh_devices": self.mesh.size if self.mesh else 0,
         }
