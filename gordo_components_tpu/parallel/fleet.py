@@ -558,6 +558,15 @@ def put_fleet_batch(batch: MachineBatch, formats=None) -> MachineBatch:
     return MachineBatch(*placed)
 
 
+def backend_supports_donation(mesh=None) -> bool:
+    """Whether the target backend honors ``donate_argnums``. XLA:CPU does
+    not — donated buffers are silently copied and every execution emits a
+    ``Some donated buffers were not usable`` warning, drowning real signal
+    in a full test run (VERDICT r3 #8) — so callers gate donation here."""
+    device = mesh.devices.flat[0] if mesh is not None else jax.devices()[0]
+    return device.platform != "cpu"
+
+
 def train_fleet_arrays(
     spec: FleetSpec,
     batch: MachineBatch,
@@ -578,9 +587,10 @@ def train_fleet_arrays(
     ``donate=True`` lets XLA reuse the device-placed batch's HBM for
     intermediates (the placed copies are consumed; the caller's host
     arrays are untouched) — the peak-memory lever for plant-scale buckets;
-    see :func:`fleet_program`. On backends without donation support (CPU)
-    XLA ignores it with a warning.
+    see :func:`fleet_program`. Ignored on backends without donation
+    support (:func:`backend_supports_donation`).
     """
+    donate = donate and backend_supports_donation(mesh)
     n_machines, n_rows, n_features = batch.X.shape
     n_targets = batch.y.shape[2]
     if mesh is not None and n_machines % mesh.size != 0:

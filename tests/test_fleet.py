@@ -106,14 +106,45 @@ def test_fleet_on_mesh_sharded():
     )
 
 
+def test_fleet_donation_gated_and_silent_on_cpu():
+    """On CPU donation is unsupported, so the gate in train_fleet_arrays
+    must drop it silently — zero 'donated buffers' warnings in a full run
+    (VERDICT r3 #8)."""
+    import warnings
+
+    from gordo_components_tpu.parallel.fleet import backend_supports_donation
+
+    assert backend_supports_donation() is (jax.devices()[0].platform != "cpu")
+    spec, batch = _make_spec_and_batch(2)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        donated = train_fleet_arrays(spec, batch, donate=True)
+        jax.block_until_ready(donated)
+    assert not [w for w in caught if "donated" in str(w.message)]
+
+
 @pytest.mark.filterwarnings("ignore:Some donated buffers were not usable")
 def test_fleet_donation_matches_undonated():
-    """donate=True (the build_fleet path — XLA may overlay intermediates on
-    the batch's HBM) must be numerically identical to the undonated program
-    and compile as a SEPARATE cached executable."""
+    """A program COMPILED with donate_argnums (the build_fleet path on TPU —
+    XLA may overlay intermediates on the batch's HBM) must be numerically
+    identical to the undonated program. train_fleet_arrays now gates
+    donation off on CPU, so exercise the donated executable directly via
+    fleet_executable — XLA:CPU copies the buffers (the filtered warning)
+    but still runs the donate-compiled program, keeping the parity check
+    meaningful in CI."""
+    from gordo_components_tpu.parallel.fleet import (
+        fleet_executable,
+        put_fleet_batch,
+    )
+
     spec, batch = _make_spec_and_batch(2)
     plain = train_fleet_arrays(spec, batch)
-    donated = train_fleet_arrays(spec, batch, donate=True)
+    n_rows, n_features = batch.X.shape[1], batch.X.shape[2]
+    compiled, formats = fleet_executable(
+        spec, 2, n_rows, n_features, batch.y.shape[2], donate=True
+    )
+    placed = put_fleet_batch(batch, formats)
+    donated = compiled(placed.X, placed.y, placed.w, placed.keys)
     np.testing.assert_allclose(
         np.asarray(donated.loss_history), np.asarray(plain.loss_history),
         rtol=1e-5,

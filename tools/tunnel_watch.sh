@@ -1,0 +1,35 @@
+#!/bin/bash
+# Tunnel watcher (VERDICT r3 #1): probe the accelerator backend every
+# POLL_S seconds; exit 0 the moment a probe sees a live non-CPU device so
+# the operator can immediately run the TPU bench suite. Exits 1 at the
+# deadline. Logs timestamped probe results to tools/tunnel_watch.log.
+set -u
+# the package is not pip-installed: the probe import only resolves from the
+# repo root, wherever the watcher was launched from
+export PYTHONPATH="/root/repo${PYTHONPATH:+:$PYTHONPATH}"
+POLL_S=${POLL_S:-600}
+DEADLINE_S=${DEADLINE_S:-39600}   # 11h
+LOG=${LOG:-/root/repo/tools/tunnel_watch.log}
+START=$(date +%s)
+while true; do
+  NOW=$(date +%s)
+  if (( NOW - START > DEADLINE_S )); then
+    echo "$(date -Is) DEADLINE reached, tunnel never came up" >> "$LOG"
+    exit 1
+  fi
+  OUT=$(timeout 100 python - <<'EOF' 2>/dev/null
+from gordo_components_tpu.utils.backend import call_with_timeout
+import jax
+status, value = call_with_timeout(lambda: [str(d) for d in jax.devices()], 80)
+print(status, value)
+EOF
+)
+  echo "$(date -Is) probe: ${OUT:-timeout-hard}" >> "$LOG"
+  case "$OUT" in
+    ok*[Tt][Pp][Uu]*|ok*axon*|ok*Axon*)
+      echo "$(date -Is) TUNNEL LIVE" >> "$LOG"
+      exit 0
+      ;;
+  esac
+  sleep "$POLL_S"
+done
