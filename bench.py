@@ -163,6 +163,9 @@ def _configs(
         # bf16 + flash attention + remat — the config where the MXU should
         # dominate. TPU-only (see main(): the CPU fallback would crawl for
         # hours in Pallas interpret mode and blow the driver's budget).
+        # batch_size=16, NOT 64: the step peak is linear in batch x tags
+        # (tools/plant_memory_sweep.py, r4) — B=64 needs ~41 GiB at 10k
+        # tags (2.6x v5e HBM, guaranteed OOM); B=16 fits with headroom.
         "plant_10ktag_bf16": {
             "model": _anomaly_config(
                 "PatchTSTAutoEncoder",
@@ -171,7 +174,7 @@ def _configs(
                 d_model=64,
                 n_layers=2,
                 epochs=max(2, epochs // 3),
-                batch_size=64,
+                batch_size=16,
                 compute_dtype="bfloat16",
                 attention_impl="flash",
                 remat=True,
