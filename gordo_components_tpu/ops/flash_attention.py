@@ -285,12 +285,31 @@ def flash_attention(
 
     Shapes follow the flax convention: q/k/v ``(..., seq, heads,
     head_dim)`` → ``(..., seq, heads, head_dim)``. Worth using when the
-    patch/sequence axis is long (the score matrix would be large); for
-    short sequences the tile padding makes ``dense_attention`` faster.
+    patch/sequence axis is long (the score matrix would be large).
+
+    **Short sequences fall back to** :func:`~gordo_components_tpu.ops.
+    attention.dense_attention`: when the whole sequence fits in one
+    q-block AND one k-block (``seq <= min(block_q, block_k)``) the kernel
+    degenerates to dense attention
+    computed on tile-padded operands — same arithmetic, strictly more
+    HBM. The padding is not a rounding error: each operand is padded to
+    ``(lcm(block_q, block_k), 128)`` regardless of true size, so a
+    many-machine short-window config (e.g. PatchTST at plant scale: 7
+    patches x 16-wide heads over batch x tags x heads = 640k rows)
+    materializes ~146x its real footprint — measured as a 21 GB HBM
+    request vs 16 GiB on v5e, a guaranteed compile-time OOM
+    (docs/measurements/bench_tpu_r4_run1.json, round 4). Dense attention
+    at those shapes keeps the score matrix trivially small. The crossover
+    rule is structural (single-tile => dense), not a tuned threshold.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     *batch, seq, heads, head_dim = q.shape
+    if seq <= min(block_q, block_k):
+        from .attention import dense_attention  # lazy: avoids an import
+        # cycle (attention.py imports this module inside its flash hop)
+
+        return dense_attention(q, k, v, scale)
     bh = heads
     for dim in batch:  # python shape math — jnp would trace it
         bh *= int(dim)

@@ -20,25 +20,49 @@ def _qkv(shape, seed=0, dtype=jnp.float32):
 
 
 @pytest.mark.parametrize(
-    "shape",
+    "shape,blocks",
     [
-        (2, 16, 2, 8),  # short seq, small head_dim (lane padding)
-        (1, 37, 1, 4),  # odd seq — exercises the padded-key mask
-        (2, 160, 2, 8),  # seq > one k block with block=128
+        # short-seq cases pass explicit small blocks so seq spans multiple
+        # tiles and the KERNEL runs (default 128-blocks would now take the
+        # single-tile dense fallback and test dense against itself)
+        ((2, 16, 2, 8), dict(block_q=8, block_k=8)),  # small head_dim
+        ((1, 37, 1, 4), dict(block_q=8, block_k=8)),  # odd seq — padded-key mask
+        ((2, 160, 2, 8), {}),  # seq > one k block with default block=128
     ],
 )
-def test_flash_matches_dense_forward(shape):
+def test_flash_matches_dense_forward(shape, blocks):
     q, k, v = _qkv(shape)
-    ours = flash_attention(q, k, v)
+    ours = flash_attention(q, k, v, **blocks)
     ref = dense_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_short_seq_falls_back_to_dense():
+    """A sequence that fits in one q block AND one k block must route to
+    dense_attention: the kernel would compute the same thing on operands
+    tile-padded to (lcm(block_q, block_k), 128) — at plant scale (7
+    patches, 16-wide heads, 640k batch x tag x head rows) that padding was
+    a measured 21 GB HBM request vs 16 GiB on v5e (round-4 bench OOM)."""
+    short = _qkv((4, 7, 4, 16), seed=17)
+    long_ = _qkv((1, 200, 1, 8), seed=19)
+    jaxpr_short = str(jax.make_jaxpr(flash_attention)(*short))
+    jaxpr_long = str(jax.make_jaxpr(flash_attention)(*long_))
+    assert "pallas_call" not in jaxpr_short  # dense fallback taken
+    assert "pallas_call" in jaxpr_long  # real kernel above one tile
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(*short)),
+        np.asarray(dense_attention(*short)),
+        atol=2e-5,
+    )
 
 
 def test_flash_asymmetric_blocks():
     """block_q > block_k pads the sequence beyond a block_k multiple — the
     phantom key block must be masked (regression: the mask guard used to
-    check seq % block_k only)."""
-    q, k, v = _qkv((1, 128, 1, 8), seed=11)
+    check seq % block_k only). seq=200 > min(block) so the KERNEL runs
+    (seq=128 would take the dense fallback and test nothing), padding to
+    lcm=256 with phantom keys 200-255."""
+    q, k, v = _qkv((1, 200, 1, 8), seed=11)
     ours = flash_attention(q, k, v, block_q=256, block_k=128)
     ref = dense_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
@@ -47,8 +71,10 @@ def test_flash_asymmetric_blocks():
 def test_flash_non_divisible_blocks():
     """block_k not dividing block_q: padding must reach a common multiple
     of both, or trailing key blocks are never visited (regression: keys
-    64-79 were silently dropped for block_q=96, block_k=64, seq=80)."""
-    q, k, v = _qkv((1, 80, 1, 8), seed=13)
+    64-79 were silently dropped for block_q=96, block_k=64, seq=80).
+    seq=200 > min(block) so the kernel runs (not the dense fallback); pad
+    target is lcm(96,64)=192 -> 384, trailing keys must all be visited."""
+    q, k, v = _qkv((1, 200, 1, 8), seed=13)
     ours = flash_attention(q, k, v, block_q=96, block_k=64)
     ref = dense_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
@@ -63,7 +89,8 @@ def test_flash_matches_dense_gradients():
     def loss(fn):
         return lambda q, k, v: jnp.sum(fn(q, k, v) * g)
 
-    ours = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    flash = lambda q, k, v: flash_attention(q, k, v, block_q=16, block_k=16)
+    ours = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
     ref = jax.grad(loss(dense_attention), argnums=(0, 1, 2))(q, k, v)
     for a, b, name in zip(ours, ref, "qkv"):
         np.testing.assert_allclose(
@@ -73,7 +100,7 @@ def test_flash_matches_dense_gradients():
 
 def test_flash_bfloat16_forward():
     q, k, v = _qkv((2, 32, 2, 8), seed=5, dtype=jnp.bfloat16)
-    ours = flash_attention(q, k, v)
+    ours = flash_attention(q, k, v, block_q=16, block_k=16)
     assert ours.dtype == jnp.bfloat16
     ref = dense_attention(
         q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
@@ -85,7 +112,7 @@ def test_flash_bfloat16_forward():
 
 def test_flash_custom_scale_and_no_batch():
     q, k, v = _qkv((24, 2, 8), seed=7)  # no leading batch dim
-    ours = flash_attention(q, k, v, scale=0.3)
+    ours = flash_attention(q, k, v, scale=0.3, block_q=8, block_k=8)
     ref = dense_attention(q, k, v, scale=0.3)
     np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
 
