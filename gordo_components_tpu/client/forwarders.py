@@ -2,8 +2,10 @@
 
 Reference parity: ``gordo_components/client/forwarders.py`` [UNVERIFIED] —
 ``PredictionForwarder`` + ``ForwardPredictionsIntoInflux``. The Influx
-forwarder is gated on the optional ``influxdb`` package (absent in this
-image); ``CsvForwarder`` provides a dependency-free sink for backfills.
+forwarder uses the installed ``influxdb`` package when present and
+otherwise the in-repo stdlib wire client
+(``dataset/data_provider/influx_client.py``), so it works with no
+optional dependency; ``CsvForwarder`` provides a file sink for backfills.
 """
 
 from __future__ import annotations
@@ -39,8 +41,11 @@ class CsvForwarder(PredictionForwarder):
 
 
 class ForwardPredictionsIntoInflux(PredictionForwarder):
-    """Write scores into InfluxDB (measurement per machine). Requires the
-    optional ``influxdb`` client package."""
+    """Write scores into InfluxDB (measurement per machine), as line
+    protocol on the real wire. Client resolution mirrors
+    ``InfluxDataProvider``: injected ``client`` > installed ``influxdb``
+    package > in-repo stdlib ``MinimalInfluxClient`` (round-trip-tested
+    against tests/influx_double.py over real sockets)."""
 
     def __init__(self, measurement: str = "anomaly", client=None, **influx_config):
         """``client``: a pre-built DataFrame-style client (tests /
@@ -52,12 +57,14 @@ class ForwardPredictionsIntoInflux(PredictionForwarder):
             return
         try:
             import influxdb  # type: ignore
-        except ImportError as exc:
-            raise RuntimeError(
-                "ForwardPredictionsIntoInflux requires the optional "
-                "'influxdb' package, which is not installed."
-            ) from exc
-        self._client = influxdb.DataFrameClient(**influx_config)
+
+            self._client = influxdb.DataFrameClient(**influx_config)
+        except ImportError:
+            from ..dataset.data_provider.influx_client import (
+                MinimalInfluxClient,
+            )
+
+            self._client = MinimalInfluxClient(**influx_config)
 
     def forward(self, machine: str, predictions: pd.DataFrame) -> None:
         self._client.write_points(

@@ -1,0 +1,249 @@
+"""Minimal InfluxDB 1.x HTTP client (stdlib-only) with a
+``DataFrameClient``-compatible surface.
+
+Reference parity: the reference's Influx stack (SURVEY.md §3
+``dataset/data_provider/providers.py`` + ``client/forwarders.py``
+[UNVERIFIED]) depends on the ``influxdb`` PyPI package, which this image
+does not ship. Rather than leave the provider/forwarder stubbed behind an
+ImportError (round-3 state: "experimental, fake-client-tested only"), this
+module speaks the actual InfluxDB 1.x wire protocol with nothing but
+``urllib``:
+
+- ``write_points(dataframe, measurement, tags=...)`` serializes the frame
+  to line protocol (escaping per the spec) and POSTs ``/write?db=...
+  &precision=ns``;
+- ``query(q)`` GETs ``/query?db=...&q=...&epoch=ns`` and parses the JSON
+  ``results[].series[]`` envelope into ``{measurement: DataFrame}`` with a
+  tz-aware UTC ``DatetimeIndex`` — the exact shape
+  ``influxdb.DataFrameClient.query`` returns and
+  :class:`~gordo_components_tpu.dataset.data_provider.providers.
+  InfluxDataProvider` consumes.
+
+:class:`InfluxDataProvider` and :class:`ForwardPredictionsIntoInflux`
+fall back to this client when the ``influxdb`` package is absent (the
+installed package, when present, stays preferred: it covers UDP, chunked
+queries, retries and auth modes this minimal client does not). The wire
+behavior is pinned by tests/test_influx.py against an in-repo HTTP double
+(tests/influx_double.py) over real sockets.
+
+Scope: HTTP(S) basic-auth + header auth, ns-precision writes, single-
+statement InfluxQL queries. Not implemented: UDP, chunked responses,
+``GROUP BY`` multi-series tag keys (each returned series must carry a
+distinct ``name``).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from base64 import b64encode
+from typing import Any, Dict, Optional
+
+import numpy as np
+import pandas as pd
+
+
+class InfluxQueryError(RuntimeError):
+    """A non-2xx ``/query`` or ``/write`` response, with the server body."""
+
+
+def _escape(value: str, *, chars: str) -> str:
+    if "\n" in value or "\r" in value:
+        # line protocol has NO escape for newlines in identifiers — an
+        # embedded one would split the point into a second, malformed line
+        # (write-side mirror of the query-side quoting in providers.py)
+        raise ValueError(
+            f"newline in line-protocol identifier {value!r}; InfluxDB "
+            "measurements/tags/field keys cannot contain line breaks"
+        )
+    out = value.replace("\\", "\\\\")
+    for ch in chars:
+        out = out.replace(ch, "\\" + ch)
+    return out
+
+
+def _escape_tag(value: str) -> str:
+    # tag keys, tag values and field keys share one escape set
+    return _escape(value, chars=",= ")
+
+
+def _escape_measurement(value: str) -> str:
+    return _escape(value, chars=", ")
+
+
+def _field_value(value: Any) -> Optional[str]:
+    """Line-protocol field literal, or None for missing values (NaN/None/
+    NaT fields are OMITTED from the line — Influx has no null literal)."""
+    if value is None:
+        return None
+    if isinstance(value, (bool, np.bool_)):
+        return "true" if value else "false"
+    if isinstance(value, (int, np.integer)):
+        return f"{int(value)}i"
+    if isinstance(value, (float, np.floating)):
+        if np.isnan(value):
+            return None
+        return repr(float(value))
+    try:  # pd.NaT and other pandas missing markers in object columns
+        if pd.isna(value):
+            return None
+    except (TypeError, ValueError):  # arrays etc. — fall through to str
+        pass
+    s = str(value)
+    if "\n" in s or "\r" in s:
+        # quoted string values have no newline escape either — a raw one
+        # splits the batch mid-line (same hazard as identifiers)
+        raise ValueError(
+            f"newline in string field value {s!r}; line protocol cannot "
+            "represent line breaks"
+        )
+    s = s.replace("\\", "\\\\").replace('"', '\\"')
+    return f'"{s}"'
+
+
+class MinimalInfluxClient:
+    """``influxdb.DataFrameClient`` work-alike over stdlib HTTP.
+
+    Constructor kwargs mirror the package's client so provider configs are
+    portable between the two; unknown kwargs are accepted and ignored for
+    the same reason (e.g. ``pool_size``, ``retries``).
+    """
+
+    def __init__(
+        self,
+        host: str = "localhost",
+        port: int = 8086,
+        username: Optional[str] = None,
+        password: Optional[str] = None,
+        database: Optional[str] = None,
+        ssl: bool = False,
+        timeout: Optional[float] = 30.0,
+        headers: Optional[Dict[str, str]] = None,
+        **_ignored: Any,
+    ):
+        # kwargs that select a DIFFERENT transport must not be silently
+        # dropped — a config written for the real package would construct
+        # fine here and then speak the wrong protocol (plain HTTP instead
+        # of UDP, unverified TLS instead of verified). Tuning kwargs
+        # (pool_size, retries, ...) are safe to ignore.
+        for key in ("use_udp", "udp_port", "proxies", "cert"):
+            if _ignored.get(key):
+                raise ValueError(
+                    f"MinimalInfluxClient does not support {key!r}; install "
+                    "the optional 'influxdb' package for that transport"
+                )
+        if _ignored.get("verify_ssl") is False:
+            raise ValueError(
+                "MinimalInfluxClient always verifies TLS; install the "
+                "optional 'influxdb' package for verify_ssl=False"
+            )
+        scheme = "https" if ssl else "http"
+        self._base = f"{scheme}://{host}:{port}"
+        self._database = database
+        self._timeout = timeout
+        self._headers = dict(headers or {})
+        if username is not None:
+            cred = b64encode(
+                f"{username}:{password or ''}".encode()
+            ).decode("ascii")
+            self._headers.setdefault("Authorization", f"Basic {cred}")
+
+    # -- wire helpers ----------------------------------------------------
+    def _request(
+        self, path: str, params: Dict[str, str], body: Optional[bytes] = None
+    ) -> bytes:
+        url = f"{self._base}{path}?{urllib.parse.urlencode(params)}"
+        req = urllib.request.Request(
+            url, data=body, headers=self._headers, method="POST" if body is not None else "GET"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode("utf-8", "replace")
+            raise InfluxQueryError(
+                f"InfluxDB {path} returned HTTP {exc.code}: {detail[:500]}"
+            ) from exc
+
+    # -- DataFrameClient surface -----------------------------------------
+    def query(self, q: str, database: Optional[str] = None) -> Dict[str, pd.DataFrame]:
+        """Run one InfluxQL statement; returns ``{series_name: DataFrame}``
+        (empty dict for empty results), frames indexed by tz-aware UTC
+        ``DatetimeIndex``."""
+        params = {"q": q, "epoch": "ns"}
+        db = database or self._database
+        if db:
+            params["db"] = db
+        payload = json.loads(self._request("/query", params).decode())
+        out: Dict[str, pd.DataFrame] = {}
+        for result in payload.get("results", []):
+            if "error" in result:
+                raise InfluxQueryError(result["error"])
+            for series in result.get("series", []):
+                columns = series["columns"]
+                frame = pd.DataFrame(series.get("values", []), columns=columns)
+                if "time" in columns:
+                    index = pd.to_datetime(frame.pop("time"), unit="ns", utc=True)
+                    frame.index = index
+                    frame.index.name = "time"
+                out[series["name"]] = frame
+        return out
+
+    def write_points(
+        self,
+        dataframe: pd.DataFrame,
+        measurement: str,
+        tags: Optional[Dict[str, str]] = None,
+        database: Optional[str] = None,
+        **_ignored: Any,
+    ) -> bool:
+        """Write a time-indexed frame: columns become fields, ``tags`` apply
+        to every point, timestamps are ns-precision."""
+        if not isinstance(dataframe.index, pd.DatetimeIndex):
+            raise TypeError(
+                "write_points needs a DatetimeIndex-ed frame, got "
+                f"{type(dataframe.index).__name__}"
+            )
+        index = dataframe.index
+        if index.tz is None:
+            index = index.tz_localize("UTC")
+        # pandas >= 2 indexes can carry s/ms/us resolution — the int64 view
+        # is only ns after an explicit as_unit (else writes land in 1970)
+        index = index.as_unit("ns")
+        tag_suffix = "".join(
+            f",{_escape_tag(str(k))}={_escape_tag(str(v))}"
+            for k, v in sorted((tags or {}).items())
+        )
+        prefix = _escape_measurement(measurement) + tag_suffix
+        timestamps = index.view("int64")
+        # serialize COLUMN-wise (never DataFrame.iterrows(): its row view
+        # upcasts integer columns to float in numeric frames, turning 'Ni'
+        # integer fields into floats — a field-type conflict against a
+        # server where the field already exists as integer)
+        columns = [
+            (_escape_tag(str(col)), [_field_value(v) for v in dataframe[col]])
+            for col in dataframe.columns
+        ]
+        lines = []
+        for i, ts in enumerate(timestamps):
+            fields = ",".join(
+                f"{key}={literals[i]}"
+                for key, literals in columns
+                if literals[i] is not None
+            )
+            if not fields:  # all-NaN row: no valid line-protocol encoding
+                continue
+            lines.append(f"{prefix} {fields} {int(ts)}")
+        if not lines:
+            return True
+        params = {"precision": "ns"}
+        db = database or self._database
+        if db:
+            params["db"] = db
+        self._request("/write", params, body="\n".join(lines).encode())
+        return True
+
+    def close(self) -> None:  # parity no-op: urllib holds no pooled sockets
+        pass

@@ -1,0 +1,333 @@
+"""In-repo InfluxDB 1.x HTTP test double (stdlib http.server).
+
+Stands in for the dockerized InfluxDB the reference's test suite spawns
+(SURVEY.md §5 [UNVERIFIED]) — this image has no docker and no network, so
+the wire protocol is validated against this double over real sockets
+instead: it implements the two endpoints the framework speaks,
+
+- ``POST /write?db=...&precision=ns`` — parses line protocol (measurement
+  + tag set + field set + ns timestamp, with the spec's backslash
+  escapes) into an in-memory point store;
+- ``GET /query?db=...&q=...&epoch=ns`` — executes the InfluxQL subset the
+  provider and tests emit (single-statement ``SELECT "field"|* FROM
+  "measurement" [WHERE tag = 'v' AND time >= '...' AND time < '...']
+  [LIMIT n]``) and answers in the server's JSON ``results[].series[]``
+  envelope with ns epoch times.
+
+Deliberately NOT a general InfluxDB: unsupported syntax returns HTTP 400
+with an error body (so a test emitting something new fails loudly instead
+of silently returning nothing).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from collections import defaultdict
+from datetime import datetime, timezone
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+
+def _split_preserve(text: str, sep: str) -> List[str]:
+    """Split on unescaped ``sep``, KEEPING escape sequences intact — parsing
+    is layered (spaces, then commas, then equals), so unescaping must only
+    happen once, at the innermost token (else ``\\=`` inside a tag value
+    becomes a live separator for the next layer)."""
+    parts, current, i = [], [], 0
+    while i < len(text):
+        ch = text[i]
+        if ch == "\\" and i + 1 < len(text):
+            current.append(ch)
+            current.append(text[i + 1])
+            i += 2
+            continue
+        if ch == sep:
+            parts.append("".join(current))
+            current = []
+        else:
+            current.append(ch)
+        i += 1
+    parts.append("".join(current))
+    return parts
+
+
+def _unescape(text: str) -> str:
+    return re.sub(r"\\(.)", r"\1", text)
+
+
+def _parse_line(line: str) -> Tuple[str, Dict[str, str], Dict[str, object], int]:
+    """One line-protocol line → (measurement, tags, fields, time_ns)."""
+    # token split on unescaped spaces: [measurement,tags] [fields] [ts]
+    tokens = _split_unescaped_spaces(line)
+    if len(tokens) != 3:
+        raise ValueError(f"expected 'key fields timestamp', got {line!r}")
+    key, field_part, ts_part = tokens
+    key_items = _split_preserve(key, ",")
+    measurement = _unescape(key_items[0])
+    tags = {}
+    for item in key_items[1:]:
+        k, v = _split_preserve(item, "=")
+        tags[_unescape(k)] = _unescape(v)
+    fields: Dict[str, object] = {}
+    for item in _split_field_pairs(field_part):
+        k, raw = item
+        if raw.startswith('"') and raw.endswith('"'):
+            fields[k] = raw[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+        elif raw in ("true", "t", "T", "True", "TRUE"):
+            fields[k] = True
+        elif raw in ("false", "f", "F", "False", "FALSE"):
+            fields[k] = False
+        elif raw.endswith("i"):
+            fields[k] = int(raw[:-1])
+        else:
+            fields[k] = float(raw)
+    return measurement, tags, fields, int(ts_part)
+
+
+def _split_unescaped_spaces(line: str) -> List[str]:
+    """Split into the 3 space-separated sections, respecting escapes and
+    quoted string field values (spaces inside quotes don't split)."""
+    parts, current, i, in_quotes = [], [], 0, False
+    while i < len(line):
+        ch = line[i]
+        if ch == "\\" and i + 1 < len(line):
+            # consume escape pairs in AND out of quotes — a \" inside a
+            # quoted field value must not toggle the quote state
+            current.append(ch)
+            current.append(line[i + 1])
+            i += 2
+            continue
+        if ch == '"':
+            in_quotes = not in_quotes
+        if ch == " " and not in_quotes:
+            parts.append("".join(current))
+            current = []
+        else:
+            current.append(ch)
+        i += 1
+    parts.append("".join(current))
+    return parts
+
+
+def _split_field_pairs(field_part: str) -> List[Tuple[str, str]]:
+    pairs = []
+    for item in _split_quoted_commas(field_part):
+        # split at the first UNESCAPED '=' (field keys escape theirs; the
+        # value side may hold '=' freely inside quotes)
+        i = 0
+        while i < len(item):
+            if item[i] == "\\":
+                i += 2
+                continue
+            if item[i] == "=":
+                break
+            i += 1
+        if i >= len(item):
+            raise ValueError(f"field pair without '=': {item!r}")
+        pairs.append((_unescape(item[:i]), item[i + 1 :]))
+    return pairs
+
+
+def _split_quoted_commas(text: str) -> List[str]:
+    parts, current, in_quotes, i = [], [], False, 0
+    while i < len(text):
+        ch = text[i]
+        if ch == "\\" and i + 1 < len(text):
+            current.append(ch)
+            current.append(text[i + 1])
+            i += 2
+            continue
+        if ch == '"':
+            in_quotes = not in_quotes
+        if ch == "," and not in_quotes:
+            parts.append("".join(current))
+            current = []
+        else:
+            current.append(ch)
+        i += 1
+    parts.append("".join(current))
+    return parts
+
+
+_SELECT_RE = re.compile(
+    r"^\s*SELECT\s+(?P<col>\"[^\"]+\"|\*)\s+FROM\s+"
+    r"(?P<measurement>\"(?:[^\"\\]|\\.)+\"|\S+)"
+    r"(?:\s+WHERE\s+(?P<where>.*?))?"
+    r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+_TIME_RE = re.compile(
+    r"^time\s*(?P<op>>=|<=|>|<)\s*'(?P<value>[^']+)'$", re.IGNORECASE
+)
+_TAG_RE = re.compile(r"^(?P<key>\"[^\"]+\"|\w[\w.-]*)\s*=\s*'(?P<value>(?:[^'\\]|\\.)*)'$")
+
+
+def _parse_time_ns(value: str) -> int:
+    stamp = datetime.fromisoformat(value)
+    if stamp.tzinfo is None:
+        stamp = stamp.replace(tzinfo=timezone.utc)
+    return int(stamp.timestamp() * 1e9)
+
+
+class InfluxDouble:
+    """The server + its point store. Start/stop per test via context
+    manager; ``url``/``host``/``port`` describe the live socket."""
+
+    def __init__(self):
+        # {(db, measurement): [(time_ns, tags, fields), ...]}
+        self._points: Dict[Tuple[str, str], List[tuple]] = defaultdict(list)
+        self._lock = threading.Lock()
+        self.requests: List[str] = []  # "<METHOD> <path>" audit trail
+        double = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence request logging
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Influxdb-Version", "1.8-double")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                parsed = urllib.parse.urlparse(self.path)
+                double.requests.append(f"POST {parsed.path}")
+                if parsed.path != "/write":
+                    return self._reply(404, {"error": "not found"})
+                params = dict(urllib.parse.parse_qsl(parsed.query))
+                if params.get("precision", "ns") != "ns":
+                    return self._reply(
+                        400, {"error": "double only speaks precision=ns"}
+                    )
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length).decode()
+                try:
+                    with double._lock:
+                        for line in body.splitlines():
+                            if not line.strip():
+                                continue
+                            m, tags, fields, ts = _parse_line(line)
+                            double._points[(params.get("db", ""), m)].append(
+                                (ts, tags, fields)
+                            )
+                except ValueError as exc:
+                    return self._reply(400, {"error": str(exc)})
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                double.requests.append(f"GET {parsed.path}")
+                if parsed.path == "/ping":
+                    return self._reply(204, {})
+                if parsed.path != "/query":
+                    return self._reply(404, {"error": "not found"})
+                params = dict(urllib.parse.parse_qsl(parsed.query))
+                if params.get("epoch") != "ns":
+                    return self._reply(
+                        400, {"error": "double only answers epoch=ns"}
+                    )
+                try:
+                    series = double._select(
+                        params.get("db", ""), params.get("q", "")
+                    )
+                except ValueError as exc:
+                    return self._reply(400, {"error": str(exc)})
+                result: dict = {"statement_id": 0}
+                if series is not None:
+                    result["series"] = [series]
+                self._reply(200, {"results": [result]})
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    # -- query engine ----------------------------------------------------
+    def _select(self, db: str, q: str) -> Optional[dict]:
+        match = _SELECT_RE.match(q)
+        if not match:
+            raise ValueError(f"double cannot parse InfluxQL: {q!r}")
+        measurement = match.group("measurement")
+        if measurement.startswith('"'):
+            measurement = re.sub(r"\\(.)", r"\1", measurement[1:-1])
+        tag_filters: Dict[str, str] = {}
+        t_min, t_max = None, None
+        where = match.group("where")
+        for cond in re.split(r"\s+AND\s+", where, flags=re.IGNORECASE) if where else []:
+            cond = cond.strip()
+            time_m = _TIME_RE.match(cond)
+            if time_m:
+                ns = _parse_time_ns(time_m.group("value"))
+                op = time_m.group("op")
+                if op in (">=", ">"):
+                    t_min = ns + (1 if op == ">" else 0)
+                else:
+                    t_max = ns + (1 if op == "<=" else 0)
+                continue
+            tag_m = _TAG_RE.match(cond)
+            if tag_m:
+                key = tag_m.group("key").strip('"')
+                tag_filters[key] = re.sub(r"\\(.)", r"\1", tag_m.group("value"))
+                continue
+            raise ValueError(f"double cannot parse WHERE term: {cond!r}")
+        with self._lock:
+            points = list(self._points.get((db, measurement), []))
+        rows = [
+            (ts, fields)
+            for ts, tags, fields in points
+            if (t_min is None or ts >= t_min)
+            and (t_max is None or ts < t_max)
+            and all(tags.get(k) == v for k, v in tag_filters.items())
+        ]
+        if not rows:
+            return None
+        rows.sort(key=lambda r: r[0])
+        limit = match.group("limit")
+        if limit:
+            rows = rows[: int(limit)]
+        col = match.group("col")
+        if col == "*":
+            columns = sorted({k for _, fields in rows for k in fields})
+        else:
+            columns = [col[1:-1]]
+        return {
+            "name": measurement,
+            "columns": ["time"] + columns,
+            "values": [
+                [ts] + [fields.get(c) for c in columns] for ts, fields in rows
+            ],
+        }
+
+    # -- lifecycle --------------------------------------------------------
+    def __enter__(self) -> "InfluxDouble":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def host(self) -> str:
+        return self._server.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def point_count(self, db: str, measurement: str) -> int:
+        with self._lock:
+            return len(self._points.get((db, measurement), []))

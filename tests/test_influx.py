@@ -1,0 +1,284 @@
+"""Real-wire InfluxDB protocol tests (VERDICT r3 #4).
+
+The reference validates its Influx stack against a dockerized InfluxDB
+(SURVEY.md §5 [UNVERIFIED]); this image has neither docker nor the
+``influxdb`` package, so the protocol is exercised over REAL sockets
+against the in-repo 1.x double (tests/influx_double.py): the in-repo
+stdlib client (the provider/forwarder fallback) speaks actual line
+protocol and ``/query`` JSON, and the full provider → dataset and
+forwarder → read-back loops run through HTTP end to end with no injected
+fake anywhere.
+"""
+
+from datetime import datetime, timezone
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_components_tpu.client.forwarders import ForwardPredictionsIntoInflux
+from gordo_components_tpu.dataset import TimeSeriesDataset
+from gordo_components_tpu.dataset.data_provider import InfluxDataProvider
+from gordo_components_tpu.dataset.data_provider.influx_client import (
+    InfluxQueryError,
+    MinimalInfluxClient,
+)
+from gordo_components_tpu.dataset.sensor_tag import SensorTag
+
+from influx_double import InfluxDouble
+
+
+def _seed_sensor_data(client, tags, periods=144, measurement="sensor_data"):
+    """Write per-tag series the way an ingest job would: one measurement,
+    machine tags in the tag set, readings in the ``value`` field."""
+    for offset, tag in enumerate(tags):
+        idx = pd.date_range(
+            "2023-01-01", periods=periods, freq="10min", tz="UTC"
+        )
+        frame = pd.DataFrame(
+            {"value": np.arange(periods, dtype=float) + 100 * offset}, index=idx
+        )
+        client.write_points(frame, measurement, tags={"tag": tag})
+
+
+def test_client_write_query_round_trip():
+    with InfluxDouble() as server:
+        client = MinimalInfluxClient(
+            host=server.host, port=server.port, database="db"
+        )
+        _seed_sensor_data(client, ["t1"], periods=6)
+        result = client.query(
+            "SELECT \"value\" FROM \"sensor_data\" WHERE tag = 't1' "
+            "AND time >= '2023-01-01T00:00:00+00:00' "
+            "AND time < '2023-01-01T00:40:00+00:00'"
+        )
+        frame = result["sensor_data"]
+        assert list(frame["value"]) == [0.0, 1.0, 2.0, 3.0]
+        assert str(frame.index.tz) == "UTC"
+        assert frame.index[1] - frame.index[0] == pd.Timedelta("10min")
+
+
+def test_client_escaping_survives_the_wire():
+    """Tag values/measurements with spaces, commas and quotes must make it
+    through line protocol and back out of InfluxQL intact."""
+    with InfluxDouble() as server:
+        client = MinimalInfluxClient(
+            host=server.host, port=server.port, database="db"
+        )
+        idx = pd.date_range("2023-01-01", periods=2, freq="1h", tz="UTC")
+        frame = pd.DataFrame(
+            {"value": [1.5, 2.5], "note": ['say "hi", ok', "plain"]},
+            index=idx,
+        )
+        client.write_points(
+            frame, "odd, measurement", tags={"tag": "GRA we,ird=01"}
+        )
+        result = client.query(
+            'SELECT "value" FROM "odd, measurement" '
+            "WHERE tag = 'GRA we,ird=01'"
+        )
+        assert list(result["odd, measurement"]["value"]) == [1.5, 2.5]
+
+
+def test_client_mixed_field_types_and_nan_rows():
+    with InfluxDouble() as server:
+        client = MinimalInfluxClient(
+            host=server.host, port=server.port, database="db"
+        )
+        idx = pd.date_range("2023-01-01", periods=3, freq="1h", tz="UTC")
+        frame = pd.DataFrame(
+            {
+                "value": [1.0, np.nan, 3.0],
+                "status": ["ok", "degraded", "ok"],
+                "count": [1, 2, 3],
+            },
+            index=idx,
+        )
+        client.write_points(frame, "m", tags={"machine": "x"})
+        result = client.query('SELECT * FROM "m"')["m"]
+        assert list(result["count"]) == [1, 2, 3]
+        assert result["value"].isna().sum() == 1  # NaN field omitted per spec
+        assert list(result["status"]) == ["ok", "degraded", "ok"]
+
+
+def test_client_int_fields_survive_numeric_frames():
+    """An all-numeric frame must keep integer columns as 'Ni' integer
+    fields (regression: DataFrame.iterrows() upcast ints to float in
+    numeric-only frames — a field-type conflict against a server where
+    the field already exists as integer)."""
+    from gordo_components_tpu.dataset.data_provider.influx_client import (
+        _field_value,
+    )
+
+    with InfluxDouble() as server:
+        client = MinimalInfluxClient(
+            host=server.host, port=server.port, database="db"
+        )
+        idx = pd.date_range("2023-01-01", periods=2, freq="1h", tz="UTC")
+        frame = pd.DataFrame({"value": [1.5, 2.5], "count": [1, 2]}, index=idx)
+        client.write_points(frame, "m")
+        back = client.query('SELECT * FROM "m"')["m"]
+        # the double parses 'Ni' to python int and floats to float; a
+        # float-serialized count would come back 1.0/2.0 (float dtype)
+        assert back["count"].tolist() == [1, 2]
+        assert back["count"].dtype.kind == "i"
+    assert _field_value(None) is None
+    assert _field_value(pd.NaT) is None
+
+
+def test_client_rejects_newline_injection():
+    """Identifiers with embedded newlines must fail loudly — line protocol
+    cannot escape them and a split line corrupts the whole batch."""
+    client = MinimalInfluxClient(host="localhost", port=1, database="db")
+    idx = pd.date_range("2023-01-01", periods=1, freq="1h", tz="UTC")
+    frame = pd.DataFrame({"value": [1.0]}, index=idx)
+    with pytest.raises(ValueError, match="newline"):
+        client.write_points(frame, "m", tags={"machine": "evil\nname"})
+    with pytest.raises(ValueError, match="newline"):
+        client.write_points(frame, "bad\nmeasurement")
+    status_frame = pd.DataFrame({"status": ["degraded\nsee log"]}, index=idx)
+    with pytest.raises(ValueError, match="newline"):
+        client.write_points(status_frame, "m")
+
+
+def test_client_rejects_unsupported_transport_kwargs():
+    """Transport-selecting kwargs from a real-influxdb-package config must
+    fail loudly, not silently fall back to plain HTTP."""
+    with pytest.raises(ValueError, match="use_udp"):
+        MinimalInfluxClient(host="h", use_udp=True, udp_port=4444)
+    with pytest.raises(ValueError, match="verify_ssl"):
+        MinimalInfluxClient(host="h", ssl=True, verify_ssl=False)
+    # tuning kwargs stay accepted-and-ignored for config portability
+    MinimalInfluxClient(host="h", pool_size=10, retries=3)
+
+
+def test_client_error_surface():
+    with InfluxDouble() as server:
+        client = MinimalInfluxClient(
+            host=server.host, port=server.port, database="db"
+        )
+        with pytest.raises(InfluxQueryError, match="cannot parse"):
+            client.query("DROP SERIES FROM everything")
+
+
+def test_provider_fallback_speaks_http_end_to_end():
+    """No injected client anywhere: InfluxDataProvider constructs the
+    stdlib fallback client itself (the ``influxdb`` package is absent in
+    this image) and feeds TimeSeriesDataset over a real socket."""
+    with InfluxDouble() as server:
+        seed = MinimalInfluxClient(
+            host=server.host, port=server.port, database="db"
+        )
+        _seed_sensor_data(seed, ["t1", "t2"])
+        provider = InfluxDataProvider(
+            measurement="sensor_data",
+            host=server.host,
+            port=server.port,
+            database="db",
+        )
+        assert isinstance(provider._client, MinimalInfluxClient)
+        ds = TimeSeriesDataset(
+            data_provider=provider,
+            train_start_date="2023-01-01T00:00:00+00:00",
+            train_end_date="2023-01-02T00:00:00+00:00",
+            tag_list=["t1", "t2"],
+            resolution="10min",
+        )
+        X, _ = ds.get_data()
+        assert list(X.columns) == ["t1", "t2"]
+        assert len(X) == 144
+        assert X["t2"].iloc[0] == 100.0  # per-tag offset from the seed
+        assert any(r.startswith("GET /query") for r in server.requests)
+
+
+def test_provider_dry_run_limits_the_pull():
+    with InfluxDouble() as server:
+        seed = MinimalInfluxClient(
+            host=server.host, port=server.port, database="db"
+        )
+        _seed_sensor_data(seed, ["t1"])
+        provider = InfluxDataProvider(
+            measurement="sensor_data",
+            host=server.host,
+            port=server.port,
+            database="db",
+        )
+        list(
+            provider.load_series(
+                datetime(2023, 1, 1, tzinfo=timezone.utc),
+                datetime(2023, 1, 2, tzinfo=timezone.utc),
+                [SensorTag("t1", "asset")],
+                dry_run=True,
+            )
+        )
+        queries = [r for r in server.requests if r.startswith("GET /query")]
+        assert len(queries) == 1  # availability probe only, LIMIT 1
+
+
+def test_forwarder_fallback_round_trip():
+    """forward() → line protocol on the wire → InfluxQL read-back: the
+    anomaly-score sink loop with no fake client."""
+    with InfluxDouble() as server:
+        forwarder = ForwardPredictionsIntoInflux(
+            measurement="anomaly",
+            host=server.host,
+            port=server.port,
+            database="db",
+        )
+        assert isinstance(forwarder._client, MinimalInfluxClient)
+        idx = pd.date_range("2023-06-01", periods=4, freq="10min", tz="UTC")
+        scores = pd.DataFrame(
+            {
+                "total-anomaly": [0.1, 0.9, 0.2, 4.5],
+                "threshold": [1.0] * 4,
+            },
+            index=idx,
+        )
+        forwarder.forward("machine-a", scores)
+        forwarder.forward("machine-b", scores * 2)
+        reader = MinimalInfluxClient(
+            host=server.host, port=server.port, database="db"
+        )
+        back = reader.query(
+            "SELECT \"total-anomaly\" FROM \"anomaly\" WHERE machine = 'machine-a'"
+        )["anomaly"]
+        np.testing.assert_allclose(back["total-anomaly"], [0.1, 0.9, 0.2, 4.5])
+        assert (back.index == idx).all()
+        both = reader.query('SELECT * FROM "anomaly"')["anomaly"]
+        assert len(both) == 8
+
+
+def test_provider_to_forwarder_loop():
+    """The full SURVEY §5 loop on one server: sensor data in, provider
+    reads it, scores forwarded back into a second measurement, read back."""
+    with InfluxDouble() as server:
+        seed = MinimalInfluxClient(
+            host=server.host, port=server.port, database="db"
+        )
+        _seed_sensor_data(seed, ["t1"])
+        provider = InfluxDataProvider(
+            measurement="sensor_data",
+            host=server.host,
+            port=server.port,
+            database="db",
+        )
+        (series,) = list(
+            provider.load_series(
+                datetime(2023, 1, 1, tzinfo=timezone.utc),
+                datetime(2023, 1, 2, tzinfo=timezone.utc),
+                [SensorTag("t1", "asset")],
+            )
+        )
+        scores = pd.DataFrame(
+            {"total-anomaly": (series - series.mean()).abs()}
+        )
+        ForwardPredictionsIntoInflux(
+            measurement="anomaly",
+            host=server.host,
+            port=server.port,
+            database="db",
+        ).forward("m1", scores)
+        back = seed.query(
+            "SELECT \"total-anomaly\" FROM \"anomaly\" WHERE machine = 'm1'"
+        )["anomaly"]
+        assert len(back) == len(series)
