@@ -4,10 +4,11 @@ DataLakeProvider.
 Reference parity [UNVERIFIED, path-level — the reference mount is empty]:
 ``gordo_components/dataset/data_provider/ncs_reader.py``, ``iroc_reader.py``,
 ``azure_utils.py``. The reference reads Equinor's two data-lake layouts from
-Azure Data Lake Store; here the "lake" is any mounted filesystem path (the
-Azure SDK and network do not exist in this environment — auth kwargs are
-accepted for config parity and rejected with a clear error if they would be
-required).
+Azure Data Lake Store; here the "lake" is either a mounted filesystem path
+(``base_dir``) or ADL reached through ``azure_utils.create_adl_filesystem``
+(``storename`` + credentials) — the auth/dispatch plumbing is real and
+test-injectable, and only the SDK import inside the default client factory
+refuses in this offline image.
 
 Layouts (reconstructed from SURVEY.md §3's component inventory):
 
@@ -45,6 +46,11 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 import pandas as pd
 
 from ..sensor_tag import SensorTag
+from .azure_utils import (
+    LocalFileSystem,
+    create_adl_filesystem,
+    resolve_adl_credentials,
+)
 from .base import GordoBaseDataProvider
 
 logger = logging.getLogger(__name__)
@@ -73,19 +79,40 @@ def _normalize_frame(frame: pd.DataFrame, origin: str) -> pd.Series:
 
 
 class NcsReader(GordoBaseDataProvider):
-    """Yearly per-tag files under per-asset directories (NCS layout)."""
+    """Yearly per-tag files under per-asset directories (NCS layout).
 
-    def __init__(self, base_dir: str, assets: Optional[List[str]] = None):
+    ``fs``: a :class:`~.azure_utils.LocalFileSystem`-shaped backend —
+    local by default; DataLakeProvider passes an ADL filesystem when the
+    lake is reached over Azure instead of a mount."""
+
+    def __init__(
+        self, base_dir: str, assets: Optional[List[str]] = None, fs=None
+    ):
         self._init_kwargs = {"base_dir": base_dir, "assets": assets}
         self.base_dir = base_dir
         self.assets = assets
+        self._fs = fs or LocalFileSystem()
+        # POSITIVE resolutions only, bounded: can_handle_tag (dispatch) and
+        # load_series both resolve the tag dir — over a remote filesystem
+        # that is stat round trips, not free os calls. Misses stay
+        # uncached so late-arriving tags are still found.
+        self._dir_cache: Dict[Tuple[Optional[str], str], str] = {}
 
     def _tag_dir(self, tag: SensorTag) -> Optional[str]:
+        key = (tag.asset, tag.name)
+        cached = self._dir_cache.get(key)
+        if cached is not None:
+            return cached
         roots = []
         if tag.asset:
             roots.append(os.path.join(self.base_dir, tag.asset, tag.name))
         roots.append(os.path.join(self.base_dir, tag.name))
-        return next((root for root in roots if os.path.isdir(root)), None)
+        found = next((root for root in roots if self._fs.isdir(root)), None)
+        if found is not None:
+            while len(self._dir_cache) >= 4096:
+                self._dir_cache.pop(next(iter(self._dir_cache)))
+            self._dir_cache[key] = found
+        return found
 
     def can_handle_tag(self, tag: SensorTag) -> bool:
         if self.assets and tag.asset not in self.assets:
@@ -96,12 +123,16 @@ class NcsReader(GordoBaseDataProvider):
         stem = os.path.join(tag_dir, f"{tag.name}_{year}")
         for ext in (".parquet", ".csv"):
             path = stem + ext
-            if not os.path.exists(path):
+            try:  # open directly — an exists() probe first would double
+                # the round trips on a remote filesystem
+                handle = self._fs.open(path, "rb")
+            except FileNotFoundError:
                 continue
-            if ext == ".parquet":
-                frame = pd.read_parquet(path)
-            else:
-                frame = pd.read_csv(path)
+            with handle:
+                if ext == ".parquet":
+                    frame = pd.read_parquet(handle)
+                else:
+                    frame = pd.read_csv(handle)
             return _normalize_frame(frame, path)
         return None
 
@@ -158,10 +189,15 @@ class IrocReader(GordoBaseDataProvider):
         "avg": "value",
     }
 
-    def __init__(self, base_dir: str, assets: Optional[List[str]] = None):
+    def __init__(
+        self, base_dir: str, assets: Optional[List[str]] = None, fs=None
+    ):
         self._init_kwargs = {"base_dir": base_dir, "assets": assets}
         self.base_dir = base_dir
         self.assets = assets
+        self._fs = fs or LocalFileSystem()
+        # positive asset-dir resolutions (see NcsReader._dir_cache)
+        self._dir_cache: Dict[str, str] = {}
         self._cache: Dict[Tuple[str, float], pd.DataFrame] = {}
         # concatenated per-asset frame, keyed by the (path, mtime) tuple of
         # its inputs — per-tag dispatch must not redo the concat per tag
@@ -170,16 +206,24 @@ class IrocReader(GordoBaseDataProvider):
     def _asset_dir(self, tag: SensorTag) -> Optional[str]:
         if not tag.asset:
             return None
+        cached = self._dir_cache.get(tag.asset)
+        if cached is not None:
+            return cached
         path = os.path.join(self.base_dir, tag.asset)
-        return path if os.path.isdir(path) else None
+        if not self._fs.isdir(path):
+            return None
+        while len(self._dir_cache) >= 1024:
+            self._dir_cache.pop(next(iter(self._dir_cache)))
+        self._dir_cache[tag.asset] = path
+        return path
 
     def _asset_frame(self, asset_dir: str) -> pd.DataFrame:
         paths = [
             os.path.join(asset_dir, entry)
-            for entry in sorted(os.listdir(asset_dir))
+            for entry in self._fs.listdir(asset_dir)
             if entry.lower().endswith(".csv")
         ]
-        asset_key = tuple((p, os.path.getmtime(p)) for p in paths)
+        asset_key = tuple((p, self._fs.mtime(p)) for p in paths)
         cached_asset = self._asset_cache.get(asset_key)
         if cached_asset is not None:
             return cached_asset
@@ -188,7 +232,8 @@ class IrocReader(GordoBaseDataProvider):
             key = (path, mtime)
             cached = self._cache.get(key)
             if cached is None:
-                frame = pd.read_csv(path)
+                with self._fs.open(path, "rb") as handle:
+                    frame = pd.read_csv(handle)
                 frame.columns = [
                     self._COLUMN_ALIASES.get(str(c).lower(), str(c).lower())
                     for c in frame.columns
@@ -261,11 +306,24 @@ class DataLakeProvider(GordoBaseDataProvider):
     reader that claims it (NCS's per-tag directory layout first, then
     IROC's concatenated CSVs).
 
-    ``base_dir`` points at the mounted lake. The reference's Azure auth
-    kwargs (``interactive``, ``storename``, ``dl_service_auth_str``) are
-    accepted so fleet configs port verbatim, but actual Azure access needs
-    the SDK + network this environment lacks — requesting it without a
-    ``base_dir`` raises immediately instead of failing deep in a build.
+    Two transports (VERDICT r3 #6):
+
+    - ``base_dir`` set → the mounted lake, read with local ``os``
+      semantics (unchanged fast path);
+    - ``base_dir`` None + ``storename`` set → Azure Data Lake via
+      :func:`~.azure_utils.create_adl_filesystem`: credentials resolve
+      from ``dl_service_auth_str`` / the ``DL_SERVICE_AUTH_STR`` env var /
+      ``interactive``, and the readers run against the ADL filesystem
+      adapter. Credential *validation* is eager (a malformed config fails
+      at construction, offline); the SDK-touching client build is LAZY —
+      first ``can_handle_tag``/``load_series`` call — so eagerly
+      constructing providers for every config at server startup is safe,
+      and the whole path is injectable (``client_factory`` for tests).
+      Only the default factory's SDK import refuses in this offline
+      image, at that first actual lake touch.
+
+    ``adl_root``: lake-side path prefix the asset directories live under
+    (Azure transport only; defaults to the lake root).
     """
 
     def __init__(
@@ -275,32 +333,63 @@ class DataLakeProvider(GordoBaseDataProvider):
         storename: Optional[str] = None,
         dl_service_auth_str: Optional[str] = None,
         assets: Optional[List[str]] = None,
+        adl_root: str = "",
+        client_factory: Optional[Any] = None,
         **kwargs: Any,
     ):
+        # NOTE: dl_service_auth_str (a secret) and client_factory (an
+        # object) are deliberately NOT serialized — to_dict() output lands
+        # in served build metadata, mirroring InfluxDataProvider's rule
         self._init_kwargs = {
             "base_dir": base_dir,
             "interactive": interactive,
             "storename": storename,
             "assets": assets,
+            **({"adl_root": adl_root} if adl_root else {}),
             **kwargs,
         }
-        if base_dir is None:
+        if base_dir is None and storename is None:
             raise ValueError(
-                "DataLakeProvider: Azure Data Lake access (interactive/"
-                "service-principal auth) requires the azure SDK and network "
-                "access, neither of which exists in this environment. Mount "
-                "the lake and pass base_dir=<mount point> instead."
+                "DataLakeProvider needs a transport: base_dir=<mounted "
+                "lake path>, or storename=<ADL store> with credentials "
+                "(dl_service_auth_str / DL_SERVICE_AUTH_STR / interactive)"
             )
-        self.base_dir = base_dir
         self.interactive = interactive
         self.storename = storename
-        self._readers: List[GordoBaseDataProvider] = [
-            NcsReader(base_dir, assets=assets),
-            IrocReader(base_dir, assets=assets),
-        ]
+        self._assets = assets
+        self._readers: Optional[List[GordoBaseDataProvider]] = None
+        if base_dir is not None:
+            self.base_dir = base_dir
+            self._make_fs = None  # readers default to the local filesystem
+        else:
+            self.base_dir = adl_root
+            # validate credentials NOW (offline, fails at config time)...
+            resolve_adl_credentials(dl_service_auth_str, interactive)
+
+            # ...but defer the SDK/network-touching client build to first
+            # use, so constructing providers eagerly (server startup over
+            # many configs) cannot fail on transport
+            def _make_fs():
+                return create_adl_filesystem(
+                    storename,
+                    dl_service_auth_str=dl_service_auth_str,
+                    interactive=interactive,
+                    client_factory=client_factory,
+                )
+
+            self._make_fs = _make_fs
+
+    def _get_readers(self) -> List[GordoBaseDataProvider]:
+        if self._readers is None:
+            fs = self._make_fs() if self._make_fs is not None else None
+            self._readers = [
+                NcsReader(self.base_dir, assets=self._assets, fs=fs),
+                IrocReader(self.base_dir, assets=self._assets, fs=fs),
+            ]
+        return self._readers
 
     def _reader_for(self, tag: SensorTag) -> GordoBaseDataProvider:
-        for reader in self._readers:
+        for reader in self._get_readers():
             if reader.can_handle_tag(tag):
                 return reader
         raise FileNotFoundError(
@@ -309,7 +398,7 @@ class DataLakeProvider(GordoBaseDataProvider):
         )
 
     def can_handle_tag(self, tag: SensorTag) -> bool:
-        return any(reader.can_handle_tag(tag) for reader in self._readers)
+        return any(r.can_handle_tag(tag) for r in self._get_readers())
 
     def load_series(
         self,
