@@ -41,12 +41,22 @@ class ServicePrincipal(NamedTuple):
 
 def parse_dl_service_auth_str(auth_str: str) -> ServicePrincipal:
     """``"<tenant>:<client_id>:<client_secret>"`` → parts, validating shape
-    early so a malformed secret fails at config time, not inside the SDK."""
-    parts = auth_str.split(":")
-    if len(parts) != 3 or not all(p.strip() for p in parts):
+    early so a malformed credential fails at config time, not inside the
+    SDK. Splits at most twice: a client SECRET may itself contain ':'."""
+    parts = auth_str.split(":", 2)
+    if len(parts) != 3:
         raise ValueError(
             "dl_service_auth_str must be '<tenant>:<client_id>:"
             f"<client_secret>' (got {len(parts)} ':'-separated parts)"
+        )
+    if not all(p.strip() for p in parts):
+        blank = [
+            name
+            for name, part in zip(("tenant", "client_id", "client_secret"), parts)
+            if not part.strip()
+        ]
+        raise ValueError(
+            f"dl_service_auth_str has blank component(s): {blank}"
         )
     return ServicePrincipal(*(p.strip() for p in parts))
 
@@ -81,7 +91,10 @@ class ADLFileSystem:
     def isdir(self, path: str) -> bool:
         try:
             info = self._client.info(path)
-        except (FileNotFoundError, OSError):
+        except FileNotFoundError:
+            # ONLY not-found maps to False — a PermissionError (ACL denial)
+            # must surface as itself, or the operator debugs lake layout
+            # instead of the actual auth problem
             return False
         return str(info.get("type", "")).upper() == "DIRECTORY"
 

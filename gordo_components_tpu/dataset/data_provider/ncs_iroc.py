@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from datetime import datetime
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -49,7 +50,7 @@ from ..sensor_tag import SensorTag
 from .azure_utils import (
     LocalFileSystem,
     create_adl_filesystem,
-    resolve_adl_credentials,
+    parse_dl_service_auth_str,
 )
 from .base import GordoBaseDataProvider
 
@@ -273,6 +274,10 @@ class IrocReader(GordoBaseDataProvider):
         dry_run: bool = False,
     ) -> Iterable[pd.Series]:
         start, end = _to_utc(train_start_date), _to_utc(train_end_date)
+        # one freshness probe (listdir + per-file mtime) per asset per CALL,
+        # not per tag — on a remote filesystem _asset_frame's cache-key
+        # computation is network round trips, and many tags share an asset
+        call_frames: Dict[str, pd.DataFrame] = {}
         for tag in tag_list:
             asset_dir = self._asset_dir(tag)
             if asset_dir is None:
@@ -282,7 +287,10 @@ class IrocReader(GordoBaseDataProvider):
                 )
             if dry_run:
                 continue
-            frame = self._asset_frame(asset_dir)
+            frame = call_frames.get(asset_dir)
+            if frame is None:
+                frame = self._asset_frame(asset_dir)
+                call_frames[asset_dir] = frame
             rows = frame[
                 (frame["tag"] == tag.name)
                 & (frame["timestamp"] >= start)
@@ -314,13 +322,17 @@ class DataLakeProvider(GordoBaseDataProvider):
       :func:`~.azure_utils.create_adl_filesystem`: credentials resolve
       from ``dl_service_auth_str`` / the ``DL_SERVICE_AUTH_STR`` env var /
       ``interactive``, and the readers run against the ADL filesystem
-      adapter. Credential *validation* is eager (a malformed config fails
-      at construction, offline); the SDK-touching client build is LAZY —
-      first ``can_handle_tag``/``load_series`` call — so eagerly
-      constructing providers for every config at server startup is safe,
-      and the whole path is injectable (``client_factory`` for tests).
-      Only the default factory's SDK import refuses in this offline
-      image, at that first actual lake touch.
+      adapter. A *provided* credential is validated eagerly (a malformed
+      config fails at construction, offline); an *absent* one is not an
+      error until first use — ``to_dict()`` drops the secret, so
+      ``from_dict()`` reconstruction must construct cleanly and resolve
+      ``DL_SERVICE_AUTH_STR`` on the host that actually touches the lake.
+      The SDK-touching client build is LAZY (first ``can_handle_tag``/
+      ``load_series`` call, under a lock) so eagerly constructing
+      providers for every config at server startup is safe, and the whole
+      path is injectable (``client_factory`` for tests). Only the default
+      factory's SDK import refuses in this offline image, at that first
+      actual lake touch.
 
     ``adl_root``: lake-side path prefix the asset directories live under
     (Azure transport only; defaults to the lake root).
@@ -358,15 +370,22 @@ class DataLakeProvider(GordoBaseDataProvider):
         self.storename = storename
         self._assets = assets
         self._readers: Optional[List[GordoBaseDataProvider]] = None
+        self._readers_lock = threading.Lock()
         if base_dir is not None:
             self.base_dir = base_dir
             self._make_fs = None  # readers default to the local filesystem
         else:
             self.base_dir = adl_root
-            # validate credentials NOW (offline, fails at config time)...
-            resolve_adl_credentials(dl_service_auth_str, interactive)
+            if dl_service_auth_str is not None:
+                # a PROVIDED credential is validated now (malformed configs
+                # fail at config time) — but an ABSENT one is not an error
+                # yet: to_dict() deliberately drops the secret, so
+                # from_dict() reconstruction (CompositeDataProvider, fleet
+                # YAML round trips) must construct and resolve the env var
+                # on the host that actually touches the lake
+                parse_dl_service_auth_str(dl_service_auth_str)
 
-            # ...but defer the SDK/network-touching client build to first
+            # the SDK/network-touching client build is deferred to first
             # use, so constructing providers eagerly (server startup over
             # many configs) cannot fail on transport
             def _make_fs():
@@ -380,13 +399,15 @@ class DataLakeProvider(GordoBaseDataProvider):
             self._make_fs = _make_fs
 
     def _get_readers(self) -> List[GordoBaseDataProvider]:
-        if self._readers is None:
-            fs = self._make_fs() if self._make_fs is not None else None
-            self._readers = [
-                NcsReader(self.base_dir, assets=self._assets, fs=fs),
-                IrocReader(self.base_dir, assets=self._assets, fs=fs),
-            ]
-        return self._readers
+        with self._readers_lock:  # one auth token / one warm reader cache
+            # even when concurrent requests race the first lake touch
+            if self._readers is None:
+                fs = self._make_fs() if self._make_fs is not None else None
+                self._readers = [
+                    NcsReader(self.base_dir, assets=self._assets, fs=fs),
+                    IrocReader(self.base_dir, assets=self._assets, fs=fs),
+                ]
+            return self._readers
 
     def _reader_for(self, tag: SensorTag) -> GordoBaseDataProvider:
         for reader in self._get_readers():
@@ -407,10 +428,23 @@ class DataLakeProvider(GordoBaseDataProvider):
         tag_list: List[SensorTag],
         dry_run: bool = False,
     ) -> Iterable[pd.Series]:
-        # per-tag dispatch preserves the caller's tag order (the dataset
-        # joins series positionally against tag_list)
+        # contiguous same-reader runs batch into ONE reader call while
+        # preserving the caller's tag order (the dataset joins series
+        # positionally against tag_list) — per-tag [tag] calls would defeat
+        # the readers' per-call memoization (IrocReader probes each asset's
+        # files once per load_series call, round trips on a remote lake)
+        run: List[SensorTag] = []
+        run_reader: Optional[GordoBaseDataProvider] = None
         for tag in tag_list:
             reader = self._reader_for(tag)
-            yield from reader.load_series(
-                train_start_date, train_end_date, [tag], dry_run=dry_run
+            if reader is not run_reader and run:
+                yield from run_reader.load_series(
+                    train_start_date, train_end_date, run, dry_run=dry_run
+                )
+                run = []
+            run_reader = reader
+            run.append(tag)
+        if run:
+            yield from run_reader.load_series(
+                train_start_date, train_end_date, run, dry_run=dry_run
             )

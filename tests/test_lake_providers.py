@@ -271,12 +271,21 @@ def test_azure_auth_validation_and_refusal_points(lake, monkeypatch):
 
     # an ambient credential on the host would change every branch below
     monkeypatch.delenv(ENV_AUTH_VAR, raising=False)
-    # malformed auth strings fail at config time with shape details
+    # malformed PROVIDED auth strings fail at config time with details;
+    # a ':' inside the client secret is legal (split at most twice)
     with pytest.raises(ValueError, match="':'-separated"):
         parse_dl_service_auth_str("tenant-only")
-    # no credentials and not interactive: clear ValueError, still offline
+    with pytest.raises(ValueError, match="blank"):
+        parse_dl_service_auth_str("tenant::secret")
+    assert parse_dl_service_auth_str("t:c:se:cr:et").client_secret == "se:cr:et"
+    with pytest.raises(ValueError, match="':'-separated"):
+        DataLakeProvider(storename="s", dl_service_auth_str="oops")
+    # ABSENT credentials are not a construction error (to_dict drops the
+    # secret; from_dict reconstruction must work) — the clear ValueError
+    # comes at first lake touch, still offline
+    provider = DataLakeProvider(storename="prodlake")
     with pytest.raises(ValueError, match="credentials"):
-        DataLakeProvider(storename="prodlake")
+        provider.can_handle_tag(SensorTag("tag-n1", "asset-ncs"))
     # valid config constructs fine offline (eager construction over many
     # configs at server startup must not touch the SDK)...
     provider = DataLakeProvider(storename="prodlake", interactive=True)
@@ -297,6 +306,12 @@ def test_azure_secrets_never_serialized(lake):
     assert "dl_service_auth_str" not in str(serialized)
     assert "client_factory" not in str(serialized)
     assert serialized["storename"] == "prodlake"
+    # secret-less reconstruction (CompositeDataProvider / fleet-YAML round
+    # trips) must CONSTRUCT; the credential demand comes at first use, on
+    # the host that holds DL_SERVICE_AUTH_STR
+    rebuilt = GordoBaseDataProvider.from_dict(serialized)
+    assert isinstance(rebuilt, DataLakeProvider)
+    assert rebuilt.storename == "prodlake"
 
 
 def test_data_lake_provider_round_trips_through_config(lake):
