@@ -286,6 +286,64 @@ def test_two_process_kill_mid_build_restores_from_checkpoint(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_heterogeneous_buckets(tmp_path):
+    """VERDICT r3 weak #5 extension: a HETEROGENEOUS fleet (three buckets —
+    two tag widths plus a per-machine n_splits override, none a multiple
+    of the 8-device global mesh) through one multi-host build_fleet call.
+    Every bucket must shard across both processes disjointly, pad under
+    multi-host, and union to the whole fleet."""
+    import re
+
+    def run_once(out_dir):
+        return _run_two_process_children(
+            ["--build-hetero", out_dir], timeout=300
+        )
+
+    out_dir = str(tmp_path / "mhhetero")
+    codes, outputs = run_once(out_dir)
+    if any(c != 0 for c in codes):  # possible port race — one retry
+        out_dir = str(tmp_path / "mhhetero-retry")
+        codes, outputs = run_once(out_dir)
+    assert all(c == 0 for c in codes), "children failed:\n" + "\n".join(outputs)
+
+    per_proc = {}
+    for out in outputs:
+        m = re.search(r"built@(\d+): (\S+)", out)
+        assert m, out
+        per_proc[int(m.group(1))] = set(m.group(2).split(","))
+    all_names = (
+        {f"hn-{i:02d}" for i in range(10)}
+        | {f"hw-{i:02d}" for i in range(6)}
+        | {f"hz-{i:02d}" for i in range(4)}
+    )
+    assert set.union(*per_proc.values()) == all_names
+    assert per_proc[0] & per_proc[1] == set()
+    # buckets larger than one process's device share (4 of the global 8)
+    # must genuinely span both processes; the 4-machine hz bucket
+    # legitimately collapses onto process 0 (positional machine shards +
+    # mesh padding), which is itself worth pinning
+    for prefix in ("hn", "hw"):
+        for names in per_proc.values():
+            assert any(n.startswith(prefix) for n in names), (
+                f"bucket {prefix} missing from a process: {per_proc}"
+            )
+
+    import json as _json
+
+    for name in all_names:
+        meta = _json.load(
+            open(os.path.join(out_dir, "models", name, "metadata.json"))
+        )
+        expected_splits = 0 if name.startswith("hz") else 2
+        assert (
+            meta["model"]["model_builder_metadata"]["cross_validation"][
+                "n_splits"
+            ]
+            == expected_splits
+        ), name
+
+
+@pytest.mark.slow
 def test_two_process_checkpoint_roundtrip(tmp_path):
     """Collective orbax slice checkpoints: two processes save a sharded
     tree, restore through the sharded template (each process its own
