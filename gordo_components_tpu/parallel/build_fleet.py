@@ -436,12 +436,15 @@ class FleetMachineConfig:
 
 def _effective_splits(
     machine: "FleetMachineConfig", default: int
-) -> Tuple[int, List[str]]:
-    """Resolve the machine's CV depth: ``evaluation.n_splits`` beats the
-    builder default (``None``/absent means "use the default"). Returns the
-    keys the fleet builder does NOT honor (e.g. ``cv_mode`` — always
-    ``"fleet"`` here) so the caller can surface them instead of silently
-    dropping config."""
+) -> Tuple[int, Optional[bool], List[str]]:
+    """Resolve the machine's CV depth and fold-execution mode:
+    ``evaluation.n_splits`` beats the builder default (``None``/absent means
+    "use the default"); ``evaluation.cv_parallel`` (bool, optional) pins the
+    fold-execution strategy (:class:`..fleet.FleetSpec.cv_parallel` —
+    vmapped vs scanned fold fits; ``None`` lets :func:`_spec_for` derive it
+    from the model's memory profile). Returns the keys the fleet builder
+    does NOT honor (e.g. ``cv_mode`` — always ``"fleet"`` here) so the
+    caller can surface them instead of silently dropping config."""
     evaluation = machine.evaluation or {}
     value = evaluation.get("n_splits")
     if value is None:
@@ -458,8 +461,15 @@ def _effective_splits(
                 f"got {value}"
             )
         eff = value
-    ignored = sorted(k for k in evaluation if k != "n_splits")
-    return eff, ignored
+    cv_parallel = evaluation.get("cv_parallel")
+    if cv_parallel is not None and not isinstance(cv_parallel, bool):
+        raise ValueError(
+            f"Machine {machine.name!r}: evaluation.cv_parallel must be a "
+            f"boolean, got {cv_parallel!r}"
+        )
+    honored = {"n_splits", "cv_parallel"}
+    ignored = sorted(k for k in evaluation if k not in honored)
+    return eff, cv_parallel, ignored
 
 
 def _scaler_kind(
@@ -486,6 +496,7 @@ def _spec_for(
     n_features: int,
     n_targets: int,
     n_splits: int,
+    cv_parallel: Optional[bool] = None,
 ) -> FleetSpec:
     est = analyzed.estimator
     if getattr(est, "joint_horizon", False):
@@ -508,6 +519,13 @@ def _spec_for(
             "single-machine builder for this config"
         )
     dropout = float(model_spec.config.get("dropout", 0.0) or 0.0)
+    if cv_parallel is None:
+        # derive the fold-execution mode from the model's memory profile: a
+        # config that asked for remat is trading FLOPs for memory already —
+        # multiplying step activations by (K+1) would undo that, so such
+        # buckets keep the sequential scan; everything else takes the
+        # (K+1)× sequential-depth win (FleetSpec.cv_parallel)
+        cv_parallel = not bool(model_spec.config.get("remat", False))
     return FleetSpec(
         module=model_spec.module,
         optimizer=model_spec.optimizer,
@@ -525,6 +543,7 @@ def _spec_for(
         target_scaler=t_kind,
         target_feature_range=t_range,
         target_scaler_options=t_options,
+        cv_parallel=cv_parallel,
     )
 
 
@@ -660,12 +679,20 @@ def build_fleet(
     timer = PhaseTimer()
     started = time.perf_counter()
     results: Dict[str, str] = {}
-    pending: List[Tuple[FleetMachineConfig, str, int]] = []
+    pending: List[Tuple[FleetMachineConfig, str, int, Optional[bool]]] = []
     ignored_eval: Dict[str, List[str]] = {}
     for machine in machines:
-        eff_splits, ignored = _effective_splits(machine, n_splits)
+        eff_splits, eff_cv_parallel, ignored = _effective_splits(
+            machine, n_splits
+        )
         if ignored:
             ignored_eval[machine.name] = ignored
+        # cv_parallel is deliberately NOT part of the cache key: it is an
+        # execution strategy (vmapped vs scanned fold fits), numerically
+        # equivalent by tests/test_fleet.py::test_cv_parallel_matches_scan —
+        # flipping it must resume from existing artifacts, not retrain. The
+        # mode that actually trained an artifact is recorded in its fleet
+        # metadata block for provenance.
         evaluation_config = {"n_splits": eff_splits, "cv_mode": "fleet"}
         cache_key = calculate_model_key(
             machine.name,
@@ -679,7 +706,7 @@ def build_fleet(
                 logger.info("Fleet cache hit for %r -> %s", machine.name, cached)
                 results[machine.name] = cached
                 continue
-        pending.append((machine, cache_key, eff_splits))
+        pending.append((machine, cache_key, eff_splits, eff_cv_parallel))
     if ignored_eval:
         sample = dict(list(ignored_eval.items())[:5])
         logger.warning(
@@ -702,7 +729,7 @@ def build_fleet(
     # widths come from the dataset's declared columns, so peak host memory
     # is one bucket's data, not the whole fleet's ---------------------------
     buckets: Dict[str, List[dict]] = {}
-    for machine, cache_key, eff_splits in pending:
+    for machine, cache_key, eff_splits, eff_cv_parallel in pending:
         dataset = _dataset_from_config(machine.data_config)
         item: dict = {
             "machine": machine,
@@ -721,12 +748,17 @@ def build_fleet(
             item["dataset_metadata"] = dataset.get_metadata()
         item["F"], item["T"] = n_features, n_targets
         item["n_splits"] = eff_splits
+        item["cv_parallel"] = eff_cv_parallel
         sig = json.dumps(
             {
                 "model_config": machine.model_config,
                 "F": n_features,
                 "T": n_targets,
                 "n_splits": item["n_splits"],
+                # an explicit fold-execution override is a different compiled
+                # program — its machines bucket separately (None derives from
+                # the model config, which is already in the signature)
+                "cv_parallel": eff_cv_parallel,
             },
             sort_keys=True,
             default=str,
@@ -747,7 +779,13 @@ def build_fleet(
             n_features = items[0]["F"]
             n_targets = items[0]["T"]
             bucket_splits = items[0]["n_splits"]
-            spec = _spec_for(analyzed, n_features, n_targets, bucket_splits)
+            spec = _spec_for(
+                analyzed,
+                n_features,
+                n_targets,
+                bucket_splits,
+                cv_parallel=items[0]["cv_parallel"],
+            )
 
             # ---- slice the bucket: each slice is an independent failure domain
             # with its own data fetch, train call, and artifact writes. All
@@ -901,6 +939,10 @@ def build_fleet(
                                     "slice": s,
                                     "slice_size": len(slice_items),
                                     "slice_duration_s": slice_duration,
+                                    # fold-execution mode that trained this
+                                    # artifact (provenance; not in the cache
+                                    # key — see evaluation_config above)
+                                    "cv_parallel": bool(spec.cv_parallel),
                                 },
                             },
                             "dataset": item["dataset_metadata"],

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.train import make_fit_fn, make_predict_fn
+from ..models.train import FitResult, make_fit_fn, make_predict_fn
 from ..ops import windowing
 from ..ops.scaling import ScalerParams
 from ..utils.cache import cached as _cached  # shared FIFO program memo
@@ -71,6 +71,16 @@ class FleetSpec(NamedTuple):
     target_scaler: str = "minmax"
     target_feature_range: Tuple[float, float] = (0.0, 1.0)
     target_scaler_options: Tuple[bool, bool] = (True, True)
+    # True: the K CV-fold fits and the final fit — independent programs with
+    # identical shapes — run as ONE vmapped batched fit instead of a
+    # sequential lax.scan, cutting the program's sequential depth by (K+1)×
+    # at the price of (K+1)× the training-step activation memory. The right
+    # default on a TPU whose per-machine models are tiny (the fleet design
+    # point); builders flip it off for memory-constrained configs (remat
+    # models at plant scale). Numerically equivalent to the scan path up to
+    # XLA reduction-order float noise — parity pinned by
+    # tests/test_fleet.py::test_cv_parallel_matches_scan.
+    cv_parallel: bool = True
 
 
 class MachineBatch(NamedTuple):
@@ -338,57 +348,97 @@ def make_machine_program(
         n_points = raw_targets.shape[0]
         fold_masks = timeseries_fold_masks(wt, spec.n_splits)
         if spec.n_splits > 0:
-            # ONE fold fit in the compiled graph, scanned over the stacked
-            # masks (folds share every shape) — an unrolled Python loop
-            # would inline n_splits copies of the whole training program
-            # and multiply XLA compile time accordingly
             train_masks = jnp.stack([m[0] for m in fold_masks])
             test_masks = jnp.stack([m[1] for m in fold_masks])
-
-            def fold_step(carry, xs):
-                emin, emax = carry
-                train_mask, test_mask, fold_key = xs
-                res = fit_local(
-                    params0, inputs, targets, wt * train_mask, fold_key
-                )
-                pred = predict_all(res.params)
-                pred_raw = (pred - sy.offset) / sy.scale
-                err = jnp.abs(raw_targets - pred_raw)
-                # rank-space folds guarantee a nonempty train region
-                # whenever a test region is nonempty; machines too short
-                # for any fold (n_real < n_splits+1) get empty test masks
-                # here and fall back to final-model residuals below
-                wtest = wt * test_mask
-                mask = (wtest > 0)[:, None]
-                emin = jnp.minimum(
-                    emin, jnp.min(jnp.where(mask, err, jnp.inf), axis=0)
-                )
-                emax = jnp.maximum(
-                    emax, jnp.max(jnp.where(mask, err, -jnp.inf), axis=0)
-                )
-                scores = _masked_metrics(raw_targets, pred_raw, wtest)
-                return (emin, emax), (scores, err, wtest)
-
-            (emin, emax), (cv_scores, fold_errors, fold_test_masks) = (
-                jax.lax.scan(
-                    fold_step,
-                    (emin, emax),
-                    (train_masks, test_masks, fold_keys),
-                )
+        if spec.n_splits > 0 and spec.cv_parallel:
+            # parallel CV: the K fold fits and the final fit are independent
+            # programs with identical shapes, so ONE vmapped fit of K+1
+            # weight vectors replaces K+1 sequential fits — sequential depth
+            # drops to a single fit's epochs×batches at (K+1)× step memory
+            # (see FleetSpec.cv_parallel). Per-fit keys match the scan path
+            # exactly, so both modes train identical models.
+            all_w = jnp.concatenate([train_masks * wt[None, :], wt[None, :]])
+            all_keys = jnp.concatenate([fold_keys, fit_key[None]])
+            fits = jax.vmap(
+                lambda wv, kv: fit_local(params0, inputs, targets, wv, kv)
+            )(all_w, all_keys)
+            preds = jax.vmap(predict_all)(fits.params)  # (K+1, P, T)
+            preds_raw = (preds - sy.offset) / sy.scale
+            errs_all = jnp.abs(raw_targets[None] - preds_raw)
+            fold_errors, err_final = errs_all[:-1], errs_all[-1]
+            # rank-space folds guarantee a nonempty train region whenever a
+            # test region is nonempty; machines too short for any fold
+            # (n_real < n_splits+1) get empty test masks here and fall back
+            # to final-model residuals below
+            fold_test_masks = test_masks * wt[None, :]
+            fmask = (fold_test_masks > 0)[:, :, None]
+            emin = jnp.min(
+                jnp.where(fmask, fold_errors, jnp.inf), axis=(0, 1)
+            )
+            emax = jnp.max(
+                jnp.where(fmask, fold_errors, -jnp.inf), axis=(0, 1)
+            )
+            cv_scores = jax.vmap(_masked_metrics, in_axes=(None, 0, 0))(
+                raw_targets, preds_raw[:-1], fold_test_masks
+            )
+            final = FitResult(
+                params=jax.tree_util.tree_map(lambda a: a[-1], fits.params),
+                loss_history=fits.loss_history[-1],
             )
         else:
-            cv_scores = jnp.zeros((0, len(FLEET_CV_METRICS)))
-            fold_errors = jnp.zeros((0, n_points, n_targets))
-            fold_test_masks = jnp.zeros((0, n_points))
+            if spec.n_splits > 0:
+                # sequential CV: ONE fold fit in the compiled graph, scanned
+                # over the stacked masks (folds share every shape) — an
+                # unrolled Python loop would inline n_splits copies of the
+                # whole training program and multiply XLA compile time
+                # accordingly; vs cv_parallel this holds step memory at 1×,
+                # the right trade for plant-scale remat configs
 
-        final = fit_local(params0, inputs, targets, wt, fit_key)
+                def fold_step(carry, xs):
+                    emin, emax = carry
+                    train_mask, test_mask, fold_key = xs
+                    res = fit_local(
+                        params0, inputs, targets, wt * train_mask, fold_key
+                    )
+                    pred = predict_all(res.params)
+                    pred_raw = (pred - sy.offset) / sy.scale
+                    err = jnp.abs(raw_targets - pred_raw)
+                    # rank-space folds guarantee a nonempty train region
+                    # whenever a test region is nonempty; machines too short
+                    # for any fold (n_real < n_splits+1) get empty test masks
+                    # here and fall back to final-model residuals below
+                    wtest = wt * test_mask
+                    mask = (wtest > 0)[:, None]
+                    emin = jnp.minimum(
+                        emin, jnp.min(jnp.where(mask, err, jnp.inf), axis=0)
+                    )
+                    emax = jnp.maximum(
+                        emax, jnp.max(jnp.where(mask, err, -jnp.inf), axis=0)
+                    )
+                    scores = _masked_metrics(raw_targets, pred_raw, wtest)
+                    return (emin, emax), (scores, err, wtest)
 
-        # final-model residuals over all real rows: the error-scaler source
-        # when CV is off, and the per-machine fallback when no CV fold
-        # covered this machine's data (short machine in a tall bucket)
-        pred_final = predict_all(final.params)
-        pred_final_raw = (pred_final - sy.offset) / sy.scale
-        err_final = jnp.abs(raw_targets - pred_final_raw)
+                (emin, emax), (cv_scores, fold_errors, fold_test_masks) = (
+                    jax.lax.scan(
+                        fold_step,
+                        (emin, emax),
+                        (train_masks, test_masks, fold_keys),
+                    )
+                )
+            else:
+                cv_scores = jnp.zeros((0, len(FLEET_CV_METRICS)))
+                fold_errors = jnp.zeros((0, n_points, n_targets))
+                fold_test_masks = jnp.zeros((0, n_points))
+
+            final = fit_local(params0, inputs, targets, wt, fit_key)
+
+            # final-model residuals over all real rows: the error-scaler
+            # source when CV is off, and the per-machine fallback when no CV
+            # fold covered this machine's data (short machine in a tall
+            # bucket)
+            pred_final = predict_all(final.params)
+            pred_final_raw = (pred_final - sy.offset) / sy.scale
+            err_final = jnp.abs(raw_targets - pred_final_raw)
         mask_final = (wt > 0)[:, None]
         fmin = jnp.min(jnp.where(mask_final, err_final, jnp.inf), axis=0)
         fmax = jnp.max(jnp.where(mask_final, err_final, -jnp.inf), axis=0)

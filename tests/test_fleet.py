@@ -17,6 +17,7 @@ from gordo_components_tpu.parallel import (
     fleet_mesh,
     train_fleet_arrays,
 )
+from gordo_components_tpu.parallel.fleet import MachineResult
 from gordo_components_tpu.parallel.build_fleet import _analyze_model, _spec_for
 from gordo_components_tpu.serializer import load, load_metadata, pipeline_from_definition
 
@@ -88,6 +89,102 @@ def test_fleet_trains_stacked_machines():
     k0 = np.asarray(leaves[0][0])
     k1 = np.asarray(leaves[0][1])
     assert not np.allclose(k0, k1)
+
+
+def test_cv_parallel_evaluation_override():
+    """evaluation.cv_parallel pins the fold-execution mode per machine
+    (beating the remat-derived default), bad types are rejected, and the
+    key counts as honored (not surfaced in the ignored list)."""
+    from gordo_components_tpu.parallel.build_fleet import _effective_splits
+
+    m = FleetMachineConfig(
+        name="m", model_config={}, data_config={},
+        evaluation={"n_splits": 1, "cv_parallel": False, "cv_mode": "full"},
+    )
+    splits, cv_parallel, ignored = _effective_splits(m, 3)
+    assert (splits, cv_parallel) == (1, False)
+    assert ignored == ["cv_mode"]  # cv_parallel is honored, cv_mode is not
+    m_default = FleetMachineConfig(
+        name="m2", model_config={}, data_config={}, evaluation={}
+    )
+    assert _effective_splits(m_default, 3)[:2] == (3, None)
+    bad = FleetMachineConfig(
+        name="m3", model_config={}, data_config={},
+        evaluation={"cv_parallel": "yes"},
+    )
+    with pytest.raises(ValueError, match="cv_parallel must be a boolean"):
+        _effective_splits(bad, 3)
+    # the derived default: remat models keep the sequential scan
+    probe = pipeline_from_definition(MODEL_CONFIG)
+    spec = _spec_for(_analyze_model(probe), 3, 3, 2)
+    assert spec.cv_parallel is True
+    assert _spec_for(
+        _analyze_model(probe), 3, 3, 2, cv_parallel=False
+    ).cv_parallel is False
+
+
+def test_cv_parallel_matches_scan():
+    """The vmapped fold path (FleetSpec.cv_parallel) must train the SAME
+    models as the sequential scan path: per-fit keys are identical by
+    construction, so every MachineResult field agrees up to XLA
+    reduction-order float noise. This pins the (K+1)x sequential-depth
+    optimization as a pure execution-strategy change, not a semantic one."""
+    spec, batch = _make_spec_and_batch(3, n_rows=128, n_splits=2)
+    assert spec.cv_parallel  # the derived default for non-remat models
+    fast = train_fleet_arrays(spec, batch)
+    slow = train_fleet_arrays(spec._replace(cv_parallel=False), batch)
+    for name in MachineResult._fields:
+        a, b = getattr(fast, name), getattr(slow, name)
+        for la, lb in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(la), np.asarray(lb), rtol=2e-4, atol=1e-5,
+                err_msg=f"cv_parallel vs scan mismatch in {name}",
+            )
+
+
+def test_cv_parallel_windowed_matches_scan():
+    """Same parity through the windowed (LSTM) path, whose predict side
+    runs lax.map chunks under the fold vmap."""
+    lstm_config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "TransformedTargetRegressor": {
+                    "regressor": {
+                        "Pipeline": {
+                            "steps": [
+                                "MinMaxScaler",
+                                {"LSTMAutoEncoder": {
+                                    "kind": "lstm_symmetric",
+                                    "lookback_window": 8,
+                                    "dims": [8],
+                                    "epochs": 2,
+                                    "batch_size": 16,
+                                }},
+                            ]
+                        }
+                    },
+                    "transformer": "MinMaxScaler",
+                }
+            }
+        }
+    }
+    spec, batch = _make_spec_and_batch(
+        2, n_rows=96, model_config=lstm_config, n_splits=2
+    )
+    assert spec.cv_parallel
+    fast = train_fleet_arrays(spec, batch)
+    slow = train_fleet_arrays(spec._replace(cv_parallel=False), batch)
+    for name in MachineResult._fields:
+        for la, lb in zip(
+            jax.tree_util.tree_leaves(getattr(fast, name)),
+            jax.tree_util.tree_leaves(getattr(slow, name)),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(la), np.asarray(lb), rtol=2e-4, atol=1e-5,
+                err_msg=f"cv_parallel vs scan mismatch in {name}",
+            )
 
 
 @pytest.mark.slow
