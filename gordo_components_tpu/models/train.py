@@ -63,6 +63,34 @@ class FitResult(NamedTuple):
     loss_history: jnp.ndarray  # (epochs,) weighted mean loss per epoch
 
 
+def make_batch_step(
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    loss: str = "mse",
+    use_dropout: bool = False,
+) -> Callable:
+    """One mini-batch SGD step: ``((params, opt_state), (x, y, w, key)) →
+    ((params, opt_state), (loss, wsum))`` — the scanned body of
+    :func:`make_fit_fn`, exposed so FLOP accounting can compile exactly the
+    step the training loop runs (XLA's ``cost_analysis`` counts a scan body
+    ONCE regardless of trip count, so whole-program flops undercount
+    training loops; see ``parallel.fleet.fleet_flops_accounting``)."""
+    loss_fn = make_loss_fn(apply_fn, loss)
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def batch_step(carry, batch):
+        params, opt_state = carry
+        xi, yi, wi, ki = batch
+        batch_loss, grads = grad_fn(
+            params, xi, yi, wi, ki if use_dropout else None
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), (batch_loss, jnp.sum(wi))
+
+    return batch_step
+
+
 def make_fit_fn(
     apply_fn: Callable,
     optimizer: optax.GradientTransformation,
@@ -77,8 +105,9 @@ def make_fit_fn(
     Returns ``fit(params, X, y, w, key) -> FitResult`` where ``X.shape[0]``
     must be a multiple of ``batch_size`` (see :func:`pad_to_batches`).
     """
-    loss_fn = make_loss_fn(apply_fn, loss)
-    grad_fn = jax.value_and_grad(loss_fn)
+    batch_step = make_batch_step(
+        apply_fn, optimizer, loss=loss, use_dropout=use_dropout
+    )
 
     def fit(params, X, y, w, key) -> FitResult:
         n = X.shape[0]
@@ -96,16 +125,6 @@ def make_fit_fn(
             yb = y[perm].reshape(steps, batch_size, *y.shape[1:])
             wb = w[perm].reshape(steps, batch_size)
             drop_keys = jax.random.split(drop_key, steps)
-
-            def batch_step(carry, batch):
-                params, opt_state = carry
-                xi, yi, wi, ki = batch
-                batch_loss, grads = grad_fn(
-                    params, xi, yi, wi, ki if use_dropout else None
-                )
-                updates, opt_state = optimizer.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state), (batch_loss, jnp.sum(wi))
 
             (params, opt_state), (batch_losses, batch_wsums) = jax.lax.scan(
                 batch_step, (params, opt_state), (Xb, yb, wb, drop_keys)

@@ -472,6 +472,27 @@ def _effective_splits(
     return eff, cv_parallel, ignored
 
 
+def _derived_cv_parallel(model_config: Dict[str, Any]) -> bool:
+    """The fold-execution mode a config derives when ``evaluation.
+    cv_parallel`` is absent: sequential scan iff the model asked for remat
+    (memory-constrained — see :func:`_spec_for`). Reads the literal
+    ``remat`` kwarg off the config dict so bucketing can resolve the mode
+    without instantiating the pipeline; no factory defaults ``remat`` on,
+    so textual absence means remat is off (pinned against the spec-level
+    derivation by tests/test_fleet.py)."""
+
+    def scan(node: Any) -> bool:
+        if isinstance(node, dict):
+            if node.get("remat"):
+                return True
+            return any(scan(v) for v in node.values())
+        if isinstance(node, (list, tuple)):
+            return any(scan(v) for v in node)
+        return False
+
+    return not scan(model_config)
+
+
 def _scaler_kind(
     scaler: Optional[Any],
 ) -> Tuple[str, Tuple[float, float], Tuple[bool, bool]]:
@@ -748,17 +769,23 @@ def build_fleet(
             item["dataset_metadata"] = dataset.get_metadata()
         item["F"], item["T"] = n_features, n_targets
         item["n_splits"] = eff_splits
-        item["cv_parallel"] = eff_cv_parallel
+        # resolve the fold-execution mode NOW (None → the remat-derived
+        # default, readable straight off the config dict) so a machine whose
+        # explicit override merely restates the default still buckets — and
+        # batches — with its unannotated twins; different resolved modes are
+        # different compiled programs and bucket separately
+        item["cv_parallel"] = (
+            eff_cv_parallel
+            if eff_cv_parallel is not None
+            else _derived_cv_parallel(machine.model_config)
+        )
         sig = json.dumps(
             {
                 "model_config": machine.model_config,
                 "F": n_features,
                 "T": n_targets,
                 "n_splits": item["n_splits"],
-                # an explicit fold-execution override is a different compiled
-                # program — its machines bucket separately (None derives from
-                # the model config, which is already in the signature)
-                "cv_parallel": eff_cv_parallel,
+                "cv_parallel": item["cv_parallel"],
             },
             sort_keys=True,
             default=str,

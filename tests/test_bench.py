@@ -90,6 +90,62 @@ def test_all_bench_configs_build_specs():
         assert spec.lookback_window >= 1, name
     plant = configs["plant_10ktag_bf16"]
     assert plant["tags"] == 10_000 and plant.get("tpu_only")
+    # the plant config asked for remat (memory-constrained): its derived
+    # fold-execution mode must be the sequential scan, every other bench
+    # config takes the vmapped (K+1)x parallel-CV path
+    plant_spec = _spec_for(
+        _analyze_model(pipeline_from_definition(plant["model"])),
+        4, 4, plant["n_splits"],
+    )
+    assert plant_spec.cv_parallel is False
+    dense_spec = _spec_for(
+        _analyze_model(
+            pipeline_from_definition(configs["dense_ae_10tag"]["model"])
+        ),
+        10, 10, 3,
+    )
+    assert dense_spec.cv_parallel is True
+
+
+def test_fleet_flops_accounting_trip_adjustment():
+    """MFU accounting: the trip-count-adjusted total must dominate the raw
+    whole-program cost_analysis figure (which counts each scan body once)
+    and scale linearly with epochs — pinning the adjustment the bench's
+    MFU is computed from before a one-shot TPU run relies on it."""
+    import sys
+
+    sys.path.insert(0, _REPO_ROOT)
+    import bench
+
+    from gordo_components_tpu.parallel.build_fleet import (
+        _analyze_model,
+        _spec_for,
+    )
+    from gordo_components_tpu.parallel.fleet import (
+        compiled_flops,
+        fleet_executable,
+        fleet_flops_accounting,
+    )
+    from gordo_components_tpu.serializer import pipeline_from_definition
+
+    cfg = bench._configs(full=False, epochs=4, machines=2)["dense_ae_10tag"]
+    probe = pipeline_from_definition(cfg["model"])
+    spec = _spec_for(_analyze_model(probe), 10, 10, n_splits=2)
+    acct = fleet_flops_accounting(spec, 2, 128, 10, 10)
+    assert acct is not None
+    # structure: 3 fits x 4 epochs x (128/64=2) steps
+    assert acct["train_steps"] == 3 * spec.epochs * (128 // spec.batch_size)
+    assert acct["predict_chunks"] == 3 * (128 // spec.batch_size)
+    assert acct["total_flops"] > 0
+    # doubling epochs doubles train steps, total grows accordingly
+    acct2 = fleet_flops_accounting(
+        spec._replace(epochs=2 * spec.epochs), 2, 128, 10, 10
+    )
+    assert acct2["train_steps"] == 2 * acct["train_steps"]
+    assert acct2["total_flops"] > acct["total_flops"]
+    # the adjusted total dominates the whole-program body-once figure
+    compiled, _ = fleet_executable(spec, 2, 128, 10, 10)
+    assert acct["total_flops"] >= compiled_flops(compiled)
 
 
 _FAKE_RESULT = {

@@ -121,6 +121,19 @@ def test_cv_parallel_evaluation_override():
     assert _spec_for(
         _analyze_model(probe), 3, 3, 2, cv_parallel=False
     ).cv_parallel is False
+    # the bucketing-time textual derivation must agree with the spec-level
+    # one (it reads the literal remat kwarg instead of instantiating)
+    from gordo_components_tpu.parallel.build_fleet import _derived_cv_parallel
+
+    assert _derived_cv_parallel(MODEL_CONFIG) is True
+    import copy
+
+    remat_config = copy.deepcopy(MODEL_CONFIG)
+    steps = remat_config["DiffBasedAnomalyDetector"]["base_estimator"][
+        "TransformedTargetRegressor"
+    ]["regressor"]["Pipeline"]["steps"]
+    steps[1]["DenseAutoEncoder"]["remat"] = True
+    assert _derived_cv_parallel(remat_config) is False
 
 
 def test_cv_parallel_matches_scan():
