@@ -1,0 +1,42 @@
+#!/bin/bash
+# TPU-revival runbook: run the full measurement suite the moment the
+# accelerator tunnel is live, in priority order, saving every artifact
+# under docs/measurements/. Designed to be safe to re-run (artifacts are
+# numbered by invocation) and to keep going when a leg fails — tunnel
+# uptime is the scarcest resource on this rig, so the highest-value
+# measurements run first:
+#   1. python bench.py            — all configs incl. plant + serving block
+#   2. BENCH_FULL=1 python bench.py — north-star fleet size (1024 machines)
+#   3. bare __graft_entry__.py    — driver compile-check parity
+# Usage: bash tools/tpu_runbook.sh [tag]   (tag defaults to r4)
+set -u
+cd /root/repo
+export PYTHONPATH="/root/repo${PYTHONPATH:+:$PYTHONPATH}"
+TAG=${1:-r4}
+OUT=docs/measurements
+mkdir -p "$OUT"
+n=1
+while [ -e "$OUT/bench_tpu_${TAG}_run${n}.json" ]; do n=$((n+1)); done
+LOG="$OUT/runbook_${TAG}_run${n}.log"
+
+run_leg() {
+  local name=$1 dest=$2; shift 2
+  echo "$(date -Is) runbook leg: $name -> $dest" | tee -a "$LOG"
+  # legs emit ONE JSON line on stdout; stderr (progress) goes to the log
+  if "$@" > "$dest.tmp" 2>> "$LOG"; then
+    tail -n 1 "$dest.tmp" > "$dest" && rm -f "$dest.tmp"
+    echo "$(date -Is) $name OK" | tee -a "$LOG"
+  else
+    echo "$(date -Is) $name FAILED (rc=$?); partial kept at $dest.tmp" \
+      | tee -a "$LOG"
+  fi
+}
+
+run_leg bench        "$OUT/bench_tpu_${TAG}_run${n}.json"  python bench.py
+run_leg bench_full   "$OUT/bench_tpu_${TAG}_full${n}.json" \
+  env BENCH_FULL=1 BENCH_NO_SERVING=1 python bench.py
+echo "$(date -Is) runbook leg: graft entry compile-check" | tee -a "$LOG"
+python __graft_entry__.py >> "$LOG" 2>&1 \
+  && echo "$(date -Is) entry OK" | tee -a "$LOG" \
+  || echo "$(date -Is) entry FAILED" | tee -a "$LOG"
+echo "$(date -Is) runbook done" | tee -a "$LOG"
