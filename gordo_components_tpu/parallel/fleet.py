@@ -330,10 +330,27 @@ def make_machine_program(
             )
             windowed_predict = make_predict_fn(windowed_apply)
 
+            # prediction has no optimizer state or backward pass, so its
+            # chunks can be wider than the training batch: fold up to 4
+            # training batches into one forward call (largest factor of the
+            # step count), cutting the predict pass's sequential ticks by
+            # that factor. The bound is RELATIVE to the training step, not
+            # absolute, because predict_all runs under the same vmaps
+            # (machines, and K+1 fits in cv_parallel mode) as the training
+            # step: a training step holds ~3x its forward activations
+            # (fwd + bwd + grads), so a 4x-wide forward-only chunk peaks at
+            # ~4/3 of the training step's memory under ANY vmap
+            # multiplication — never a new OOM class. Values are unchanged —
+            # prediction is per-window.
+            steps = padded // spec.batch_size
+            predict_width = spec.batch_size * next(
+                k for k in range(min(4, steps), 0, -1) if steps % k == 0
+            )
+
             def predict_all(params):
-                # bounded-memory full prediction: sequential batch chunks,
-                # so peak HBM per machine stays one (batch, L, F) gather
-                chunks = inputs.reshape(-1, spec.batch_size)
+                # bounded-memory full prediction: sequential widened chunks,
+                # so peak HBM per machine stays one (width, L, F) gather
+                chunks = inputs.reshape(-1, predict_width)
                 preds = jax.lax.map(
                     lambda sb: windowed_predict(params, sb), chunks
                 )

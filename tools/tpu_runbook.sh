@@ -15,9 +15,13 @@ export PYTHONPATH="/root/repo${PYTHONPATH:+:$PYTHONPATH}"
 TAG=${1:-r4}
 OUT=docs/measurements
 mkdir -p "$OUT"
+# number the invocation off the LOG (created unconditionally below), so a
+# re-run after a partially-failed invocation never reuses its n and
+# overwrites surviving artifacts from the scarce tunnel session
 n=1
-while [ -e "$OUT/bench_tpu_${TAG}_run${n}.json" ]; do n=$((n+1)); done
+while [ -e "$OUT/runbook_${TAG}_run${n}.log" ]; do n=$((n+1)); done
 LOG="$OUT/runbook_${TAG}_run${n}.log"
+: > "$LOG"
 
 run_leg() {
   local name=$1 dest=$2; shift 2
@@ -27,7 +31,8 @@ run_leg() {
     tail -n 1 "$dest.tmp" > "$dest" && rm -f "$dest.tmp"
     echo "$(date -Is) $name OK" | tee -a "$LOG"
   else
-    echo "$(date -Is) $name FAILED (rc=$?); partial kept at $dest.tmp" \
+    local rc=$?
+    echo "$(date -Is) $name FAILED (rc=$rc); partial kept at $dest.tmp" \
       | tee -a "$LOG"
   fi
 }
