@@ -99,11 +99,19 @@ def make_fit_fn(
     epochs: int = 1,
     shuffle: bool = True,
     use_dropout: bool = False,
+    unroll: int = 1,
 ) -> Callable:
     """Build the compiled training program.
 
     Returns ``fit(params, X, y, w, key) -> FitResult`` where ``X.shape[0]``
     must be a multiple of ``batch_size`` (see :func:`pad_to_batches`).
+
+    ``unroll`` inlines that many mini-batch steps per loop iteration of the
+    inner scan (``lax.scan``'s own knob): tiny fleet models are dominated
+    by per-iteration dispatch overhead on TPU, and unrolling lets XLA
+    schedule several steps per dispatch. Pure scheduling — the step
+    sequence and numerics are unchanged; compile time grows with the
+    unrolled body, so memory-/compile-constrained callers keep 1.
     """
     batch_step = make_batch_step(
         apply_fn, optimizer, loss=loss, use_dropout=use_dropout
@@ -127,7 +135,10 @@ def make_fit_fn(
             drop_keys = jax.random.split(drop_key, steps)
 
             (params, opt_state), (batch_losses, batch_wsums) = jax.lax.scan(
-                batch_step, (params, opt_state), (Xb, yb, wb, drop_keys)
+                batch_step,
+                (params, opt_state),
+                (Xb, yb, wb, drop_keys),
+                unroll=min(unroll, steps) if steps else 1,
             )
             epoch_loss = jnp.sum(batch_losses * batch_wsums) / jnp.maximum(
                 jnp.sum(batch_wsums), 1.0

@@ -540,13 +540,14 @@ def _spec_for(
             "single-machine builder for this config"
         )
     dropout = float(model_spec.config.get("dropout", 0.0) or 0.0)
+    memory_constrained = bool(model_spec.config.get("remat", False))
     if cv_parallel is None:
         # derive the fold-execution mode from the model's memory profile: a
         # config that asked for remat is trading FLOPs for memory already —
         # multiplying step activations by (K+1) would undo that, so such
         # buckets keep the sequential scan; everything else takes the
         # (K+1)× sequential-depth win (FleetSpec.cv_parallel)
-        cv_parallel = not bool(model_spec.config.get("remat", False))
+        cv_parallel = not memory_constrained
     return FleetSpec(
         module=model_spec.module,
         optimizer=model_spec.optimizer,
@@ -565,6 +566,10 @@ def _spec_for(
         target_feature_range=t_range,
         target_scaler_options=t_options,
         cv_parallel=cv_parallel,
+        # scan unrolling follows the model's memory profile directly, NOT
+        # cv_parallel: an explicit cv_parallel override must not silently
+        # change compile-time/footprint behavior too
+        fit_unroll=1 if memory_constrained else 4,
     )
 
 

@@ -83,6 +83,14 @@ class FleetSpec(NamedTuple):
     # XLA reduction-order float noise — parity pinned by
     # tests/test_fleet.py::test_cv_parallel_matches_scan.
     cv_parallel: bool = True
+    # mini-batch steps inlined per iteration of the training scan
+    # (lax.scan's unroll): tiny fleet models are dispatch-overhead-bound,
+    # and unrolling lets XLA schedule several steps per dispatch. Pure
+    # scheduling, numerics unchanged; compile time grows with the body, so
+    # _spec_for defaults it to 1 for the memory-/compile-constrained
+    # (remat) buckets and 4 otherwise — independent of cv_parallel so an
+    # explicit override of one never silently drags the other along.
+    fit_unroll: int = 4
 
 
 class MachineBatch(NamedTuple):
@@ -222,6 +230,7 @@ def make_machine_program(
     whole per-machine build as one traceable program."""
 
     apply_fn = spec.module.apply
+    fit_unroll = spec.fit_unroll
     fit_fn = make_fit_fn(
         apply_fn,
         spec.optimizer,
@@ -229,6 +238,7 @@ def make_machine_program(
         batch_size=spec.batch_size,
         epochs=spec.epochs,
         use_dropout=spec.use_dropout,
+        unroll=fit_unroll,
     )
     predict_fn = make_predict_fn(apply_fn)
 
@@ -327,6 +337,7 @@ def make_machine_program(
                 batch_size=spec.batch_size,
                 epochs=spec.epochs,
                 use_dropout=spec.use_dropout,
+                unroll=fit_unroll,
             )
             windowed_predict = make_predict_fn(windowed_apply)
 
@@ -337,15 +348,21 @@ def make_machine_program(
             # that factor. The bound is RELATIVE to the training step, not
             # absolute, because predict_all runs under the same vmaps
             # (machines, and K+1 fits in cv_parallel mode) as the training
-            # step: a training step holds ~3x its forward activations
-            # (fwd + bwd + grads), so a 4x-wide forward-only chunk peaks at
-            # ~4/3 of the training step's memory under ANY vmap
-            # multiplication — never a new OOM class. Values are unchanged —
-            # prediction is per-window.
+            # step: a NON-remat training step holds ~3x its forward
+            # activations (fwd + bwd + grads), so a 4x-wide forward-only
+            # chunk peaks at ~4/3 of the training step's memory under ANY
+            # vmap multiplication. That argument does NOT hold for remat
+            # buckets (their step peak is deliberately small), so the
+            # memory-constrained cv_parallel=False mode keeps the original
+            # one-batch chunks. Values are unchanged — prediction is
+            # per-window.
             steps = padded // spec.batch_size
-            predict_width = spec.batch_size * next(
-                k for k in range(min(4, steps), 0, -1) if steps % k == 0
-            )
+            if spec.cv_parallel:
+                predict_width = spec.batch_size * next(
+                    k for k in range(min(4, steps), 0, -1) if steps % k == 0
+                )
+            else:
+                predict_width = spec.batch_size
 
             def predict_all(params):
                 # bounded-memory full prediction: sequential widened chunks,
@@ -654,11 +671,16 @@ def fleet_flops_accounting(
     trip count, so the whole fleet program's reported flops undercount the
     training loop by roughly ``n_fits × epochs × steps_per_epoch`` — on the
     round-4 TPU bench that made MFU look ~25× smaller than reality. This
-    helper compiles the EXACT scanned bodies standalone — the mini-batch
-    train step (:func:`gordo_components_tpu.models.train.make_batch_step`,
-    the same function ``make_fit_fn`` scans) and the predict chunk — reads
-    each one's XLA-reported flops, and multiplies by the Python-known trip
-    counts from the program structure (no hand FLOP model anywhere).
+    helper compiles the loop bodies standalone — the EXACT mini-batch train
+    step (:func:`gordo_components_tpu.models.train.make_batch_step`, the
+    same function ``make_fit_fn`` scans) and a batch-size-wide predict
+    chunk — reads each one's XLA-reported flops, and multiplies by the
+    Python-known trip counts from the program structure (no hand FLOP
+    model anywhere). ``predict_chunks`` counts BATCH-SIZE-EQUIVALENT
+    chunks, not literal ``lax.map`` iterations: the program may execute
+    wider predict chunks (see ``predict_width`` in
+    :func:`make_machine_program`), and the total is invariant because
+    per-chunk flops are linear in width.
 
     The total is a slight UNDERcount still: scaler fits, fold masks,
     thresholds, and metrics (all O(rows×tags) elementwise, no matmuls) are

@@ -98,6 +98,7 @@ def test_all_bench_configs_build_specs():
         4, 4, plant["n_splits"],
     )
     assert plant_spec.cv_parallel is False
+    assert plant_spec.fit_unroll == 1  # remat: no compile/footprint blowup
     dense_spec = _spec_for(
         _analyze_model(
             pipeline_from_definition(configs["dense_ae_10tag"]["model"])
@@ -105,6 +106,7 @@ def test_all_bench_configs_build_specs():
         10, 10, 3,
     )
     assert dense_spec.cv_parallel is True
+    assert dense_spec.fit_unroll == 4
 
 
 def test_fleet_flops_accounting_trip_adjustment():
