@@ -87,10 +87,12 @@ class FleetSpec(NamedTuple):
     # (lax.scan's unroll): tiny fleet models are dispatch-overhead-bound,
     # and unrolling lets XLA schedule several steps per dispatch. Pure
     # scheduling, numerics unchanged; compile time grows with the body, so
-    # _spec_for defaults it to 1 for the memory-/compile-constrained
-    # (remat) buckets and 4 otherwise — independent of cv_parallel so an
-    # explicit override of one never silently drags the other along.
-    fit_unroll: int = 4
+    # the default here is the safe 1 and _spec_for opts non-remat buckets
+    # into 4 — independent of cv_parallel so an explicit override of one
+    # never silently drags the other along. A value > 1 doubles as the
+    # spec's "memory profile is unconstrained" bit: predict-chunk widening
+    # keys off it (not off the user-overridable cv_parallel).
+    fit_unroll: int = 1
 
 
 class MachineBatch(NamedTuple):
@@ -353,11 +355,12 @@ def make_machine_program(
             # chunk peaks at ~4/3 of the training step's memory under ANY
             # vmap multiplication. That argument does NOT hold for remat
             # buckets (their step peak is deliberately small), so the
-            # memory-constrained cv_parallel=False mode keeps the original
-            # one-batch chunks. Values are unchanged — prediction is
-            # per-window.
+            # widening keys off fit_unroll > 1 — the spec bit _spec_for
+            # sets from the model's memory profile — NOT off the
+            # user-overridable cv_parallel. Values are unchanged —
+            # prediction is per-window.
             steps = padded // spec.batch_size
-            if spec.cv_parallel:
+            if spec.fit_unroll > 1:
                 predict_width = spec.batch_size * next(
                     k for k in range(min(4, steps), 0, -1) if steps % k == 0
                 )
