@@ -447,12 +447,14 @@ def _measure_serving(degraded: bool) -> Dict[str, Any]:
 
 def main() -> None:
     from gordo_components_tpu.utils.backend import (
+        enable_persistent_compile_cache,
         pin_cpu_if_forced,
         require_live_backend_or_cpu_fallback,
     )
 
     degraded = pin_cpu_if_forced()
     require_live_backend_or_cpu_fallback("bench.py")
+    enable_persistent_compile_cache()
     machines_env = os.environ.get("BENCH_MACHINES")
     machines = int(machines_env) if machines_env is not None else 128
     epochs = int(os.environ.get("BENCH_EPOCHS", "10"))

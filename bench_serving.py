@@ -233,12 +233,14 @@ def measure(
 
 def main() -> None:
     from gordo_components_tpu.utils.backend import (
+        enable_persistent_compile_cache,
         pin_cpu_if_forced,
         require_live_backend_or_cpu_fallback,
     )
 
     degraded = pin_cpu_if_forced()
     require_live_backend_or_cpu_fallback("bench_serving.py")
+    enable_persistent_compile_cache()
 
     result = measure(**resolve_sizes(degraded))
     if degraded:

@@ -379,3 +379,19 @@ def test_require_live_backend_passes_on_live_cpu():
     from gordo_components_tpu.utils.backend import require_live_backend
 
     require_live_backend("test-script")  # CPU backend is live -> returns
+
+
+def test_enable_persistent_compile_cache_respects_existing_dir():
+    """The bench/entry cache helper must never override a cache dir the
+    operator (or tests/conftest.py, as here) already pinned — and must
+    report the dir actually in effect."""
+    import jax as _jax
+
+    from gordo_components_tpu.utils.backend import (
+        enable_persistent_compile_cache,
+    )
+
+    before = _jax.config.jax_compilation_cache_dir
+    assert before  # conftest pinned tests/.jax_compilation_cache
+    assert enable_persistent_compile_cache() == before
+    assert _jax.config.jax_compilation_cache_dir == before

@@ -19,7 +19,11 @@ mkdir -p "$OUT"
 # re-run after a partially-failed invocation never reuses its n and
 # overwrites surviving artifacts from the scarce tunnel session
 n=1
-while [ -e "$OUT/runbook_${TAG}_run${n}.log" ]; do n=$((n+1)); done
+while [ -e "$OUT/runbook_${TAG}_run${n}.log" ] \
+   || [ -e "$OUT/bench_tpu_${TAG}_run${n}.json" ] \
+   || [ -e "$OUT/bench_tpu_${TAG}_run${n}.json.tmp" ] \
+   || [ -e "$OUT/bench_tpu_${TAG}_full${n}.json" ] \
+   || [ -e "$OUT/bench_tpu_${TAG}_full${n}.json.tmp" ]; do n=$((n+1)); done
 LOG="$OUT/runbook_${TAG}_run${n}.log"
 : > "$LOG"
 
