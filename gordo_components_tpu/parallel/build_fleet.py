@@ -566,10 +566,27 @@ def _spec_for(
         target_feature_range=t_range,
         target_scaler_options=t_options,
         cv_parallel=cv_parallel,
-        # scan unrolling follows the model's memory profile directly, NOT
+        # scan unrolling follows the model's step-body size, NOT
         # cv_parallel: an explicit cv_parallel override must not silently
-        # change compile-time/footprint behavior too
-        fit_unroll=1 if memory_constrained else 4,
+        # change compile-time/footprint behavior too. Only "flat" models
+        # (small MLP step bodies) unroll: a windowed model's batch step
+        # already contains an inner time scan / attention stack, so
+        # inlining 4 copies multiplies exactly the structures XLA:TPU's
+        # optimization passes are superlinear in — measured on the live
+        # tunnel (r4): the 32-machine LSTM fleet compile went from 28.7 s
+        # to ~25 min with unroll=4 (XLA:CPU shows no such blowup, 16-27 s
+        # across all knob combinations), while its dispatch-overhead win
+        # only ever applied to the tiny dense bodies anyway
+        fit_unroll=(
+            1
+            if (memory_constrained or model_spec.input_kind == "window")
+            else 4
+        ),
+        # predict-chunk widening keys off the memory profile alone: it is
+        # a forward-only memory argument (fleet.py) with no XLA:TPU
+        # compile-time cost, so windowed non-remat models keep it even
+        # though they don't unroll
+        widen_predict=not memory_constrained,
     )
 
 

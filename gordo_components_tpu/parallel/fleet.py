@@ -87,12 +87,20 @@ class FleetSpec(NamedTuple):
     # (lax.scan's unroll): tiny fleet models are dispatch-overhead-bound,
     # and unrolling lets XLA schedule several steps per dispatch. Pure
     # scheduling, numerics unchanged; compile time grows with the body, so
-    # the default here is the safe 1 and _spec_for opts non-remat buckets
-    # into 4 — independent of cv_parallel so an explicit override of one
-    # never silently drags the other along. A value > 1 doubles as the
-    # spec's "memory profile is unconstrained" bit: predict-chunk widening
-    # keys off it (not off the user-overridable cv_parallel).
+    # the default here is the safe 1 and _spec_for opts non-remat flat
+    # buckets into 4 — independent of cv_parallel so an explicit override
+    # of one never silently drags the other along. Windowed models never
+    # unroll: their batch step already carries an inner time scan /
+    # attention stack, and inlining copies of it is exactly what XLA:TPU's
+    # optimization passes are superlinear in (measured r4: 28.7 s -> ~25
+    # min for the 32-machine LSTM fleet compile).
     fit_unroll: int = 1
+    # "memory profile is unconstrained" bit, set by _spec_for from the
+    # model's remat request: predict-chunk widening keys off it (NOT off
+    # the user-overridable cv_parallel, and NOT off fit_unroll, which
+    # windowed models keep at 1 for compile-time reasons unrelated to
+    # memory)
+    widen_predict: bool = True
 
 
 class MachineBatch(NamedTuple):
@@ -355,12 +363,14 @@ def make_machine_program(
             # chunk peaks at ~4/3 of the training step's memory under ANY
             # vmap multiplication. That argument does NOT hold for remat
             # buckets (their step peak is deliberately small), so the
-            # widening keys off fit_unroll > 1 — the spec bit _spec_for
+            # widening keys off spec.widen_predict — the bit _spec_for
             # sets from the model's memory profile — NOT off the
-            # user-overridable cv_parallel. Values are unchanged —
-            # prediction is per-window.
+            # user-overridable cv_parallel, and not off fit_unroll (which
+            # windowed models keep at 1 for XLA:TPU compile-time reasons
+            # unrelated to memory). Values are unchanged — prediction is
+            # per-window.
             steps = padded // spec.batch_size
-            if spec.fit_unroll > 1:
+            if spec.widen_predict:
                 predict_width = spec.batch_size * next(
                     k for k in range(min(4, steps), 0, -1) if steps % k == 0
                 )

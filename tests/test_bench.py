@@ -99,6 +99,7 @@ def test_all_bench_configs_build_specs():
     )
     assert plant_spec.cv_parallel is False
     assert plant_spec.fit_unroll == 1  # remat: no compile/footprint blowup
+    assert plant_spec.widen_predict is False  # remat: keep predict narrow
     dense_spec = _spec_for(
         _analyze_model(
             pipeline_from_definition(configs["dense_ae_10tag"]["model"])
@@ -107,6 +108,20 @@ def test_all_bench_configs_build_specs():
     )
     assert dense_spec.cv_parallel is True
     assert dense_spec.fit_unroll == 4
+    # windowed models keep unroll=1: their batch step already carries an
+    # inner time scan / attention stack, and inlining 4 copies blew the
+    # XLA:TPU compile from 28.7 s to ~25 min (measured r4, live tunnel)
+    lstm_spec = _spec_for(
+        _analyze_model(
+            pipeline_from_definition(configs["lstm_ae_50tag"]["model"])
+        ),
+        50, 50, 2,
+    )
+    assert lstm_spec.cv_parallel is True
+    assert lstm_spec.fit_unroll == 1
+    # ... but keeps the forward-only predict-chunk widening (a memory
+    # argument, not a compile-time one)
+    assert lstm_spec.widen_predict is True
 
 
 def test_fleet_flops_accounting_trip_adjustment():

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Revival automation (VERDICT r3 #1: "a revival must never be missed while
+# feature work is in flight"): block on the tunnel watcher; the moment a
+# probe sees a live accelerator, run the full measurement runbook
+# unattended. Loops so a tunnel that comes up, wedges mid-runbook, and
+# comes up again gets a fresh numbered runbook invocation each time.
+set -u
+cd /root/repo
+export PYTHONPATH="/root/repo${PYTHONPATH:+:$PYTHONPATH}"
+TAG=${1:-r4}
+while true; do
+  POLL_S=${POLL_S:-300} bash tools/tunnel_watch.sh || exit 1  # deadline hit
+  echo "$(date -Is) tunnel live -> runbook" >> tools/tunnel_watch.log
+  bash tools/tpu_runbook.sh "$TAG"
+  echo "$(date -Is) runbook invocation finished" >> tools/tunnel_watch.log
+  sleep 60
+done
