@@ -45,7 +45,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus a
 ``configs`` breakdown.
 
 Env overrides: BENCH_MACHINES (128), BENCH_EPOCHS (10), BENCH_FULL (0),
-BENCH_CPU (0), BENCH_CONFIGS (comma list to restrict).
+BENCH_CPU (0), BENCH_CONFIGS (comma list to restrict), BENCH_CV_PARALLEL
+(unset; 0|1 pins the fold-execution mode for windowed configs — set to 0
+by the runbook's compile canary when the vmapped-CV windowed compile is
+measured-pathological on XLA:TPU), BENCH_NO_SERVING (0), BENCH_PLANT (0).
 """
 
 from __future__ import annotations
@@ -199,6 +202,22 @@ def _flops_of(compiled) -> Optional[float]:
     return compiled_flops(compiled)
 
 
+def _cv_parallel_override(analyzed) -> Optional[bool]:
+    """The fold-execution pin for this config, or None for the derived
+    default. BENCH_CV_PARALLEL=0|1 pins the mode for WINDOWED configs only
+    (``estimator.lookahead is not None`` — the same bit ``_make_spec``
+    validates ``input_kind`` against); flat configs are never touched,
+    their small-MLP step bodies compile fine under vmap CV. The runbook's
+    compile canary (tools/tpu_isolate.py) sets 0 when the vmapped-CV
+    windowed program is measured-pathological to compile on the live
+    XLA:TPU backend, so a scarce tunnel session still gets scan-CV numbers
+    instead of burning ~25 min/config on compiles."""
+    cv_env = os.environ.get("BENCH_CV_PARALLEL")
+    if cv_env is None or analyzed.estimator.lookahead is None:
+        return None
+    return cv_env == "1"
+
+
 def _bench_config(name: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
     from gordo_components_tpu.parallel import MachineBatch
     from gordo_components_tpu.parallel.build_fleet import _analyze_model, _spec_for
@@ -224,7 +243,14 @@ def _bench_config(name: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
 
     machines, rows, tags = cfg["machines"], cfg["rows"], cfg["tags"]
     probe = pipeline_from_definition(cfg["model"])
-    spec = _spec_for(_analyze_model(probe), tags, tags, n_splits=cfg["n_splits"])
+    analyzed = _analyze_model(probe)
+    spec = _spec_for(
+        analyzed,
+        tags,
+        tags,
+        n_splits=cfg["n_splits"],
+        cv_parallel=_cv_parallel_override(analyzed),
+    )
 
     def batch_for(n_machines: int, seed: int) -> MachineBatch:
         X = _synthetic(n_machines, rows, tags, seed)

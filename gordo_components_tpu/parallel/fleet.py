@@ -99,8 +99,10 @@ class FleetSpec(NamedTuple):
     # model's remat request: predict-chunk widening keys off it (NOT off
     # the user-overridable cv_parallel, and NOT off fit_unroll, which
     # windowed models keep at 1 for compile-time reasons unrelated to
-    # memory)
-    widen_predict: bool = True
+    # memory). Defaults to the safe narrow mode like fit_unroll — a spec
+    # built without _spec_for must opt in, never inherit 4x-wide predict
+    # chunks it didn't budget for.
+    widen_predict: bool = False
 
 
 class MachineBatch(NamedTuple):

@@ -124,6 +124,43 @@ def test_all_bench_configs_build_specs():
     assert lstm_spec.widen_predict is True
 
 
+def test_bench_cv_parallel_env_pins_windowed_configs_only(monkeypatch):
+    """BENCH_CV_PARALLEL=0 (set by the runbook's compile canary when the
+    vmapped-CV windowed program is measured-pathological on XLA:TPU) must
+    flip windowed configs to scan CV while leaving flat configs on their
+    derived vmap default — exercised through the same helper
+    ``_bench_config`` calls."""
+    import sys
+
+    sys.path.insert(0, _REPO_ROOT)
+    import bench
+
+    from gordo_components_tpu.parallel.build_fleet import (
+        _analyze_model,
+        _spec_for,
+    )
+    from gordo_components_tpu.serializer import pipeline_from_definition
+
+    configs = bench._configs(full=False, epochs=2, machines=2)
+
+    def spec_of(name):
+        cfg = configs[name]
+        analyzed = _analyze_model(pipeline_from_definition(cfg["model"]))
+        return _spec_for(
+            analyzed,
+            cfg["tags"],
+            cfg["tags"],
+            n_splits=cfg["n_splits"],
+            cv_parallel=bench._cv_parallel_override(analyzed),
+        )
+
+    monkeypatch.delenv("BENCH_CV_PARALLEL", raising=False)
+    assert spec_of("lstm_ae_50tag").cv_parallel is True  # derived default
+    monkeypatch.setenv("BENCH_CV_PARALLEL", "0")
+    assert spec_of("dense_ae_10tag").cv_parallel is True  # flat: untouched
+    assert spec_of("lstm_ae_50tag").cv_parallel is False  # windowed: pinned
+
+
 def test_fleet_flops_accounting_trip_adjustment():
     """MFU accounting: the trip-count-adjusted total must dominate the raw
     whole-program cost_analysis figure (which counts each scan body once)

@@ -1,0 +1,98 @@
+"""Bounded XLA:TPU compile canary for the windowed-fleet knobs.
+
+Round-4 finding (BASELINE.md "second tunnel session"): vmapped parallel-CV
+folds combined with ``lax.scan`` unroll=4 blew the 32-machine LSTM fleet
+compile from 28.7 s to 1505.7 s on the TPU backend, while XLA:CPU compiles
+every knob combination in 16-27 s. The unroll half is fixed structurally
+(windowed models keep unroll=1 — ``build_fleet._spec_for``); whether vmap
+CV *alone* also regresses XLA:TPU compile is unknown until measured on a
+live tunnel. This canary answers that with a bounded cost:
+
+- compiles the exact ``lstm_ae_50tag`` bench program (vmap-CV, unroll 1)
+  in a subprocess with a hard timeout;
+- enables the repo-local persistent compilation cache in the child, so a
+  *successful* canary is not wasted work — the bench leg that follows hits
+  the cache for the same program;
+- exit 0 = compile finished inside the budget (vmap CV is safe: run the
+  bench as-is); exit 1 = timeout/failure (the runbook exports
+  ``BENCH_CV_PARALLEL=0`` so the bench's windowed configs take the
+  sequential-scan CV path instead of burning ~25 min/config).
+
+Usage: ``python tools/tpu_isolate.py [budget_s]`` (default 420).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+CHILD = r"""
+import json, sys, time
+sys.path.insert(0, %(repo)r)
+from gordo_components_tpu.utils.backend import enable_persistent_compile_cache
+enable_persistent_compile_cache()
+from gordo_components_tpu.parallel.build_fleet import _analyze_model, _spec_for
+from gordo_components_tpu.parallel.fleet import fleet_executable
+from gordo_components_tpu.serializer import pipeline_from_definition
+from bench import _configs
+
+cfg = _configs(False, 10, 128)["lstm_ae_50tag"]
+probe = pipeline_from_definition(cfg["model"])
+spec = _spec_for(
+    _analyze_model(probe), cfg["tags"], cfg["tags"], n_splits=cfg["n_splits"]
+)
+assert spec.cv_parallel and spec.fit_unroll == 1, spec
+t = time.perf_counter()
+fleet_executable(spec, cfg["machines"], cfg["rows"], cfg["tags"], cfg["tags"])
+print(json.dumps({"compile_s": round(time.perf_counter() - t, 1)}))
+"""
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    budget_s = float(sys.argv[1]) if len(sys.argv) > 1 else 420.0
+    started = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-u", "-c", CHILD % {"repo": REPO}],
+            capture_output=True,
+            text=True,
+            timeout=budget_s,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            json.dumps(
+                {
+                    "verdict": "pathological",
+                    "timeout_s": budget_s,
+                    "note": "vmap-CV lstm fleet compile exceeded budget; "
+                    "use BENCH_CV_PARALLEL=0",
+                }
+            )
+        )
+        return 1
+    wall = round(time.time() - started, 1)
+    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    if out.returncode != 0 or not line.startswith("{"):
+        print(
+            json.dumps(
+                {
+                    "verdict": "failed",
+                    "rc": out.returncode,
+                    "wall_s": wall,
+                    "stderr_tail": out.stderr[-400:],
+                }
+            )
+        )
+        return 1
+    result = json.loads(line)
+    result.update({"verdict": "ok", "wall_s": wall})
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,9 +41,27 @@ run_leg() {
   fi
 }
 
-run_leg bench        "$OUT/bench_tpu_${TAG}_run${n}.json"  python bench.py
+# leg 0 — compile canary (tools/tpu_isolate.py): bounded probe of the
+# vmapped-CV windowed fleet compile. Success doubles as a cache warm-up
+# (the child persists the compilation cache the bench legs read); timeout
+# or failure flips the bench legs to scan-CV for windowed configs so a
+# pathological XLA:TPU compile can't eat the tunnel session (~25 min per
+# windowed config, measured r4).
+CANARY_ENV=()
+echo "$(date -Is) runbook leg: compile canary" | tee -a "$LOG"
+if CANARY_OUT=$(timeout 480 python tools/tpu_isolate.py 420 2>> "$LOG"); then
+  echo "$(date -Is) canary OK: $CANARY_OUT" | tee -a "$LOG"
+else
+  echo "$(date -Is) canary PATHOLOGICAL: ${CANARY_OUT:-no output} — bench" \
+    "legs will use BENCH_CV_PARALLEL=0 (scan CV) for windowed configs" \
+    | tee -a "$LOG"
+  CANARY_ENV=(BENCH_CV_PARALLEL=0)
+fi
+
+run_leg bench        "$OUT/bench_tpu_${TAG}_run${n}.json"  \
+  env "${CANARY_ENV[@]}" python bench.py
 run_leg bench_full   "$OUT/bench_tpu_${TAG}_full${n}.json" \
-  env BENCH_FULL=1 BENCH_NO_SERVING=1 python bench.py
+  env "${CANARY_ENV[@]}" BENCH_FULL=1 BENCH_NO_SERVING=1 python bench.py
 echo "$(date -Is) runbook leg: graft entry compile-check" | tee -a "$LOG"
 python __graft_entry__.py >> "$LOG" 2>&1 \
   && echo "$(date -Is) entry OK" | tee -a "$LOG" \
