@@ -80,6 +80,36 @@ def gordo(log_level: str, debug_nans: bool):
         )
 
 
+def _enable_build_compile_cache(output_dir: str, cache_dir) -> None:
+    """Persist the XLA compilation cache for build commands. A killed and
+    resumed (or simply re-run) fleet build otherwise re-pays every bucket
+    compile — tens of seconds per bucket on TPU, the dominant cost of a
+    warm-registry resume. Default location is ``<output_dir>/
+    .jax_compilation_cache`` so the cache lives next to the artifacts it
+    belongs to (shared storage in multi-host builds; JAX's cache writes
+    are atomic renames, safe for concurrent processes). ``--compile-cache-
+    dir off`` disables; an operator-pinned ``JAX_COMPILATION_CACHE_DIR``
+    always wins (the helper never overrides an existing setting)."""
+    import os
+
+    from ..utils.backend import enable_persistent_compile_cache
+
+    if cache_dir == "off":
+        return
+    enable_persistent_compile_cache(
+        cache_dir or os.path.join(output_dir, ".jax_compilation_cache")
+    )
+
+
+_COMPILE_CACHE_OPT = click.option(
+    "--compile-cache-dir",
+    envvar="GORDO_COMPILE_CACHE",
+    default=None,
+    help="persistent XLA compilation cache dir (default: "
+    "<output-dir>/.jax_compilation_cache; 'off' disables)",
+)
+
+
 @gordo.command("build")
 @click.argument("name")
 @click.option("--model-config", envvar="MODEL_CONFIG",
@@ -94,13 +124,15 @@ def gordo(log_level: str, debug_nans: bool):
               type=click.Choice(["full_build", "cross_val_only", "build_only"]))
 @click.option("--n-splits", default=3, show_default=True)
 @click.option("--print-cv-scores", is_flag=True, default=False)
+@_COMPILE_CACHE_OPT
 def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
-              metadata, cv_mode, n_splits, print_cv_scores):
+              metadata, cv_mode, n_splits, print_cv_scores, compile_cache_dir):
     """Build one machine's model (idempotent via the config-hash cache)."""
     from ..builder import provide_saved_model
     from ..dataset.dataset import InsufficientDataError
     from ..serializer import load_metadata
 
+    _enable_build_compile_cache(output_dir, compile_cache_dir)
     try:
         model_cfg = _load_config(model_config, "MODEL_CONFIG")
         data_cfg = _load_config(data_config, "DATA_CONFIG")
@@ -155,18 +187,23 @@ def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
               type=int, help="multi-host: total process count")
 @click.option("--process-id", envvar="GORDO_PROCESS_ID", default=None,
               type=int, help="multi-host: this host's process index")
+@_COMPILE_CACHE_OPT
 def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
                     n_splits, seed, slice_size, coordinator_address,
-                    num_processes, process_id):
+                    num_processes, process_id, compile_cache_dir):
     """Build an entire fleet: machines are bucketed and trained as vmapped
     programs sharded over the device mesh. With ``--coordinator-address``
     (or on a TPU pod with autodetectable cluster metadata plus explicit
     ``--num-processes``), the build runs multi-host — every process ingests
     and writes only its own machine shard."""
+    from jax.errors import JaxRuntimeError
+
     from ..dataset.dataset import InsufficientDataError
     from ..parallel import FleetMachineConfig, build_fleet, fleet_mesh
+    from ..parallel.build_fleet import EXIT_RETRYABLE
     from ..workflow import NormalizedConfig
 
+    _enable_build_compile_cache(output_dir, compile_cache_dir)
     try:
         multihost = coordinator_address is not None or num_processes is not None
         if process_id is not None and not multihost:
@@ -220,6 +257,22 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
     except ValueError as exc:
         logger.error("Config error in fleet build: %s", exc)
         sys.exit(EXIT_CONFIG)
+    except JaxRuntimeError as exc:
+        # device/collective runtime failure — in multi-host builds most
+        # often a dead peer detected by the transport (connection reset in
+        # an allgather). Deterministically retryable: restart-all re-runs
+        # resume from the registry + slice checkpoints, so map it to the
+        # explicit transient code (75, EX_TEMPFAIL) rather than a generic
+        # crash. The in-process watchdog (GORDO_SLICE_TIMEOUT_S) exits the
+        # same code for the hangs the transport cannot see.
+        logger.error(
+            "Runtime failure in fleet build (dead peer / device error?): "
+            "%s — exiting retryable code %d; a restarted run resumes from "
+            "the registry and slice checkpoints",
+            exc,
+            EXIT_RETRYABLE,
+        )
+        sys.exit(EXIT_RETRYABLE)
     click.echo(json.dumps(results, indent=2))
 
 

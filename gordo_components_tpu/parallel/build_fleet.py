@@ -58,6 +58,16 @@ logger = logging.getLogger(__name__)
 _ROW_QUANTUM = 256
 
 MANIFEST_FILE = "fleet_manifest.json"
+
+# exit code for a tripped multi-host watchdog: EX_TEMPFAIL — deliberately
+# NOT the permanent-failure codes the CLI maps config/data errors to
+# (64/66, which Argo/k8s must NOT retry); anything else is retryable under
+# the reference's retry semantics, and 75 is the conventional "transient,
+# try again" sysexits value
+EXIT_RETRYABLE = 75
+
+# env knob for the per-slice collective watchdog in multi-host builds
+SLICE_TIMEOUT_ENV = "GORDO_SLICE_TIMEOUT_S"
 _CKPT_SUBDIR = ".slice_checkpoints"
 
 
@@ -656,6 +666,82 @@ def _cv_metadata(result, i: int, n_splits: int) -> Dict[str, Any]:
     }
 
 
+class _SliceWatchdog:
+    """Failure detection for multi-host slices (SURVEY §6.3 translation:
+    the reference delegates hung-pod detection to k8s liveness + Argo
+    retries; a multi-host ``build_fleet`` needs an in-process equivalent
+    because a dead PEER process leaves the survivors blocked inside a
+    collective — ``process_allgather``, the collective orbax save/restore,
+    or a barrier — which no k8s probe can distinguish from slow training
+    from the outside).
+
+    With ``GORDO_SLICE_TIMEOUT_S`` set (CLI: ``fleet-build`` passes the
+    env through), each slice iteration must finish inside the budget or
+    the process logs CRITICAL and hard-exits :data:`EXIT_RETRYABLE` (75,
+    EX_TEMPFAIL — retried under the reference's Argo semantics, unlike
+    the permanent 64/66). A hard ``os._exit`` is deliberate: a thread
+    blocked in a native collective cannot be interrupted from Python, so
+    a cooperative exception would never fire. Restart-all-then-resume is
+    exactly the reference's retry model — the re-run resolves finished
+    machines from the registry and restores any checkpointed slice
+    instead of retraining. Size the budget above the worst healthy slice
+    wall time (it is a liveness bound, not a perf target); unset = no
+    watchdog (single-host builds never arm it: a lone process cannot be
+    stalled by a peer, and killing it would lose the in-flight slice for
+    nothing).
+
+    Pinned end-to-end by tests/test_aux.py's asymmetric-failure drill
+    (peer killed mid-build -> survivor exits 75 -> rerun resumes).
+    """
+
+    def __init__(self, multihost: bool, timeout_s: Optional[float] = None):
+        if timeout_s is None:
+            raw = os.environ.get(SLICE_TIMEOUT_ENV, "")
+            timeout_s = float(raw) if raw else 0.0
+        self.armed = bool(multihost and timeout_s > 0)
+        self.timeout_s = timeout_s
+        self._timer: Optional[Any] = None
+        self._where = ""
+
+    def start(self, bucket: int, sl: int) -> None:
+        """Arm the timer for one slice iteration (no-op when unarmed)."""
+        if not self.armed:
+            return
+        import threading
+
+        self.stop()
+        self._where = f"bucket {bucket} slice {sl}"
+        self._timer = threading.Timer(self.timeout_s, self._trip)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def _trip(self) -> None:
+        try:
+            # best-effort diagnostics only: ANY exception here (e.g. the
+            # distributed runtime already torn down when process_index()
+            # is evaluated) must still reach os._exit — a dead timer
+            # thread would leave the process hung in the native
+            # collective, the exact failure this watchdog exists to stop
+            logger.critical(
+                "Fleet slice watchdog: %s exceeded %.0fs on process %d — "
+                "a peer process has likely died mid-collective; exiting "
+                "%d (retryable) so the job layer restarts all processes "
+                "and the re-run resumes from registry + slice checkpoints",
+                self._where,
+                self.timeout_s,
+                jax.process_index(),
+                EXIT_RETRYABLE,
+            )
+            logging.shutdown()  # the CRITICAL line must hit the stream
+            # before os._exit skips every atexit/flush hook
+        finally:
+            os._exit(EXIT_RETRYABLE)
+
 def build_fleet(
     machines: List[FleetMachineConfig],
     output_dir: str,
@@ -816,6 +902,7 @@ def build_fleet(
 
     master_key = jax.random.PRNGKey(seed)
     checkpointer = _SliceCheckpointer(output_dir, mesh=mesh)
+    watchdog = _SliceWatchdog(multihost)
     prefetcher = ThreadPoolExecutor(
         max_workers=1, thread_name_prefix="fleet-prefetch"
     )
@@ -863,6 +950,12 @@ def build_fleet(
                 span,
             )
             for s, slice_items in enumerate(slices):
+                # armed only multi-host + GORDO_SLICE_TIMEOUT_S: if THIS
+                # iteration stalls past the budget (dead peer -> blocked
+                # collective), the process exits EXIT_RETRYABLE for the
+                # job layer to restart; disarmed at iteration end below
+                # and in the outer finally
+                watchdog.start(b, s)
                 slice_started = time.perf_counter()
                 X, y, w, n_rows, fetch_s = prepared.result()
                 timer.add("data_fetch", fetch_s)
@@ -1022,12 +1115,15 @@ def build_fleet(
                 for item in slice_items:  # free before the next slice fetches
                     item.pop("X", None)
                     item.pop("y", None)
+                watchdog.stop()  # this slice made liveness; next start()
+                # re-arms with a fresh budget
             bucket_duration = time.perf_counter() - bucket_started
             logger.info(
                 "Fleet bucket %d/%d done in %.1fs", b + 1, len(buckets), bucket_duration
             )
 
     finally:
+        watchdog.stop()
         prefetcher.shutdown(wait=True, cancel_futures=True)
     checkpointer.close()
     logger.info(

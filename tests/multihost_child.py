@@ -185,6 +185,54 @@ def build_crash_mode(output_dir: str) -> None:
     build_mode(output_dir)
 
 
+def build_asym_crash_mode(output_dir: str) -> None:
+    """ASYMMETRIC failure drill (ROADMAP #5 / VERDICT r3 weak #5): only
+    process 1 dies — at the start of its second slice, after slice 0's
+    artifacts landed. Process 0 survives, stalls in the slice's collective
+    assembly (its peer is gone), and must be killed by the slice watchdog
+    (``GORDO_SLICE_TIMEOUT_S``, set by the parent test) with the RETRYABLE
+    exit code — never hang. The parent then re-runs a normal build, which
+    must resume slice 0 from the registry and complete the fleet."""
+    import importlib
+
+    bf = importlib.import_module("gordo_components_tpu.parallel.build_fleet")
+
+    orig = bf._SliceWatchdog.start
+
+    def start_or_die(self, bucket, sl):
+        if sl >= 1 and jax.process_index() == 1:
+            print("peer-died-asymmetrically", flush=True)
+            os._exit(17)
+        orig(self, bucket, sl)
+
+    bf._SliceWatchdog.start = start_or_die
+    build_mode(output_dir)
+
+
+def build_hang_mode(output_dir: str) -> None:
+    """Watchdog drill: BOTH processes wedge at the start of slice 1 (after
+    arming the watchdog) — simulating a collective that blocks with every
+    peer still alive, the case the transport layer cannot detect (no
+    connection reset, no heartbeat failure). The slice watchdog must free
+    both with the RETRYABLE exit code."""
+    import importlib
+    import time
+
+    bf = importlib.import_module("gordo_components_tpu.parallel.build_fleet")
+
+    orig = bf._SliceWatchdog.start
+
+    def start_then_wedge(self, bucket, sl):
+        orig(self, bucket, sl)
+        if sl >= 1:
+            print("wedged-in-slice", flush=True)
+            while True:
+                time.sleep(1)
+
+    bf._SliceWatchdog.start = start_then_wedge
+    build_mode(output_dir)
+
+
 def ckpt_roundtrip_mode(ckpt_dir: str) -> None:
     """Collective slice-checkpoint round-trip: save a globally-sharded tree
     (plus a zero-size leaf), restore it through the sharded template, and
@@ -256,6 +304,12 @@ def main() -> None:
         return
     if len(sys.argv) >= 6 and sys.argv[4] == "--build-crash":
         build_crash_mode(sys.argv[5])
+        return
+    if len(sys.argv) >= 6 and sys.argv[4] == "--build-asym-crash":
+        build_asym_crash_mode(sys.argv[5])
+        return
+    if len(sys.argv) >= 6 and sys.argv[4] == "--build-hang":
+        build_hang_mode(sys.argv[5])
         return
     if len(sys.argv) >= 6 and sys.argv[4] == "--build-hetero":
         build_hetero_mode(sys.argv[5])

@@ -145,6 +145,53 @@ def _jax_cache_dir():
     return _jax.config.jax_compilation_cache_dir
 
 
+def test_cli_build_commands_enable_compile_cache(runner, tmp_path, monkeypatch):
+    """build/fleet-build persist the XLA compilation cache (resume must not
+    re-pay bucket compiles): default <output-dir>/.jax_compilation_cache,
+    --compile-cache-dir overrides, 'off' disables. Pinned by recording the
+    helper call — the commands are invoked with a bad config so the test
+    exercises only the cache wiring (which runs first), not a full build."""
+    from gordo_components_tpu.utils import backend as backend_mod
+
+    calls = []
+    monkeypatch.setattr(
+        backend_mod,
+        "enable_persistent_compile_cache",
+        lambda cache_dir=None: calls.append(cache_dir) or str(cache_dir),
+    )
+    out = str(tmp_path / "models")
+    bad = ["--machine-config", "{not valid", "--output-dir", out]
+    assert runner.invoke(gordo, ["fleet-build", *bad]).exit_code != 0
+    assert calls == [os.path.join(out, ".jax_compilation_cache")]
+    calls.clear()
+    custom = str(tmp_path / "cache")
+    assert (
+        runner.invoke(
+            gordo, ["fleet-build", *bad, "--compile-cache-dir", custom]
+        ).exit_code
+        != 0
+    )
+    assert calls == [custom]
+    calls.clear()
+    assert (
+        runner.invoke(
+            gordo, ["fleet-build", *bad, "--compile-cache-dir", "off"]
+        ).exit_code
+        != 0
+    )
+    assert calls == []
+    # the single-machine build command wires the same helper
+    assert (
+        runner.invoke(
+            gordo,
+            ["build", "m1", "--model-config", "{not valid",
+             "--data-config", "{}", "--output-dir", out],
+        ).exit_code
+        != 0
+    )
+    assert calls == [os.path.join(out, ".jax_compilation_cache")]
+
+
 @pytest.mark.slow
 def test_cli_fleet_build_multihost_flags(tmp_path):
     """--coordinator-address wires jax.distributed init + the global fleet

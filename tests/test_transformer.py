@@ -1,5 +1,7 @@
 """PatchTST model-kind and ring-attention tests."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -164,15 +166,31 @@ def test_patchtst_fleet_bucket_ring_matches_dense():
     ring = _fleet_bucket_history("ring", lookback=64, stride=8)
     np.testing.assert_allclose(ring, dense, rtol=1e-3, atol=1e-5)
 
-    # machine axis sharded over the SAME devices the patch ring rotates on
-    mesh = fleet_mesh(8)
-    dense_m = _fleet_bucket_history(
-        "dense", lookback=64, stride=8, mesh=mesh, n_machines=8
+    # machine axis sharded over the SAME devices the patch ring rotates on —
+    # in a FRESH subprocess: compiling this composition late in a
+    # long-lived suite process segfaults inside native XLA:CPU (jaxlib
+    # 0.9.0, observed twice in full-suite runs, never in a fresh process);
+    # see tests/ring_fleet_child.py for the full account
+    import subprocess
+    import sys
+
+    import jax as _jax
+
+    child = os.path.join(os.path.dirname(__file__), "ring_fleet_child.py")
+    proc = subprocess.run(
+        [sys.executable, child],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env={
+            **os.environ,
+            "JAX_COMPILATION_CACHE_DIR": (
+                _jax.config.jax_compilation_cache_dir or ""
+            ),
+        },
     )
-    ring_m = _fleet_bucket_history(
-        "ring", lookback=64, stride=8, mesh=mesh, n_machines=8
-    )
-    np.testing.assert_allclose(ring_m, dense_m, rtol=1e-3, atol=1e-5)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ring-mesh-fleet OK" in proc.stdout
 
 
 # ------------------------------------------------------------ ring attention
