@@ -47,6 +47,8 @@ from .fleet import (
     FLEET_CV_METRICS,
     FleetSpec,
     MachineBatch,
+    backend_supports_donation,
+    peek_fleet_executable,
     train_fleet_arrays,
 )
 from .mesh import pad_to_multiple
@@ -78,6 +80,7 @@ def _prepare_slice(
     n_targets: int,
     quantize_rows: bool,
     span: Optional[Tuple[int, int]] = None,
+    place: Optional[Tuple[Any, Any, bool]] = None,
 ):
     """Host-side ingest for one slice: provider fetch + padded stacked
     assembly. Runs on the prefetch worker so slice ``s+1``'s data-lake reads
@@ -95,6 +98,24 @@ def _prepare_slice(
     exchange it for the global maximum before building global arrays (done
     on the main thread — collectives must never run on the prefetch worker,
     or two processes could order them differently and deadlock).
+
+    ``place=(spec, mesh, donate)``: single-host transfer overlap. When the
+    bucket's executable for this exact shape is ALREADY compiled
+    (:func:`..fleet.peek_fleet_executable` — never compiles from this
+    thread), the worker issues the layout-matched ``device_put`` of X/y/w
+    here, so the NEXT slice's host→device transfer rides behind the
+    current slice's training and artifact writes instead of serializing in
+    front of its own training (on a tunnel-attached TPU the transfer costs
+    ~3x the 128-machine program's execution). ``jax.device_put`` dispatch
+    is async, so the worker never blocks on the wire either. Skipped for
+    memory-constrained (remat) buckets — callers pass ``place=None``.
+    The peek typically first hits for slice 2 of a row shape: slice 1's
+    prepare is submitted before slice 0 triggers the bucket's compile, so
+    its peek usually races a still-running compile and stays host-side —
+    i.e. a 2-slice bucket may see no overlap at all; the win scales with
+    slice count, exactly where ingest wall-time does too. Multi-host
+    callers must NOT pass ``place`` (their batch assembly is collective,
+    main-thread-only).
 
     Every shape input is an explicit argument (not a closure over bucket-loop
     locals): the call runs on another thread, and late-bound locals would
@@ -130,6 +151,25 @@ def _prepare_slice(
         X[i, n_rows - rows :] = item["X"]
         y[i, n_rows - rows :] = item["y"]
         w[i, n_rows - rows :] = 1.0
+    if place is not None and span is None:
+        spec, mesh, donate = place
+        hit = peek_fleet_executable(
+            spec, n_padded, n_rows, n_features, n_targets, mesh=mesh,
+            donate=donate,
+        )
+        if hit is not None:
+            formats = hit[1]
+            if formats is not None:
+                X, y, w = (
+                    jax.device_put(a, f)
+                    for a, f in zip((X, y, w), formats[:3])
+                )
+            else:
+                # no layout API on this backend: a default-layout put still
+                # overlaps the wire behind the previous slice's training —
+                # it is the same plain device_put the main thread would
+                # otherwise pay serially in front of its own training
+                X, y, w = (jax.device_put(a) for a in (X, y, w))
     return X, y, w, n_rows, time.perf_counter() - fetch_started
 
 
@@ -903,6 +943,9 @@ def build_fleet(
     master_key = jax.random.PRNGKey(seed)
     checkpointer = _SliceCheckpointer(output_dir, mesh=mesh)
     watchdog = _SliceWatchdog(multihost)
+    # the donate value train_fleet_arrays will resolve to — the prefetch
+    # worker must peek the executable cache under the SAME key
+    donate_effective = backend_supports_donation(mesh)
     prefetcher = ThreadPoolExecutor(
         max_workers=1, thread_name_prefix="fleet-prefetch"
     )
@@ -944,10 +987,20 @@ def build_fleet(
             )
             quantize_rows = len(slices) > 1
             span = _local_machine_span(mesh, n_padded) if multihost else None
+            # single-host transfer overlap (see _prepare_slice): the worker
+            # device-places a prepared slice when the bucket's executable
+            # already exists. Memory-constrained (remat) buckets keep the
+            # batch on host until their own turn — their peak-HBM budget
+            # has no room for a second slice's buffers
+            place = (
+                (spec, mesh, donate_effective)
+                if (not multihost and spec.widen_predict)
+                else None
+            )
             prepared = prefetcher.submit(
                 _prepare_slice,
                 slices[0], n_padded, n_features, n_targets, quantize_rows,
-                span,
+                span, place,
             )
             for s, slice_items in enumerate(slices):
                 # armed only multi-host + GORDO_SLICE_TIMEOUT_S: if THIS
@@ -963,7 +1016,7 @@ def build_fleet(
                     prepared = prefetcher.submit(
                         _prepare_slice,
                         slices[s + 1], n_padded, n_features, n_targets,
-                        quantize_rows, span,
+                        quantize_rows, span, place,
                     )
                 keys = jax.random.split(
                     jax.random.fold_in(jax.random.fold_in(master_key, b), s),

@@ -635,6 +635,27 @@ def fleet_executable(
     return _cached(_EXEC_CACHE, _EXEC_CACHE_MAX, key, build)
 
 
+def peek_fleet_executable(
+    spec: FleetSpec,
+    n_machines: int,
+    n_rows: int,
+    n_features: int,
+    n_targets: int,
+    mesh=None,
+    donate: bool = False,
+):
+    """The cached ``(compiled, formats)`` for this shape, or ``None`` —
+    NEVER compiles. For the ingest prefetcher: it places the next slice's
+    batch layout-matched only when the program already exists, because a
+    worker-side compile would race the unlocked program cache with the
+    main thread and contend the (single) device compile slot."""
+    key = (spec, n_machines, n_rows, n_features, n_targets, mesh, donate)
+    try:
+        return _EXEC_CACHE.get(key)
+    except TypeError:
+        return None
+
+
 def put_fleet_batch(batch: MachineBatch, formats=None) -> MachineBatch:
     """Device-place a batch, layout-matched when ``formats`` is given (see
     :func:`fleet_executable`). The returned batch's arrays are device

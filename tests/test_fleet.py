@@ -860,3 +860,54 @@ def test_evaluation_n_splits_validation(tmp_path):
     )
     meta = load_metadata(results["null-splits"])
     assert meta["model"]["cross_validation"]["n_splits"] == 2
+
+
+def test_prepare_slice_places_on_device_when_executable_cached():
+    """Transfer overlap: once a bucket's executable exists, the prefetch
+    worker's _prepare_slice must return DEVICE-placed X/y/w (layout-matched
+    via the cached formats) so the next slice's host->device transfer rides
+    behind training — and must stay on host before the first compile (no
+    formats to borrow) and when no placement is requested."""
+    from gordo_components_tpu.parallel.build_fleet import _prepare_slice
+    from gordo_components_tpu.parallel.fleet import (
+        fleet_executable,
+        peek_fleet_executable,
+    )
+
+    probe = pipeline_from_definition(MODEL_CONFIG)
+    spec = _spec_for(_analyze_model(probe), 3, 3, n_splits=1)
+    rng = np.random.default_rng(0)
+    items = [
+        {
+            "X": rng.normal(size=(48, 3)).astype(np.float32),
+            "y": rng.normal(size=(48, 3)).astype(np.float32),
+            "dataset_metadata": {},
+        }
+        for _ in range(2)
+    ]
+    place = (spec, None, False)
+
+    def is_device(a):
+        return isinstance(a, jax.Array)
+
+    # fresh shape, nothing compiled -> stays host-side even with place
+    X, y, w, n_rows, _ = _prepare_slice(
+        [dict(i) for i in items], 2, 3, 3, False, None, place
+    )
+    if peek_fleet_executable(spec, 2, n_rows, 3, 3) is None:
+        assert not is_device(X)
+
+    # compile the executable, then the SAME call must come back placed
+    # (unless this backend exposes no input formats — then it stays host)
+    compiled, formats = fleet_executable(spec, 2, n_rows, 3, 3)
+    X2, y2, w2, n_rows2, _ = _prepare_slice(
+        [dict(i) for i in items], 2, 3, 3, False, None, place
+    )
+    assert n_rows2 == n_rows
+    if formats is not None:
+        assert is_device(X2) and is_device(y2) and is_device(w2)
+        # placed data is bit-identical to the host assembly
+        np.testing.assert_array_equal(np.asarray(X2), X)
+    # and no placement without the request
+    X3, *_ = _prepare_slice([dict(i) for i in items], 2, 3, 3, False, None)
+    assert not is_device(X3)
