@@ -911,3 +911,44 @@ def test_prepare_slice_places_on_device_when_executable_cached():
     # and no placement without the request
     X3, *_ = _prepare_slice([dict(i) for i in items], 2, 3, 3, False, None)
     assert not is_device(X3)
+
+
+def test_prepare_slice_fetches_machines_concurrently():
+    """One slice's per-machine provider reads run concurrently (the
+    reference's pod-per-machine fan-out gave it this for free): 4 fake
+    datasets each sleeping 0.2s must fetch in well under the 0.8s serial
+    sum, land in item order, and propagate a provider exception verbatim."""
+    import time as _time
+
+    from gordo_components_tpu.parallel.build_fleet import _prepare_slice
+
+    class SlowDataset:
+        def __init__(self, value):
+            self.value = value
+
+        def get_data(self):
+            _time.sleep(0.2)
+            X = np.full((8, 3), self.value, np.float32)
+            return X, X.copy()
+
+        def get_metadata(self):
+            return {"v": self.value}
+
+    items = [{"dataset": SlowDataset(float(i))} for i in range(4)]
+    started = _time.perf_counter()
+    X, y, w, n_rows, fetch_s = _prepare_slice(items, 4, 3, 3, False)
+    wall = _time.perf_counter() - started
+    assert wall < 0.6, f"serial fetch? {wall:.2f}s"
+    for i in range(4):
+        assert np.all(np.asarray(X)[i, -8:] == float(i))
+        assert items[i]["dataset_metadata"] == {"v": i}
+
+    class BoomDataset(SlowDataset):
+        def get_data(self):
+            raise RuntimeError("lake exploded")
+
+    with pytest.raises(RuntimeError, match="lake exploded"):
+        _prepare_slice(
+            [{"dataset": SlowDataset(0.0)}, {"dataset": BoomDataset(1.0)}],
+            2, 3, 3, False,
+        )
