@@ -385,8 +385,17 @@ def workflow_group():
               help="(with --tpu) >1 emits the multi-host layout: an "
                    "Indexed Job (one pod per host) + headless coordinator "
                    "Service wiring fleet-build's jax.distributed flags")
+@click.option("--slice-timeout-s", default=1800, show_default=True,
+              type=click.IntRange(min=0),
+              help="(with --tpu --tpu-hosts>1) GORDO_SLICE_TIMEOUT_S on the "
+                   "build pods: the slice watchdog budget that turns a "
+                   "wedged collective into retryable exit 75 (ignored by "
+                   "the Job's podFailurePolicy, so restarts don't burn "
+                   "backoffLimit); size above the worst healthy slice "
+                   "time. 0 disables the watchdog — wedged pods then hang "
+                   "until killed externally")
 def workflow_generate_cmd(machine_config, output_file, image, parallelism,
-                          tpu_mode, tpu_chips, tpu_hosts):
+                          tpu_mode, tpu_chips, tpu_hosts, slice_timeout_s):
     """Fleet YAML -> Argo Workflow (reference-compatible) or TPU Job spec."""
     from ..workflow import generate_argo_workflow, generate_tpu_job
     from ..workflow.workflow_generator import validate_generated
@@ -395,7 +404,8 @@ def workflow_generate_cmd(machine_config, output_file, image, parallelism,
         config = _load_config(machine_config, "machine-config")
         if tpu_mode:
             manifest = generate_tpu_job(
-                config, image=image, tpu_chips=tpu_chips, hosts=tpu_hosts
+                config, image=image, tpu_chips=tpu_chips, hosts=tpu_hosts,
+                slice_timeout_s=slice_timeout_s,
             )
         else:
             manifest = generate_argo_workflow(

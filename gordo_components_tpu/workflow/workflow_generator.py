@@ -158,7 +158,27 @@ metadata:
   name: {{ project }}-fleet-build
   labels: {app: gordo-fleet-builder, project: {{ project }}}
 spec:
+{% if hosts > 1 %}
+  # every wedge/peer-death event costs up to `hosts` pod failures (the
+  # victim plus each watchdog-freed survivor), so the budget scales with
+  # hosts — and the retryable code 75 is excluded from the count entirely
+  # below, or a single event would exhaust a flat limit and permanently
+  # fail the Job for exactly the failure mode the watchdog recovers
+  backoffLimit: {{ 3 * hosts }}
+{% else %}
   backoffLimit: 3
+{% endif %}
+  # make the exit-code contract real at the Job layer (k8s >= 1.26,
+  # requires restartPolicy Never): transient/watchdog exits (75) restart
+  # without counting toward backoffLimit; the CLI's permanent config/data
+  # codes (64/66) fail the Job immediately instead of burning retries on
+  # a config that can never build
+  podFailurePolicy:
+    rules:
+      - action: Ignore
+        onExitCodes: {containerName: fleet-builder, operator: In, values: [75]}
+      - action: FailJob
+        onExitCodes: {containerName: fleet-builder, operator: In, values: [64, 66]}
 {% if hosts > 1 %}
   # one indexed pod per TPU host: every pod runs the SAME fleet-build
   # command, joins the jax.distributed runtime at pod 0, and trains/writes
@@ -194,6 +214,13 @@ spec:
                   fieldPath: "metadata.annotations['batch.kubernetes.io/job-completion-index']"
             - name: GORDO_COORDINATOR
               value: "{{ project }}-fleet-build-0.{{ project }}-fleet-coord:6000"
+            # slice liveness watchdog: a pod wedged in a collective (dead
+            # peer the transport can't see) exits the retryable code 75
+            # after this budget instead of hanging the Job forever; the
+            # backoffLimit restart then resumes from registry + slice
+            # checkpoints. Size it above the worst healthy slice wall time.
+            - name: GORDO_SLICE_TIMEOUT_S
+              value: "{{ slice_timeout_s }}"
 {% endif %}
           resources:
             limits: {"google.com/tpu": {{ tpu_chips }}}
@@ -282,6 +309,7 @@ def generate_tpu_job(
     register_dir: str = "/gordo/registry",
     tpu_chips: int = 16,
     hosts: int = 1,
+    slice_timeout_s: int = 1800,
 ) -> str:
     """TPU-native emitter: one fleet-build Job + one multi-model server
     Deployment for the entire fleet.
@@ -290,7 +318,12 @@ def generate_tpu_job(
     Service plus an Indexed Job (one pod per TPU host) whose pods derive
     ``GORDO_PROCESS_ID`` from their completion index and join the
     jax.distributed runtime at pod 0 — the k8s wiring for
-    ``fleet-build --coordinator-address``."""
+    ``fleet-build --coordinator-address``. Multi-host pods also carry
+    ``GORDO_SLICE_TIMEOUT_S`` (``slice_timeout_s``, default 30 min): the
+    in-process slice watchdog that turns a wedged collective (a dead peer
+    the transport can't see) into the retryable exit 75 the Job's
+    backoffLimit can act on, instead of a forever-Running pod no liveness
+    probe can tell from slow training."""
     if not isinstance(config, NormalizedConfig):
         config = NormalizedConfig(config)
     if hosts < 1:
@@ -302,6 +335,7 @@ def generate_tpu_job(
         register_dir=register_dir,
         tpu_chips=tpu_chips,
         hosts=hosts,
+        slice_timeout_s=slice_timeout_s,
     )
 
 

@@ -129,6 +129,27 @@ def test_tpu_job_multihost_golden():
     assert env["GORDO_NUM_PROCESSES"]["value"] == "4"
     assert "job-completion-index" in str(env["GORDO_PROCESS_ID"])
     assert svc["metadata"]["name"] in env["GORDO_COORDINATOR"]["value"]
+    # the slice watchdog rides the Job spec: a wedged collective exits
+    # retryable-75 for backoffLimit to restart instead of hanging the pod
+    assert env["GORDO_SLICE_TIMEOUT_S"]["value"] == "1800"
+    # ... and the Job's podFailurePolicy makes the exit-code contract
+    # real: 75 restarts without burning backoffLimit, 64/66 fail the Job
+    rules = job["spec"]["podFailurePolicy"]["rules"]
+    by_action = {r["action"]: r["onExitCodes"]["values"] for r in rules}
+    assert by_action["Ignore"] == [75]
+    assert sorted(by_action["FailJob"]) == [64, 66]
+    # a wedge event costs up to `hosts` pod failures, so the budget scales
+    assert job["spec"]["backoffLimit"] == 12
+    custom = generate_tpu_job(
+        FLEET_YAML, tpu_chips=8, hosts=4, slice_timeout_s=300
+    )
+    env2 = {
+        e["name"]: e
+        for d in yaml.safe_load_all(custom)
+        if d and d["kind"] == "Job"
+        for e in d["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env2["GORDO_SLICE_TIMEOUT_S"]["value"] == "300"
 
     with pytest.raises(ValueError, match="hosts"):
         generate_tpu_job(FLEET_YAML, hosts=0)
