@@ -13,6 +13,7 @@ TAG=${1:-r4}
 OUT=docs/measurements
 STAMP=$(mktemp)  # artifacts older than the wrapper (e.g. a committed run
                  # from an earlier session) must not satisfy the latch
+trap 'rm -f "$STAMP"' EXIT
 while true; do
   POLL_S=${POLL_S:-300} bash tools/tunnel_watch.sh || exit 1  # deadline hit
   echo "$(date -Is) tunnel live -> runbook" >> tools/tunnel_watch.log
