@@ -161,11 +161,11 @@ def build_hetero_mode(output_dir: str) -> None:
     )
 
 
-def build_crash_mode(output_dir: str) -> None:
-    """Run build_mode but die (every process) immediately after the FIRST
-    slice's collective checkpoint save completes — before any artifact
-    lands. The follow-up normal build must then RESTORE that slice instead
-    of retraining (kill-mid-build resume, multi-host edition)."""
+def _install_crash_after_first_checkpoint() -> None:
+    """Monkeypatch shared by the crash drills: every process dies (exit 17,
+    sentinel printed) immediately after the FIRST slice's collective
+    checkpoint save is durable — before any artifact lands. That is the
+    crash window the restore-instead-of-retrain tests pin."""
     import importlib
 
     # NB: `from ..parallel import build_fleet` would bind the FUNCTION the
@@ -176,12 +176,18 @@ def build_crash_mode(output_dir: str) -> None:
 
     def save_then_die(self, key, result):
         orig(self, key, result)
-        self._ckptr.wait_until_finished()  # the ckpt must be durable —
-        # that's the crash window this test pins
+        self._ckptr.wait_until_finished()  # the ckpt must be durable
         print("crashed-after-checkpoint", flush=True)
         os._exit(17)
 
     bf._SliceCheckpointer.save_async = save_then_die
+
+
+def build_crash_mode(output_dir: str) -> None:
+    """build_mode under the crash-after-checkpoint drill: the follow-up
+    normal build must RESTORE the checkpointed slice instead of retraining
+    (kill-mid-build resume, multi-host edition)."""
+    _install_crash_after_first_checkpoint()
     build_mode(output_dir)
 
 
@@ -207,6 +213,15 @@ def build_asym_crash_mode(output_dir: str) -> None:
 
     bf._SliceWatchdog.start = start_or_die
     build_mode(output_dir)
+
+
+def build_hetero_crash_mode(output_dir: str) -> None:
+    """The crash-after-checkpoint drill composed with the THREE-bucket
+    heterogeneous fleet — the restore path exercised against a checkpoint
+    whose sharded template comes from a mixed bucket-shape fleet, not just
+    the homogeneous one build_crash_mode covers."""
+    _install_crash_after_first_checkpoint()
+    build_hetero_mode(output_dir)
 
 
 def build_hang_mode(output_dir: str) -> None:
@@ -310,6 +325,9 @@ def main() -> None:
         return
     if len(sys.argv) >= 6 and sys.argv[4] == "--build-hang":
         build_hang_mode(sys.argv[5])
+        return
+    if len(sys.argv) >= 6 and sys.argv[4] == "--build-hetero-crash":
+        build_hetero_crash_mode(sys.argv[5])
         return
     if len(sys.argv) >= 6 and sys.argv[4] == "--build-hetero":
         build_hetero_mode(sys.argv[5])
