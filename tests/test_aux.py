@@ -156,7 +156,10 @@ def _run_two_process_children(extra_argv, timeout, extra_env=None):
         **(extra_env or {}),
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        "JAX_COMPILATION_CACHE_DIR": _jax.config.jax_compilation_cache_dir,
+        # None when the parent runs cacheless (GORDO_TEST_NO_COMPILE_CACHE)
+        "JAX_COMPILATION_CACHE_DIR": (
+            _jax.config.jax_compilation_cache_dir or ""
+        ),
     }
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))

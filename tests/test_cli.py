@@ -142,7 +142,9 @@ def test_cli_fleet_build(runner, tmp_path):
 def _jax_cache_dir():
     import jax as _jax
 
-    return _jax.config.jax_compilation_cache_dir
+    # empty string when the parent runs cacheless (children treat "" as
+    # unset) — None would crash subprocess env construction
+    return _jax.config.jax_compilation_cache_dir or ""
 
 
 def test_cli_build_commands_enable_compile_cache(runner, tmp_path, monkeypatch):
