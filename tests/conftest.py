@@ -33,8 +33,12 @@ if os.environ.get("GORDO_TEST_NO_COMPILE_CACHE", "0") != "1":
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
 else:
     # a shell-profile JAX_COMPILATION_CACHE_DIR would silently re-enable
-    # the cache jax-side and void the isolation experiment
+    # the cache jax-side and void the isolation experiment — as would the
+    # slow CLI build tests, whose commands call the product's
+    # enable_persistent_compile_cache (GORDO_COMPILE_CACHE=off is that
+    # helper's own documented opt-out)
     os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    os.environ["GORDO_COMPILE_CACHE"] = "off"
     jax.config.update("jax_compilation_cache_dir", None)
 
 import numpy as np

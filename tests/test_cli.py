@@ -161,6 +161,10 @@ def test_cli_build_commands_enable_compile_cache(runner, tmp_path, monkeypatch):
         "enable_persistent_compile_cache",
         lambda cache_dir=None: calls.append(cache_dir) or str(cache_dir),
     )
+    # a cacheless diagnostic run (conftest's GORDO_TEST_NO_COMPILE_CACHE
+    # branch) exports GORDO_COMPILE_CACHE=off, which would short-circuit
+    # the default-derivation this test pins
+    monkeypatch.delenv("GORDO_COMPILE_CACHE", raising=False)
     out = str(tmp_path / "models")
     bad = ["--machine-config", "{not valid", "--output-dir", out]
     assert runner.invoke(gordo, ["fleet-build", *bad]).exit_code != 0
