@@ -519,6 +519,8 @@ def test_enable_persistent_compile_cache_respects_existing_dir():
         enable_persistent_compile_cache,
     )
 
+    if os.environ.get("GORDO_TEST_NO_COMPILE_CACHE", "0") == "1":
+        pytest.skip("cacheless diagnostic run: conftest pinned no dir")
     before = _jax.config.jax_compilation_cache_dir
     assert before  # conftest pinned tests/.jax_compilation_cache
     assert enable_persistent_compile_cache() == before
