@@ -122,11 +122,16 @@ def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> str:
     compile of a windowed fleet program costs tens of seconds to tens of
     minutes, and the driver's round-end ``bench.py`` run repeats the exact
     programs the operator's runbook just compiled. Safe to call multiple
-    times; a no-op if the operator already pinned a cache dir."""
+    times; a no-op if the operator already pinned a cache dir, and fully
+    disabled (returns "") when ``GORDO_COMPILE_CACHE=off`` — the global
+    opt-out every entry point honors (the cacheless test suite mode
+    depends on in-process ``bench.main()`` calls honoring it too)."""
     import os
 
     import jax
 
+    if os.environ.get("GORDO_COMPILE_CACHE") == "off":
+        return ""
     if jax.config.jax_compilation_cache_dir:
         return jax.config.jax_compilation_cache_dir
     if cache_dir is None:
