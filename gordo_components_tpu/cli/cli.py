@@ -94,10 +94,14 @@ def _enable_build_compile_cache(output_dir: str, cache_dir) -> None:
 
     from ..utils.backend import enable_persistent_compile_cache
 
-    if cache_dir == "off":
-        return
+    # click already resolved flag-vs-env precedence into cache_dir; pass
+    # it through explicitly ("off" included — the helper disables and
+    # clears any env-sourced active config), defaulting only a fully
+    # unset knob to the output-dir-local cache
     enable_persistent_compile_cache(
-        cache_dir or os.path.join(output_dir, ".jax_compilation_cache")
+        cache_dir
+        if cache_dir is not None
+        else os.path.join(output_dir, ".jax_compilation_cache")
     )
 
 

@@ -122,15 +122,24 @@ def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> str:
     compile of a windowed fleet program costs tens of seconds to tens of
     minutes, and the driver's round-end ``bench.py`` run repeats the exact
     programs the operator's runbook just compiled. Safe to call multiple
-    times; a no-op if the operator already pinned a cache dir, and fully
-    disabled (returns "") when ``GORDO_COMPILE_CACHE=off`` — the global
-    opt-out every entry point honors (the cacheless test suite mode
-    depends on in-process ``bench.main()`` calls honoring it too)."""
+    times; a no-op if the operator already pinned a cache dir.
+
+    ``GORDO_COMPILE_CACHE`` is the entry-point-wide env knob, with the
+    same semantics the CLI flag gives it: a directory pins the cache
+    location, ``off`` disables caching entirely (returns "" and clears
+    even an env-var-sourced active config, so the cacheless segfault-
+    isolation mode holds outside pytest too). An EXPLICIT ``cache_dir``
+    argument always beats the env var — a caller that resolved its own
+    precedence (click: flag beats envvar) must not be second-guessed."""
     import os
 
     import jax
 
-    if os.environ.get("GORDO_COMPILE_CACHE") == "off":
+    if cache_dir is None:
+        cache_dir = os.environ.get("GORDO_COMPILE_CACHE") or None
+    if cache_dir == "off":
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        jax.config.update("jax_compilation_cache_dir", None)
         return ""
     if jax.config.jax_compilation_cache_dir:
         return jax.config.jax_compilation_cache_dir

@@ -185,7 +185,10 @@ def test_cli_build_commands_enable_compile_cache(runner, tmp_path, monkeypatch):
         ).exit_code
         != 0
     )
-    assert calls == []
+    # "off" is passed THROUGH to the helper (which disables and clears any
+    # env-sourced active config), not swallowed CLI-side
+    assert calls == ["off"]
+    calls.clear()
     # the single-machine build command wires the same helper
     assert (
         runner.invoke(
