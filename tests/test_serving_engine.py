@@ -207,17 +207,28 @@ def test_forecast_horizon_parity():
     )
 
 
-@pytest.mark.slow
-def test_target_subset_parity():
-    """A target_tag_list machine (T-of-F subset targets) lifts into the
-    engine when the target→input column mapping is provided, with exact
-    host-path parity against anomaly(X, y=X[:, cols])."""
-    cols = [1, 3]
+_SUBSET_COLS = [1, 3]
+
+
+@pytest.fixture(scope="module")
+def fitted_subset():
+    """A target_tag_list machine (targets = input cols 1,3 of 5) + its
+    training data — shared by the host-parity and shard-parity tests."""
     rng = np.random.default_rng(10)
     X = rng.normal(size=(160, 5)).astype(np.float32) * 3 + 5
     model = pipeline_from_definition(_anomaly_config())
-    model.cross_validate(X, X[:, cols], n_splits=2)
-    model.fit(X, X[:, cols])
+    model.cross_validate(X, X[:, _SUBSET_COLS], n_splits=2)
+    model.fit(X, X[:, _SUBSET_COLS])
+    return model, X
+
+
+@pytest.mark.slow
+def test_target_subset_parity(fitted_subset):
+    """A target_tag_list machine (T-of-F subset targets) lifts into the
+    engine when the target→input column mapping is provided, with exact
+    host-path parity against anomaly(X, y=X[:, cols])."""
+    cols = _SUBSET_COLS
+    model, X = fitted_subset
     engine = ServingEngine({"sub": model}, target_cols={"sub": cols})
     assert engine.can_score("sub"), engine.stats()["host_path_machines"]
     scored = engine.anomaly("sub", X)
@@ -474,3 +485,37 @@ def test_engine_warmup_compiles_bucket_programs(fitted_pair):
     before = engine.stats()["compiled_programs"]
     engine.warmup()
     assert engine.stats()["compiled_programs"] == before
+
+
+@pytest.mark.slow
+def test_mesh_sharded_engine_forecast_and_target_subset_parity(fitted_subset):
+    """Capacity mode x the non-reconstruction lifts: a multi-step forecast
+    machine and a target_tag_list machine served from MESH-SHARDED stacked
+    params must match their replicated-engine scores exactly — the
+    per-machine gather must compose with the windowed forecast program and
+    with the per-machine target-column gather, not just with the dense
+    reconstruction path the existing shard-parity test covers."""
+    from gordo_components_tpu.parallel.mesh import fleet_mesh
+
+    horizon = 2
+    fmodel, fX = _fit(_forecast_config(horizon), n_rows=96, seed=9)
+    smodel, sX = fitted_subset
+
+    models = {"fc": fmodel, "sub": smodel}
+    target_cols = {"sub": _SUBSET_COLS}
+    sharded = ServingEngine(models, mesh=fleet_mesh(8), target_cols=target_cols)
+    plain = ServingEngine(models, target_cols=target_cols)
+    assert sharded.can_score("fc") and sharded.can_score("sub"), (
+        sharded.stats()["host_path_machines"]
+    )
+    # the lifts must really be running sharded, or parity is vacuous
+    for bucket in sharded._buckets:
+        leaf = jax.tree_util.tree_leaves(bucket.stacked)[0]
+        assert len(leaf.sharding.device_set) == 8, bucket.names
+    for name, X in (("fc", fX), ("sub", sX)):
+        a = sharded.anomaly(name, X)
+        b = plain.anomaly(name, X)
+        np.testing.assert_allclose(a.model_output, b.model_output, atol=1e-5)
+        np.testing.assert_allclose(
+            a.total_anomaly_score, b.total_anomaly_score, atol=1e-4
+        )
