@@ -405,6 +405,7 @@ def _measure_serving(degraded: bool) -> Dict[str, Any]:
         "end_to_end_p99_ms",
         "concurrent_rps",
         "shard_mesh_devices",
+        "hot_machine_p50_ms",
     )
     if len(jax.devices()) > 1:
         try:

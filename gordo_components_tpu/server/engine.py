@@ -36,7 +36,9 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import os
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
@@ -145,11 +147,25 @@ class _Bucket:
         max_batch: int,
         mesh=None,
         dispatch_lock: Optional[threading.Lock] = None,
+        hot_cap: int = 0,
     ):
         self.apply_fn = apply_fn
         self.lookback = lookback
         self.lookahead = lookahead
         self.max_batch = max_batch
+        # shard-mode hot-machine cache (ROADMAP #3): up to ``hot_cap``
+        # recently-hot machines keep an UNSHARDED device copy of their
+        # slice of the stacked tree, scored through a replicated program —
+        # skipping the per-dispatch cross-device gather AND the process-
+        # global shard dispatch lock. All state below is touched only by
+        # the leader thread inside _process (the _busy latch serializes
+        # leaders per bucket), so no extra lock is needed. Memory cost is
+        # hot_cap x one machine's params — negligible next to the sharded
+        # stack capacity mode exists for.
+        self._hot_cap = int(hot_cap) if mesh is not None else 0
+        self._hot: "OrderedDict[int, Any]" = OrderedDict()
+        self._hot_hits: Dict[int, int] = {}
+        self.hot_request_count = 0
         # shard mode: sharded executions contain collectives whose
         # in-process rendezvous (CPU backend) must not interleave across
         # concurrent dispatches — the engine hands every bucket ONE lock
@@ -196,7 +212,9 @@ class _Bucket:
             if self._fleet_sharding is None
             else jax.device_put(stacked, self._fleet_sharding)
         )
-        self._programs: Dict[Tuple[int, int], Any] = {}
+        # (rows, k) -> stacked gather-by-idx program;
+        # ("hot", rows, k) -> unsharded hot-machine program
+        self._programs: Dict[Tuple[Any, ...], Any] = {}
         self._cond = threading.Condition()
         self._busy = False
         self._pending: Dict[int, List[_Item]] = {}
@@ -207,15 +225,14 @@ class _Bucket:
         self.max_batch_seen = 0
 
     # -- compiled programs ---------------------------------------------------
-    def _program(self, rows: int, k: int):
-        key = (rows, k)
-        program = self._programs.get(key)
-        if program is not None:
-            return program
+    def _machine_score_fn(self):
+        """The per-machine scoring math, closed over this bucket's
+        architecture — shared by the stacked (gather-by-idx) program and
+        the hot-cache (unsharded machine tree) program so they cannot
+        drift numerically."""
         L, la, apply_fn = self.lookback, self.lookahead, self.apply_fn
 
-        def score_one(stacked, idx, x):
-            machine = jax.tree_util.tree_map(lambda a: a[idx], stacked)
+        def machine_score(machine, x):
             xs = x * machine["sx"].scale + machine["sx"].offset
             if la is None:
                 inputs = xs
@@ -236,6 +253,19 @@ class _Bucket:
             total = jnp.linalg.norm(scaled, axis=-1)
             return x_tail, pred_raw, scaled, total
 
+        return machine_score
+
+    def _program(self, rows: int, k: int):
+        key = (rows, k)
+        program = self._programs.get(key)
+        if program is not None:
+            return program
+        machine_score = self._machine_score_fn()
+
+        def score_one(stacked, idx, x):
+            machine = jax.tree_util.tree_map(lambda a: a[idx], stacked)
+            return machine_score(machine, x)
+
         vmapped = jax.vmap(score_one, in_axes=(None, 0, 0))
         if self._fleet_sharding is None:
             program = jax.jit(vmapped)
@@ -250,6 +280,33 @@ class _Bucket:
             )
         self._programs[key] = program
         return program
+
+    def _hot_program(self, rows: int, k: int):
+        """Replicated program for hot-cached machines: one UNSHARDED
+        machine tree + a (k, rows, F) request stack — no cross-device
+        gather, no collectives, no shard dispatch lock."""
+        key = ("hot", rows, k)
+        program = self._programs.get(key)
+        if program is None:
+            program = jax.jit(
+                jax.vmap(self._machine_score_fn(), in_axes=(None, 0))
+            )
+            self._programs[key] = program
+        return program
+
+    def _gather_machine(self, idx: int):
+        """One machine's slice of the sharded stack, pulled to host and
+        re-placed as an unsharded device tree (the one-time promotion cost
+        a hot machine pays to skip the per-dispatch gather). Indexing a
+        sharded array dispatches a multi-device resharding program, so the
+        pull runs under the process-global shard dispatch lock — another
+        bucket's (or engine generation's) concurrent sharded execution
+        must never interleave its collective rendezvous with this one."""
+        with self._dispatch_lock or contextlib.nullcontext():
+            host_tree = jax.tree_util.tree_map(
+                lambda a: np.asarray(a[idx]), self.stacked
+            )
+        return jax.device_put(host_tree)
 
     # -- request path --------------------------------------------------------
     def submit(self, idx: int, x: np.ndarray, m_valid: int) -> ScoreResult:
@@ -290,7 +347,53 @@ class _Bucket:
         assert item.result is not None
         return item.result
 
+    # a drained batch spanning more distinct hot machines than this scores
+    # through ONE sharded dispatch instead: per-machine hot dispatches are
+    # only a win while they don't fragment the micro-batch (k sequential
+    # k=1 programs would regress concurrent throughput below the uncached
+    # path for spread-out traffic; the cache's design case is concentrated
+    # repeat-machine load)
+    _HOT_GROUP_LIMIT = 2
+
     def _process(self, rows: int, items: List[_Item]) -> None:
+        if not self._hot_cap:
+            return self._process_cold(rows, items)
+        # shard mode with a hot cache: requests for hot machines skip the
+        # gather-carrying sharded program (and its process-global lock)
+        by_idx: Dict[int, List[_Item]] = {}
+        for it in items:
+            if it.idx in self._hot:
+                by_idx.setdefault(it.idx, []).append(it)
+        if len(by_idx) > self._HOT_GROUP_LIMIT:
+            return self._process_cold(rows, items)  # keep ONE dispatch
+        cold = [it for it in items if it.idx not in self._hot]
+        for idx, group in by_idx.items():
+            self._process_hot(rows, idx, group)
+        if cold:
+            self._process_cold(rows, cold)
+
+    def _process_hot(self, rows: int, idx: int, items: List[_Item]) -> None:
+        try:
+            tree = self._hot[idx]
+            self._hot.move_to_end(idx)  # LRU touch
+            k = len(items)
+            kb = _round_up_pow2(k)
+            xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
+            program = self._hot_program(rows, kb)
+            x_tail, pred, scaled, total = jax.device_get(program(tree, xs))
+            self.dispatch_count += 1
+            self.request_count += k
+            self.hot_request_count += k
+            self.max_batch_seen = max(self.max_batch_seen, k)
+            self._fill_results(items, x_tail, pred, scaled, total)
+        except BaseException as exc:  # surface on every waiting thread
+            for it in items:
+                it.error = exc
+        finally:
+            for it in items:
+                it.done.set()
+
+    def _process_cold(self, rows: int, items: List[_Item]) -> None:
         try:
             k = len(items)
             kb = _round_up_pow2(k)
@@ -306,20 +409,54 @@ class _Bucket:
             self.dispatch_count += 1
             self.request_count += k
             self.max_batch_seen = max(self.max_batch_seen, k)
-            for i, it in enumerate(items):
-                m = it.m_valid
-                it.result = ScoreResult(
-                    model_input=x_tail[i][:m],
-                    model_output=pred[i][:m],
-                    tag_anomaly_scores=scaled[i][:m],
-                    total_anomaly_score=total[i][:m],
-                )
+            self._fill_results(items, x_tail, pred, scaled, total)
         except BaseException as exc:  # surface on every waiting thread
             for it in items:
                 it.error = exc
         finally:
             for it in items:
                 it.done.set()
+        # OUTSIDE the scoring try/finally: these requests already scored —
+        # a failed promotion (e.g. no HBM headroom for the unsharded copy;
+        # capacity mode exists because the fleet is big) must never turn
+        # their success into client errors. Logged, and retried naturally
+        # by the next cold hit.
+        if items and items[0].error is None:
+            try:
+                self._maybe_promote(items)
+            except Exception:
+                logger.exception(
+                    "hot-cache promotion failed (serving unaffected)"
+                )
+
+    @staticmethod
+    def _fill_results(items, x_tail, pred, scaled, total) -> None:
+        for i, it in enumerate(items):
+            m = it.m_valid
+            it.result = ScoreResult(
+                model_input=x_tail[i][:m],
+                model_output=pred[i][:m],
+                tag_anomaly_scores=scaled[i][:m],
+                total_anomaly_score=total[i][:m],
+            )
+
+    def _maybe_promote(self, items: List[_Item]) -> None:
+        """After a successful cold dispatch: machines scoring their 2nd+
+        cold request get an unsharded hot copy; LRU eviction bounds the
+        cache. Runs on the leader thread only (see __init__); the gather
+        itself takes the shard dispatch lock (see _gather_machine)."""
+        if not self._hot_cap:
+            return
+        for idx in {it.idx for it in items}:
+            hits = self._hot_hits.get(idx, 0) + 1
+            self._hot_hits[idx] = hits
+            if hits >= 2 and idx not in self._hot:
+                self._hot[idx] = self._gather_machine(idx)
+                while len(self._hot) > self._hot_cap:
+                    evicted, _ = self._hot.popitem(last=False)
+                    # evicted machines must re-earn promotion, or the next
+                    # cold hit would instantly thrash them back in
+                    self._hot_hits.pop(evicted, None)
 
 
 class ServingEngine:
@@ -350,8 +487,17 @@ class ServingEngine:
         max_rows_dispatch: int = 8192,
         target_cols: Optional[Dict[str, Optional[List[int]]]] = None,
         mesh=None,
+        hot_cap: Optional[int] = None,
     ):
         self.mesh = mesh
+        # shard mode only: machines scoring repeatedly keep an unsharded
+        # device copy of their params, skipping the per-dispatch
+        # cross-device gather (ROADMAP #3). Default 16, env-tunable;
+        # 0 disables. Ignored without a mesh (replicated engines have no
+        # gather to skip).
+        if hot_cap is None:
+            hot_cap = int(os.environ.get("GORDO_SERVE_HOT_CACHE", "16"))
+        self.hot_cap = max(0, hot_cap)
         # the PROCESS-global lock in shard mode (see its definition): all
         # buckets of all engine generations serialize sharded dispatches
         self._shard_dispatch_lock = (
@@ -460,6 +606,7 @@ class ServingEngine:
                 max_batch=max_batch,
                 mesh=mesh,
                 dispatch_lock=self._shard_dispatch_lock,
+                hot_cap=self.hot_cap,
             )
             self._buckets.append(bucket)
             for i, (_, entry) in enumerate(members):
@@ -588,4 +735,10 @@ class ServingEngine:
             # 0 = single-device replicated (latency mode); >0 = stacked
             # params sharded over that many devices (capacity mode)
             "shard_mesh_devices": self.mesh.size if self.mesh else 0,
+            # shard-mode hot cache: machines currently holding an unsharded
+            # device copy, and requests that skipped the sharded gather
+            "hot_machines": sum(len(b._hot) for b in self._buckets),
+            "hot_requests": sum(
+                b.hot_request_count for b in self._buckets
+            ),
         }

@@ -519,3 +519,49 @@ def test_mesh_sharded_engine_forecast_and_target_subset_parity(fitted_subset):
         np.testing.assert_allclose(
             a.total_anomaly_score, b.total_anomaly_score, atol=1e-4
         )
+
+
+@pytest.mark.slow
+def test_mesh_sharded_hot_cache_promotes_and_matches(fitted_pair):
+    """ROADMAP #3: shard-mode hot-machine cache. A machine's 2nd cold
+    request promotes an unsharded device copy; later requests score
+    through the replicated hot program with scores IDENTICAL to the
+    sharded path, stats expose the cache, and a cap of 1 LRU-evicts."""
+    from gordo_components_tpu.parallel.mesh import fleet_mesh
+
+    models = {name: m for name, (m, _) in fitted_pair.items()}  # 2 machines
+    engine = ServingEngine(models, mesh=fleet_mesh(8), hot_cap=1)
+    plain = ServingEngine(models)
+    (n1, (_, X1)), (n2, (_, X2)) = sorted(fitted_pair.items())
+
+    cold = engine.anomaly(n1, X1)  # hit 1: cold
+    assert engine.stats()["hot_machines"] == 0
+    engine.anomaly(n1, X1)  # hit 2: cold, then promoted
+    assert engine.stats()["hot_machines"] == 1
+    hot = engine.anomaly(n1, X1)  # served from the hot copy
+    stats = engine.stats()
+    assert stats["hot_requests"] == 1
+    np.testing.assert_allclose(
+        hot.total_anomaly_score, cold.total_anomaly_score, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        hot.total_anomaly_score,
+        plain.anomaly(n1, X1).total_anomaly_score,
+        atol=1e-4,
+    )
+
+    # cap=1: promoting the second machine evicts the first (LRU)
+    engine.anomaly(n2, X2)
+    engine.anomaly(n2, X2)
+    assert engine.stats()["hot_machines"] == 1
+    engine.anomaly(n2, X2)
+    assert engine.stats()["hot_requests"] == 2
+    # the evicted machine re-earns promotion from zero hits
+    engine.anomaly(n1, X1)
+    assert engine.stats()["hot_machines"] == 1  # still only n2 hot
+    engine.anomaly(n1, X1)  # 2nd post-eviction cold hit -> promoted again
+    final = engine.anomaly(n1, X1)
+    np.testing.assert_allclose(
+        final.total_anomaly_score, cold.total_anomaly_score, atol=1e-6
+    )
+    assert engine.stats()["hot_requests"] == 3
