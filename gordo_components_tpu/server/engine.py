@@ -372,6 +372,13 @@ class _Bucket:
         if cold:
             self._process_cold(rows, cold)
 
+    def _account(self, k: int, hot: bool = False) -> None:
+        self.dispatch_count += 1
+        self.request_count += k
+        if hot:
+            self.hot_request_count += k
+        self.max_batch_seen = max(self.max_batch_seen, k)
+
     def _process_hot(self, rows: int, idx: int, items: List[_Item]) -> None:
         try:
             tree = self._hot[idx]
@@ -381,10 +388,7 @@ class _Bucket:
             xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
             program = self._hot_program(rows, kb)
             x_tail, pred, scaled, total = jax.device_get(program(tree, xs))
-            self.dispatch_count += 1
-            self.request_count += k
-            self.hot_request_count += k
-            self.max_batch_seen = max(self.max_batch_seen, k)
+            self._account(k, hot=True)
             self._fill_results(items, x_tail, pred, scaled, total)
         except BaseException as exc:  # surface on every waiting thread
             for it in items:
@@ -406,9 +410,7 @@ class _Bucket:
                 x_tail, pred, scaled, total = jax.device_get(
                     program(self.stacked, idxs, xs)
                 )
-            self.dispatch_count += 1
-            self.request_count += k
-            self.max_batch_seen = max(self.max_batch_seen, k)
+            self._account(k)
             self._fill_results(items, x_tail, pred, scaled, total)
         except BaseException as exc:  # surface on every waiting thread
             for it in items:
