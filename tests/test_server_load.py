@@ -58,12 +58,27 @@ ANOMALY_MODEL = {
 }
 
 
-@pytest.fixture(scope="module")
-def live_server(tmp_path_factory):
+import contextlib
+
+
+@contextlib.contextmanager
+def _serve(app):
     """The production server object (threaded werkzeug, like run_server's
     run_simple(threaded=True)) on a real ephemeral socket."""
     from werkzeug.serving import make_server
 
+    server = make_server("127.0.0.1", 0, app, threaded=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield server.server_port
+    finally:
+        server.shutdown()
+        thread.join(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def live_server(tmp_path_factory):
     root = tmp_path_factory.mktemp("served-load")
     model_dir = provide_saved_model(
         "machine-a",
@@ -73,14 +88,8 @@ def live_server(tmp_path_factory):
         evaluation_config={"cv_mode": "build_only"},
     )
     app = build_app({"machine-a": model_dir}, project="proj", models_root=str(root))
-    server = make_server("127.0.0.1", 0, app, threaded=True)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
-    thread.start()
-    try:
-        yield {"port": server.server_port, "app": app, "root": root}
-    finally:
-        server.shutdown()
-        thread.join(timeout=10)
+    with _serve(app) as port:
+        yield {"port": port, "app": app, "root": root}
 
 
 def _post_scores(port: int, rows: int = 24, timeout: float = 30.0):
@@ -208,3 +217,36 @@ def test_reload_during_traffic_never_fails_requests(live_server):
             t.join(timeout=30)
     assert not failures, f"requests failed during reload: {failures[:3]}"
     assert len(completed) >= 4  # traffic genuinely overlapped the reloads
+
+
+def test_shard_fleet_hot_cache_engages_over_http(live_server, monkeypatch):
+    """The HBM capacity mode's hot-machine cache through the REAL HTTP
+    stack: a sharded server receiving repeat-machine traffic must promote
+    the machine after its 2nd cold request and serve the rest from the
+    unsharded hot copy — visible in /metrics, with responses numerically
+    matching the replicated server's (within float tolerance — different
+    program, same math)."""
+    monkeypatch.setenv("GORDO_SERVE_HOT_CACHE", "16")  # hermetic: a CI
+    # env exporting 0 would silently disable the behavior under test
+    root = live_server["root"]
+    app = build_app(
+        {"machine-a": str(root / "machine-a")},
+        project="proj",
+        models_root=str(root),
+        shard_fleet=True,
+    )
+    with _serve(app) as port:
+        payloads = [
+            _post_scores(port) for _ in range(6)
+        ]  # 2 cold -> promote -> 4 hot
+        assert all(status == 200 for status, _, _ in payloads)
+        stats = app.engine.stats()
+        assert stats["shard_mesh_devices"] == 8
+        assert stats["hot_machines"] == 1
+        assert stats["hot_requests"] >= 4
+        _, _, sharded_body = payloads[-1]
+        status, _, plain_body = _post_scores(live_server["port"])
+        assert status == 200
+        sharded_total = json.loads(sharded_body)["data"]["total-anomaly-score"]
+        plain_total = json.loads(plain_body)["data"]["total-anomaly-score"]
+        np.testing.assert_allclose(sharded_total, plain_total, atol=1e-5)
