@@ -10,7 +10,7 @@ CPUs, or a v5e-16.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import numpy as np
