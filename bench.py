@@ -46,9 +46,11 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus a
 
 Env overrides: BENCH_MACHINES (128), BENCH_EPOCHS (10), BENCH_FULL (0),
 BENCH_CPU (0), BENCH_CONFIGS (comma list to restrict), BENCH_CV_PARALLEL
-(unset; 0|1 pins the fold-execution mode for windowed configs — set to 0
-by the runbook's compile canary when the vmapped-CV windowed compile is
-measured-pathological on XLA:TPU), BENCH_NO_SERVING (0), BENCH_PLANT (0).
+(0|1 pins the fold-execution mode for windowed configs; UNSET they
+default to scan CV on TPU — the only mode with a measured-sane TPU
+compile — and to the derived vmap default on CPU. The runbook's compile
+canary exports =1 when it PROVES the vmapped-CV compile is fine on the
+live chip), BENCH_NO_SERVING (0), BENCH_PLANT (0).
 """
 
 from __future__ import annotations
@@ -204,18 +206,28 @@ def _flops_of(compiled) -> Optional[float]:
 
 def _cv_parallel_override(analyzed) -> Optional[bool]:
     """The fold-execution pin for this config, or None for the derived
-    default. BENCH_CV_PARALLEL=0|1 pins the mode for WINDOWED configs only
-    (``estimator.lookahead is not None`` — the same bit ``_make_spec``
-    validates ``input_kind`` against); flat configs are never touched,
-    their small-MLP step bodies compile fine under vmap CV. The runbook's
-    compile canary (tools/tpu_isolate.py) sets 0 when the vmapped-CV
-    windowed program is measured-pathological to compile on the live
-    XLA:TPU backend, so a scarce tunnel session still gets scan-CV numbers
-    instead of burning ~25 min/config on compiles."""
+    default. Applies to WINDOWED configs only (``estimator.lookahead is
+    not None`` — the same bit ``_make_spec`` validates ``input_kind``
+    against); flat configs are never touched, their small-MLP step bodies
+    compile fine under vmap CV.
+
+    BENCH_CV_PARALLEL=0|1 pins the mode explicitly. UNSET on a TPU
+    backend, windowed configs default to the SEQUENTIAL scan — the only
+    fold-execution mode with a measured-sane TPU compile time (28.7 s;
+    whether vmapped CV alone shares the unroll blowup, 1505.7 s measured
+    for the pair, is unresolved until tools/tpu_isolate.py's canary
+    passes on a live tunnel, and the driver's unattended round-end bench
+    must never gamble 25 min/config on it). The runbook exports
+    BENCH_CV_PARALLEL=1 when the canary PROVES vmap-CV compiles fine
+    (the canary's own compile then sits warm in the persistent cache).
+    On CPU the derived default (vmap) stands — all knob combinations
+    compile in 16-27 s there."""
     cv_env = os.environ.get("BENCH_CV_PARALLEL")
-    if cv_env is None or analyzed.estimator.lookahead is None:
+    if analyzed.estimator.lookahead is None:
         return None
-    return cv_env == "1"
+    if cv_env is not None:
+        return cv_env == "1"
+    return False if jax.default_backend() == "tpu" else None
 
 
 def _bench_config(name: str, cfg: Dict[str, Any]) -> Dict[str, Any]:

@@ -125,11 +125,11 @@ def test_all_bench_configs_build_specs():
 
 
 def test_bench_cv_parallel_env_pins_windowed_configs_only(monkeypatch):
-    """BENCH_CV_PARALLEL=0 (set by the runbook's compile canary when the
-    vmapped-CV windowed program is measured-pathological on XLA:TPU) must
-    flip windowed configs to scan CV while leaving flat configs on their
-    derived vmap default — exercised through the same helper
-    ``_bench_config`` calls."""
+    """The fold-execution knob, exercised through the same helper
+    ``_bench_config`` calls: explicit BENCH_CV_PARALLEL=0|1 pins windowed
+    configs (flat configs never touched); unset, windowed configs take
+    the derived vmap default on CPU but the known-good scan default on a
+    TPU backend, where only the canary's explicit =1 unlocks vmap."""
     import sys
 
     sys.path.insert(0, _REPO_ROOT)
@@ -155,10 +155,19 @@ def test_bench_cv_parallel_env_pins_windowed_configs_only(monkeypatch):
         )
 
     monkeypatch.delenv("BENCH_CV_PARALLEL", raising=False)
-    assert spec_of("lstm_ae_50tag").cv_parallel is True  # derived default
+    assert spec_of("lstm_ae_50tag").cv_parallel is True  # CPU: derived
     monkeypatch.setenv("BENCH_CV_PARALLEL", "0")
     assert spec_of("dense_ae_10tag").cv_parallel is True  # flat: untouched
     assert spec_of("lstm_ae_50tag").cv_parallel is False  # windowed: pinned
+    # unset on a TPU backend: windowed configs take the known-good scan
+    # default — the driver's unattended bench must never gamble on the
+    # unproven vmap-CV compile; only the canary's explicit =1 unlocks it
+    monkeypatch.delenv("BENCH_CV_PARALLEL", raising=False)
+    monkeypatch.setattr(bench.jax, "default_backend", lambda: "tpu")
+    assert spec_of("lstm_ae_50tag").cv_parallel is False
+    assert spec_of("dense_ae_10tag").cv_parallel is True  # flat: untouched
+    monkeypatch.setenv("BENCH_CV_PARALLEL", "1")
+    assert spec_of("lstm_ae_50tag").cv_parallel is True  # canary-proven
 
 
 def test_fleet_flops_accounting_trip_adjustment():

@@ -13,10 +13,12 @@ live tunnel. This canary answers that with a bounded cost:
 - enables the repo-local persistent compilation cache in the child, so a
   *successful* canary is not wasted work — the bench leg that follows hits
   the cache for the same program;
-- exit 0 = compile finished inside the budget (vmap CV is safe: run the
-  bench as-is); exit 1 = timeout/failure (the runbook exports
-  ``BENCH_CV_PARALLEL=0`` so the bench's windowed configs take the
-  sequential-scan CV path instead of burning ~25 min/config).
+- exit 0 = compile finished inside the budget: the runbook exports
+  ``BENCH_CV_PARALLEL=1``, unlocking vmapped CV for the bench's windowed
+  configs (their unset-on-TPU default is the known-good sequential
+  scan); exit 1 = timeout/failure: the runbook pins
+  ``BENCH_CV_PARALLEL=0`` explicitly so even a stale =1 in the shell
+  cannot burn ~25 min/config on compiles.
 
 Usage: ``python tools/tpu_isolate.py [budget_s]`` (default 420).
 """
@@ -69,7 +71,7 @@ def main() -> int:
                     "verdict": "pathological",
                     "timeout_s": budget_s,
                     "note": "vmap-CV lstm fleet compile exceeded budget; "
-                    "use BENCH_CV_PARALLEL=0",
+                    "bench keeps its scan-CV TPU default; the runbook pins =0",
                 }
             )
         )

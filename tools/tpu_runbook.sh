@@ -42,19 +42,23 @@ run_leg() {
 }
 
 # leg 0 — compile canary (tools/tpu_isolate.py): bounded probe of the
-# vmapped-CV windowed fleet compile. Success doubles as a cache warm-up
-# (the child persists the compilation cache the bench legs read); timeout
-# or failure flips the bench legs to scan-CV for windowed configs so a
-# pathological XLA:TPU compile can't eat the tunnel session (~25 min per
-# windowed config, measured r4).
+# vmapped-CV windowed fleet compile. bench.py's windowed-on-TPU default
+# is the known-good scan CV; only a PASSING canary unlocks the vmapped
+# mode (BENCH_CV_PARALLEL=1), and its compile then sits warm in the
+# persistent cache for the bench leg. A timeout/failure just leaves the
+# safe default in place — a pathological XLA:TPU compile can't eat the
+# tunnel session (~25 min per windowed config, measured r4).
 CANARY_ENV=()
 echo "$(date -Is) runbook leg: compile canary" | tee -a "$LOG"
 if CANARY_OUT=$(timeout 480 python tools/tpu_isolate.py 420 2>> "$LOG"); then
-  echo "$(date -Is) canary OK: $CANARY_OUT" | tee -a "$LOG"
+  echo "$(date -Is) canary OK: $CANARY_OUT — bench legs unlock vmapped" \
+    "CV for windowed configs (BENCH_CV_PARALLEL=1)" | tee -a "$LOG"
+  CANARY_ENV=(BENCH_CV_PARALLEL=1)
 else
   echo "$(date -Is) canary PATHOLOGICAL: ${CANARY_OUT:-no output} — bench" \
-    "legs will use BENCH_CV_PARALLEL=0 (scan CV) for windowed configs" \
-    | tee -a "$LOG"
+    "legs pin scan CV for windowed configs" | tee -a "$LOG"
+  # explicit =0, NOT merely unset: a stale =1 in the operator's shell
+  # must not override the verdict and eat the tunnel session
   CANARY_ENV=(BENCH_CV_PARALLEL=0)
 fi
 
