@@ -1,0 +1,127 @@
+"""Property-based tests (hypothesis) for the two contracts where an
+off-by-one or numeric edge silently corrupts every downstream number:
+the windowing index arithmetic (`ops/windowing.py` — SURVEY §4.5 calls
+its off-by-one contract 'subtle and MUST be pinned') and the scaler
+affines (`ops/scaling.py` — every score in the system passes through
+them twice). The golden tests pin specific values; these pin the
+INVARIANTS across the whole small-shape space."""
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from gordo_components_tpu.ops import scaling, windowing
+
+# small-shape space: exhaustive enough to catch boundary arithmetic,
+# cheap enough for the default test tier
+_ROWS = st.integers(min_value=1, max_value=40)
+_LOOKBACK = st.integers(min_value=1, max_value=12)
+_LOOKAHEAD = st.integers(min_value=0, max_value=5)
+_FEATURES = st.integers(min_value=1, max_value=4)
+
+
+@settings(max_examples=60, deadline=None)
+@given(n=_ROWS, L=_LOOKBACK, la=_LOOKAHEAD, F=_FEATURES)
+def test_windows_and_targets_zip_exactly(n, L, la, F):
+    """For EVERY (rows, lookback, lookahead): window count matches the
+    formula; window i is rows [i, i+L); its target is row i+L-1+la — the
+    single off-by-one contract every model kind relies on."""
+    x = np.arange(n * F, dtype=np.float32).reshape(n, F)
+    count = windowing.n_windows(n, L, la)
+    assert count == max(0, n - L + 1 - la)
+    if count <= 0:
+        return
+    windows = np.asarray(windowing.sliding_windows(x, L, la))
+    assert windows.shape == (count, L, F)
+    targets = np.asarray(
+        windowing.reconstruction_targets(x, L)
+        if la == 0
+        else windowing.forecast_targets(x, L, la)
+    )
+    assert len(targets) == count
+    for i in (0, count - 1):  # boundaries are where off-by-ones live
+        np.testing.assert_array_equal(windows[i], x[i : i + L])
+        np.testing.assert_array_equal(targets[i], x[i + L - 1 + la])
+
+
+@settings(max_examples=40, deadline=None)
+@given(n=_ROWS, L=_LOOKBACK, F=_FEATURES, data=st.data())
+def test_gather_windows_matches_sliding(n, L, F, data):
+    """The lazy training-loop gather must agree with the materialized
+    sliding_windows for ANY valid start subset — they share the contract,
+    not just the module."""
+    count = windowing.n_windows(n, L, 0)
+    if count <= 0:
+        return
+    x = np.random.default_rng(0).normal(size=(n, F)).astype(np.float32)
+    starts = np.asarray(
+        data.draw(
+            st.lists(
+                st.integers(min_value=0, max_value=count - 1),
+                min_size=1,
+                max_size=8,
+            )
+        ),
+        np.int32,
+    )
+    dense = np.asarray(windowing.sliding_windows(x, L))
+    lazy = np.asarray(windowing.gather_windows(x, starts, L))
+    np.testing.assert_array_equal(lazy, dense[starts])
+
+
+@settings(max_examples=40, deadline=None)
+@given(n=_ROWS, L=_LOOKBACK, H=st.integers(min_value=1, max_value=5), F=_FEATURES)
+def test_multi_step_targets_zip_exactly(n, L, H, F):
+    """Joint-horizon targets: window i targets rows [i+L, i+L+H) and the
+    count zips with sliding_windows(x, L, lookahead=H)."""
+    count = windowing.n_windows(n, L, H)
+    if count <= 0:
+        return
+    x = np.arange(n * F, dtype=np.float32).reshape(n, F)
+    tgt = np.asarray(windowing.multi_step_targets(x, L, H))
+    assert tgt.shape == (count, H, F)
+    win = np.asarray(windowing.sliding_windows(x, L, H))
+    assert len(win) == count
+    for i in (0, count - 1):
+        np.testing.assert_array_equal(tgt[i], x[i + L : i + L + H])
+
+
+_VALUES = st.floats(
+    min_value=-1e6, max_value=1e6, allow_nan=False, allow_subnormal=False
+)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    rows=st.integers(min_value=1, max_value=20),
+    F=_FEATURES,
+    data=st.data(),
+)
+def test_scaler_roundtrip_and_range(rows, F, data):
+    """For ANY finite data (constant columns included): minmax transform
+    lands in [0, 1], inverse_transform(transform(x)) == x to float
+    precision, and standard-scaled data has ~zero mean — the affine pair
+    every training batch and every served score passes through."""
+    flat = data.draw(
+        st.lists(_VALUES, min_size=rows * F, max_size=rows * F)
+    )
+    x = np.asarray(flat, np.float32).reshape(rows, F)
+    # every tolerance below must scale with the data's magnitude: float32
+    # rounding alone produces range excursions ~4e-3 and ulp-scale stds
+    # on near-duplicate large values (probed empirically in review), so
+    # fixed absolute tolerances would flag a CORRECT implementation
+    span = float(np.abs(x).max()) or 1.0
+    mm = scaling.fit_minmax(x)
+    y = np.asarray(scaling.transform(mm, x))
+    assert np.all(y >= -1e-2) and np.all(y <= 1 + 1e-2)
+    back = np.asarray(scaling.inverse_transform(mm, y))
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=span * 1e-5 + 1e-4)
+    std = scaling.fit_standard(x)
+    z = np.asarray(scaling.transform(std, x))
+    # mean-zero only holds where columns are numerically well-conditioned
+    # (std not at float32 ulp scale relative to the magnitude)
+    well = np.asarray(x.std(axis=0) > span * 1e-4)
+    if rows > 1 and well.any():
+        np.testing.assert_allclose(
+            z.mean(axis=0)[well], 0.0, atol=1e-2
+        )
