@@ -25,6 +25,30 @@ import yaml
 
 EXIT_CONFIG = 64
 EXIT_DATA = 66
+# EX_SOFTWARE: a deterministic device-side failure (HBM OOM, invalid XLA
+# program). The generated Job FailJobs on this code — restarting cannot
+# help a program that is too big for the chip, and the retryable 75 path
+# is Ignored by the podFailurePolicy so it must never absorb these.
+EXIT_PERMANENT = 70
+
+def _is_permanent_xla_error(message: str) -> bool:
+    """Deterministic-failure classifier for JaxRuntimeError messages.
+
+    Kept narrow on purpose: everything unrecognised (UNAVAILABLE,
+    DEADLINE_EXCEEDED, INTERNAL from a dead collective peer, ...) stays
+    retryable — wrongly marking a transient failure permanent kills a
+    recoverable multi-host build, while wrongly retrying a permanent one
+    only burns the Job's activeDeadlineSeconds bound. RESOURCE_EXHAUSTED
+    alone is NOT enough: gRPC uses the same status for transient
+    flow-control/overload on cross-host transfers, so it only counts as
+    the deterministic device OOM when paired with allocator wording.
+    """
+    if "INVALID_ARGUMENT" in message:
+        return True
+    if "RESOURCE_EXHAUSTED" in message:
+        lowered = message.lower()
+        return any(w in lowered for w in ("allocat", "hbm", "memory"))
+    return False
 
 logger = logging.getLogger(__name__)
 
@@ -262,13 +286,27 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
         logger.error("Config error in fleet build: %s", exc)
         sys.exit(EXIT_CONFIG)
     except JaxRuntimeError as exc:
-        # device/collective runtime failure — in multi-host builds most
-        # often a dead peer detected by the transport (connection reset in
-        # an allgather). Deterministically retryable: restart-all re-runs
-        # resume from the registry + slice checkpoints, so map it to the
-        # explicit transient code (75, EX_TEMPFAIL) rather than a generic
-        # crash. The in-process watchdog (GORDO_SLICE_TIMEOUT_S) exits the
-        # same code for the hangs the transport cannot see.
+        # Deterministic device failures (HBM OOM = RESOURCE_EXHAUSTED,
+        # invalid XLA program = INVALID_ARGUMENT) exit the permanent code:
+        # the Job's podFailurePolicy Ignores 75, so mapping these to 75
+        # would crash-loop a build that can never succeed on TPU quota
+        # forever without ever counting toward backoffLimit.
+        if _is_permanent_xla_error(str(exc)):
+            logger.error(
+                "Deterministic device failure in fleet build: %s — "
+                "exiting permanent code %d (restarts cannot help)",
+                exc,
+                EXIT_PERMANENT,
+            )
+            sys.exit(EXIT_PERMANENT)
+        # Everything else is a device/collective runtime failure — in
+        # multi-host builds most often a dead peer detected by the
+        # transport (connection reset in an allgather). Deterministically
+        # retryable: restart-all re-runs resume from the registry + slice
+        # checkpoints, so map it to the explicit transient code (75,
+        # EX_TEMPFAIL) rather than a generic crash. The in-process
+        # watchdog (GORDO_SLICE_TIMEOUT_S) exits the same code for the
+        # hangs the transport cannot see.
         logger.error(
             "Runtime failure in fleet build (dead peer / device error?): "
             "%s — exiting retryable code %d; a restarted run resumes from "
@@ -398,8 +436,16 @@ def workflow_group():
                    "backoffLimit); size above the worst healthy slice "
                    "time. 0 disables the watchdog — wedged pods then hang "
                    "until killed externally")
+@click.option("--active-deadline-s", default=86400, show_default=True,
+              type=click.IntRange(min=1),
+              help="(with --tpu) Job activeDeadlineSeconds: the global "
+                   "wall-clock bound on the build, and the only bound on "
+                   "retryable (exit 75) crash loops since the "
+                   "podFailurePolicy excludes 75 from backoffLimit; size "
+                   "above the worst full-fleet build time")
 def workflow_generate_cmd(machine_config, output_file, image, parallelism,
-                          tpu_mode, tpu_chips, tpu_hosts, slice_timeout_s):
+                          tpu_mode, tpu_chips, tpu_hosts, slice_timeout_s,
+                          active_deadline_s):
     """Fleet YAML -> Argo Workflow (reference-compatible) or TPU Job spec."""
     from ..workflow import generate_argo_workflow, generate_tpu_job
     from ..workflow.workflow_generator import validate_generated
@@ -410,6 +456,7 @@ def workflow_generate_cmd(machine_config, output_file, image, parallelism,
             manifest = generate_tpu_job(
                 config, image=image, tpu_chips=tpu_chips, hosts=tpu_hosts,
                 slice_timeout_s=slice_timeout_s,
+                active_deadline_s=active_deadline_s,
             )
         else:
             manifest = generate_argo_workflow(

@@ -166,6 +166,12 @@ class _Bucket:
         self._hot: "OrderedDict[int, Any]" = OrderedDict()
         self._hot_hits: Dict[int, int] = {}
         self._hot_last_use: Dict[int, int] = {}  # idx -> dispatch_count
+        # idx -> times this machine's hot copy failed at dispatch and was
+        # demoted; raises its re-promotion hit threshold exponentially so
+        # a deterministically failing hot program can't oscillate
+        # promote->fail->demote forever (each cycle costs a failed device
+        # dispatch, a duplicate cold dispatch, and a promotion gather)
+        self._hot_demotions: Dict[int, int] = {}
         self.hot_request_count = 0
         # shard mode: sharded executions contain collectives whose
         # in-process rendezvous (CPU backend) must not interleave across
@@ -382,16 +388,37 @@ class _Bucket:
             xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
             program = self._hot_program(rows, kb)
             x_tail, pred, scaled, total = jax.device_get(program(tree, xs))
-            # stamped only AFTER a successful dispatch: a persistently
-            # failing hot entry must age out under the freshness guard,
-            # not pin itself fresh on every failed retry
-            self._hot_last_use[idx] = self.dispatch_count
+            # accounted before stamping so hot- and cold-path freshness
+            # both record POST-dispatch counts (_maybe_promote stamps after
+            # _process_cold's _account); stamped only on success — see the
+            # demotion below for the failure path
             self._account(k, hot=True)
+            self._hot_last_use[idx] = self.dispatch_count
             self._fill_results(items, x_tail, pred, scaled, total)
-        except BaseException as exc:  # surface on every waiting thread
+        except Exception:
+            # a failing hot copy must not keep failing this machine's pure
+            # batches while the sharded cold path could serve them — and
+            # below hot_cap nothing else would ever evict it. Demote it
+            # (re-promotion needs exponentially more cold hits each time,
+            # see _maybe_promote) and score the same items cold;
+            # _process_cold owns done/error from here.
+            logger.exception(
+                "hot-cache dispatch failed for machine idx %d; demoting "
+                "the hot copy and retrying on the cold path", idx
+            )
+            self._hot.pop(idx, None)
+            self._hot_last_use.pop(idx, None)
+            self._hot_hits.pop(idx, None)
+            self._hot_demotions[idx] = self._hot_demotions.get(idx, 0) + 1
+            self._process_cold(rows, items)
+        except BaseException as exc:
+            # KeyboardInterrupt/SystemExit must not vanish into a cold
+            # retry — surface on every waiting thread as before
             for it in items:
                 it.error = exc
-        finally:
+            for it in items:
+                it.done.set()
+        else:
             for it in items:
                 it.done.set()
 
@@ -469,7 +496,11 @@ class _Bucket:
                 continue
             hits = self._hot_hits.get(idx, 0) + 1
             self._hot_hits[idx] = hits
-            if hits < 2:
+            # base threshold 2; each past dispatch-failure demotion (see
+            # _process_hot) multiplies it 8x, so a deterministically
+            # failing hot program backs off geometrically instead of
+            # re-entering the cache every other cold hit
+            if hits < 2 * (8 ** self._hot_demotions.get(idx, 0)):
                 continue
             if len(self._hot) >= self._hot_cap:
                 victim = next(iter(self._hot))

@@ -170,15 +170,21 @@ spec:
 {% endif %}
   # make the exit-code contract real at the Job layer (k8s >= 1.26,
   # requires restartPolicy Never): transient/watchdog exits (75) restart
-  # without counting toward backoffLimit; the CLI's permanent config/data
-  # codes (64/66) fail the Job immediately instead of burning retries on
-  # a config that can never build
+  # without counting toward backoffLimit; the CLI's permanent config/data/
+  # device codes (64/66/70 — 70 is deterministic XLA failure such as HBM
+  # OOM) fail the Job immediately instead of burning retries on a build
+  # that can never succeed
   podFailurePolicy:
     rules:
       - action: Ignore
         onExitCodes: {containerName: fleet-builder, operator: In, values: [75]}
       - action: FailJob
-        onExitCodes: {containerName: fleet-builder, operator: In, values: [64, 66]}
+        onExitCodes: {containerName: fleet-builder, operator: In, values: [64, 66, 70]}
+  # global wall-clock bound: because exit 75 is Ignored above, a failure
+  # mode that keeps presenting as retryable (e.g. an XLA error the CLI's
+  # permanent-marker list doesn't recognise) could otherwise crash-loop on
+  # TPU quota forever without ever touching backoffLimit
+  activeDeadlineSeconds: {{ active_deadline_s }}
 {% if hosts > 1 %}
   # one indexed pod per TPU host: every pod runs the SAME fleet-build
   # command, joins the jax.distributed runtime at pod 0, and trains/writes
@@ -310,6 +316,7 @@ def generate_tpu_job(
     tpu_chips: int = 16,
     hosts: int = 1,
     slice_timeout_s: int = 1800,
+    active_deadline_s: int = 86400,
 ) -> str:
     """TPU-native emitter: one fleet-build Job + one multi-model server
     Deployment for the entire fleet.
@@ -328,6 +335,12 @@ def generate_tpu_job(
         config = NormalizedConfig(config)
     if hosts < 1:
         raise ValueError(f"hosts must be >= 1, got {hosts}")
+    if active_deadline_s < 1:
+        raise ValueError(
+            f"active_deadline_s must be >= 1, got {active_deadline_s}: the "
+            "deadline is the only bound on retryable (exit 75) crash loops, "
+            "which the podFailurePolicy excludes from backoffLimit"
+        )
     return _TPU_JOB_TEMPLATE.render(
         project=config.project_name,
         image=image,
@@ -336,6 +349,7 @@ def generate_tpu_job(
         tpu_chips=tpu_chips,
         hosts=hosts,
         slice_timeout_s=slice_timeout_s,
+        active_deadline_s=active_deadline_s,
     )
 
 

@@ -139,6 +139,42 @@ def test_cli_fleet_build(runner, tmp_path):
         load(model_dir)
 
 
+def test_cli_fleet_build_device_error_exit_codes(runner, tmp_path, monkeypatch):
+    """ADVICE r4: JaxRuntimeError no longer maps wholesale to retryable
+    75 — the generated Job Ignores 75, so a deterministic device failure
+    (HBM OOM / invalid XLA program) would crash-loop on TPU quota forever.
+    Those exit the permanent code (70, which the Job FailJobs on); genuine
+    transport/collective failures keep the retryable contract."""
+    from jax.errors import JaxRuntimeError
+
+    from gordo_components_tpu import parallel as parallel_pkg
+
+    config_file = tmp_path / "fleet.yaml"
+    config_file.write_text(yaml.safe_dump(FLEET_YAML))
+    args = ["fleet-build", "--machine-config", str(config_file),
+            "--output-dir", str(tmp_path / "m")]
+
+    def _raising(message):
+        def fake_build_fleet(*a, **k):
+            raise JaxRuntimeError(message)
+
+        return fake_build_fleet
+
+    for message, expected in (
+        ("RESOURCE_EXHAUSTED: attempting to allocate 21.0G", 70),
+        ("RESOURCE_EXHAUSTED: out of HBM on device 0", 70),
+        ("INVALID_ARGUMENT: unsupported HLO", 70),
+        # gRPC reuses RESOURCE_EXHAUSTED for transient flow-control on
+        # cross-host transfers: without allocator wording it stays 75
+        ("RESOURCE_EXHAUSTED: received trailing metadata size exceeds limit", 75),
+        ("UNAVAILABLE: connection reset by peer in all-gather", 75),
+        ("INTERNAL: something opaque the transport saw", 75),
+    ):
+        monkeypatch.setattr(parallel_pkg, "build_fleet", _raising(message))
+        result = runner.invoke(gordo, args)
+        assert result.exit_code == expected, (message, result.output)
+
+
 def _jax_cache_dir():
     import jax as _jax
 

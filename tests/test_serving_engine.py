@@ -598,3 +598,50 @@ def test_mesh_sharded_hot_cache_freshness_guard(fitted_pair):
     before = stats["hot_requests"]
     engine.anomaly(n1, X1)
     assert engine.stats()["hot_requests"] == before + 1
+
+
+@pytest.mark.slow
+def test_mesh_sharded_hot_cache_demotes_failing_entry(fitted_pair):
+    """ADVICE r4: a failing hot copy must not permanently fail its
+    machine's pure-hot batches. The engine demotes the entry on a hot
+    dispatch error and scores the SAME request through the sharded cold
+    path — the client sees a correct answer, not the hot path's
+    exception — and the machine re-earns promotion afterwards."""
+    from gordo_components_tpu.parallel.mesh import fleet_mesh
+
+    models = {name: m for name, (m, _) in fitted_pair.items()}
+    engine = ServingEngine(models, mesh=fleet_mesh(8), hot_cap=4)
+    (n1, (_, X1)), _ = sorted(fitted_pair.items())
+
+    cold = engine.anomaly(n1, X1)
+    engine.anomaly(n1, X1)  # promoted
+    assert engine.stats()["hot_machines"] == 1
+    bucket, _idx = engine._by_name[n1]
+
+    def poisoned(rows, k):
+        raise RuntimeError("injected hot-dispatch failure")
+
+    bucket._hot_program = poisoned  # instance override, cold path untouched
+    try:
+        served = engine.anomaly(n1, X1)  # must fall back, not raise
+    finally:
+        del bucket._hot_program
+    np.testing.assert_allclose(
+        served.total_anomaly_score, cold.total_anomaly_score, atol=1e-6
+    )
+    assert engine.stats()["hot_machines"] == 0  # demoted
+    # re-promotion backs off: one past demotion raises the hit threshold
+    # 2 -> 16 so a deterministically failing hot program can't oscillate
+    # promote->fail->demote on every other cold hit. The fallback cold
+    # dispatch above already counted as hit 1.
+    for _ in range(14):
+        engine.anomaly(n1, X1)
+    assert engine.stats()["hot_machines"] == 0  # still backing off
+    engine.anomaly(n1, X1)  # hit 16 -> re-promoted (hot path repaired)
+    assert engine.stats()["hot_machines"] == 1
+    before = engine.stats()["hot_requests"]
+    again = engine.anomaly(n1, X1)
+    assert engine.stats()["hot_requests"] == before + 1
+    np.testing.assert_allclose(
+        again.total_anomaly_score, cold.total_anomaly_score, atol=1e-6
+    )

@@ -133,16 +133,27 @@ def test_tpu_job_multihost_golden():
     # retryable-75 for backoffLimit to restart instead of hanging the pod
     assert env["GORDO_SLICE_TIMEOUT_S"]["value"] == "1800"
     # ... and the Job's podFailurePolicy makes the exit-code contract
-    # real: 75 restarts without burning backoffLimit, 64/66 fail the Job
+    # real: 75 restarts without burning backoffLimit; 64/66 (config/data)
+    # and 70 (deterministic device failure, e.g. HBM OOM) fail the Job
     rules = job["spec"]["podFailurePolicy"]["rules"]
     by_action = {r["action"]: r["onExitCodes"]["values"] for r in rules}
     assert by_action["Ignore"] == [75]
-    assert sorted(by_action["FailJob"]) == [64, 66]
+    assert sorted(by_action["FailJob"]) == [64, 66, 70]
     # a wedge event costs up to `hosts` pod failures, so the budget scales
     assert job["spec"]["backoffLimit"] == 12
+    # the global deadline is the only bound on retryable crash loops (75
+    # never counts toward backoffLimit), so it must always be emitted
+    assert job["spec"]["activeDeadlineSeconds"] == 86400
     custom = generate_tpu_job(
-        FLEET_YAML, tpu_chips=8, hosts=4, slice_timeout_s=300
+        FLEET_YAML, tpu_chips=8, hosts=4, slice_timeout_s=300,
+        active_deadline_s=7200,
     )
+    job2 = next(
+        d for d in yaml.safe_load_all(custom) if d and d["kind"] == "Job"
+    )
+    assert job2["spec"]["activeDeadlineSeconds"] == 7200
+    with pytest.raises(ValueError, match="active_deadline_s"):
+        generate_tpu_job(FLEET_YAML, active_deadline_s=0)
     env2 = {
         e["name"]: e
         for d in yaml.safe_load_all(custom)
