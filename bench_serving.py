@@ -22,7 +22,14 @@ path. The bench reports three numbers:
   can decompose end_to_end ≈ link_rtt + device themselves.
 
 ``vs_baseline`` is the 5 ms north-star target divided by ``value`` (>1 ⇒
-faster than target).
+faster than target); it is null on any non-TPU run — the target is a TPU
+anchor.
+
+End-to-end percentiles are STEADY-STATE: a separately-reported ``warmup``
+pass (three full round-robin sweeps) absorbs first-dispatch compiles,
+hot-cache promotion gathers, and first hot dispatches first. ``saturation`` ramps concurrent client
+counts (1..32 workers) over mixed-machine traffic and reports rps + tail
+latency per rung; ``rps_at_p99_lt_5ms`` is the saturation headline.
 
 Env overrides: BENCH_SERVE_MACHINES (100), BENCH_SERVE_ROWS (144 = one day
 at 10-min resolution), BENCH_SERVE_TAGS (10), BENCH_SERVE_REQUESTS (200),
@@ -150,8 +157,23 @@ def measure(
     rng = np.random.default_rng(1)
     X = rng.normal(size=(rows, tags)).astype(np.float32) * 2 + 4
 
-    # warm-up: compile the k=1 program
-    engine.anomaly(names[0], X)
+    # -- warm-up pass, measured and reported SEPARATELY (VERDICT r4 weak
+    # #3: a 540 ms CPU p99 turned out to be first-dispatch compiles and
+    # hot-cache promotion gathers landing inside the percentile window).
+    # THREE round-robin passes over the whole fleet: pass 1 pays every
+    # first-dispatch compile; pass 2 is each machine's 2nd cold hit, which
+    # (shard mode) triggers its promotion gather up to hot_cap; pass 3 is
+    # the promoted machines' first HOT dispatch — the hot program's
+    # compile (measured 169 ms on the CPU mesh, i.e. the entire former
+    # "steady-state" p99). Steady state below starts only after the
+    # cache's working set is settled AND every program it uses has run.
+    warmup_lat = []
+    for _ in range(3):
+        for name in names:
+            started = time.perf_counter()
+            engine.anomaly(name, X)
+            warmup_lat.append(time.perf_counter() - started)
+    warmup_ms = np.asarray(warmup_lat) * 1000.0
 
     # -- host↔device link round-trip floor (tunnel RTT on this rig) ---------
     tiny = np.ones((1,), np.float32)
@@ -199,16 +221,66 @@ def measure(
         jax.block_until_ready(outs)
     device_ms = (time.perf_counter() - started) / n_pipe * 1000.0
 
-    # -- sustained concurrent load (micro-batching path) --------------------
-    def one(i: int) -> None:
-        engine.anomaly(names[i % len(names)], X)
-
-    with ThreadPoolExecutor(max_workers=16) as pool:
-        list(pool.map(one, range(64)))  # warm batched program sizes
+    # -- sustained concurrent load (micro-batching path), ramped over
+    # client counts to find the saturation point (VERDICT r4 #8): for each
+    # worker count, mixed-machine traffic through engine.anomaly with
+    # per-request latencies, so the curve reports rps AND tail latency and
+    # ``rps_at_p99_lt_5ms`` is a first-class metric next to p50. The
+    # 16-worker rung keeps the legacy ``concurrent_rps`` comparable.
+    def one(i: int) -> float:
+        name = names[i % len(names)]
         started = time.perf_counter()
-        list(pool.map(one, range(n_requests)))
-        concurrent_s = time.perf_counter() - started
-    throughput = n_requests / concurrent_s
+        engine.anomaly(name, X)
+        return time.perf_counter() - started
+
+    # concurrent requests coalesce into power-of-two dispatch batches, and
+    # each batch size's FIRST execution compiles a new program — which
+    # batch sizes occur is timing-dependent, so warm every possible one
+    # (cold and hot variants) deterministically before any timed rung, or
+    # a rung's p99 measures XLA compile time, not serving
+    rows_padded = x_padded.shape[0]
+    kb = 1
+    while kb <= 32:  # queue depth is bounded by the deepest rung (32)
+        xs_kb = jax.device_put(np.repeat(x_padded[None], kb, axis=0))
+        idxs_kb = jax.device_put(np.full((kb,), idx, np.int32))
+        jax.block_until_ready(
+            bucket._program(rows_padded, kb)(bucket.stacked, idxs_kb, xs_kb)
+        )
+        if shard_mode and engine.hot_cap and bucket._hot:
+            hot_idx = next(iter(bucket._hot))
+            jax.block_until_ready(
+                bucket._hot_program(rows_padded, kb)(
+                    bucket._hot[hot_idx], np.asarray(xs_kb)
+                )
+            )
+        kb *= 2
+    saturation = []
+    for workers in (1, 2, 4, 8, 16, 32):
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            # settle the pool's threads before timing
+            list(pool.map(one, range(min(n_requests, 2 * workers))))
+            started = time.perf_counter()
+            lats = list(pool.map(one, range(n_requests)))
+            elapsed = time.perf_counter() - started
+        lat_arr = np.asarray(lats) * 1000.0
+        saturation.append({
+            "workers": workers,
+            "rps": round(n_requests / elapsed, 1),
+            "p50_ms": round(float(np.percentile(lat_arr, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat_arr, 99)), 3),
+        })
+    throughput = next(
+        s["rps"] for s in saturation if s["workers"] == 16
+    )
+    under_target = [s for s in saturation if s["p99_ms"] < 5.0]
+    # the 5 ms SLO is a TPU anchor (like vs_baseline): a CPU rung slipping
+    # under it must not populate a TPU-anchored headline, so non-TPU runs
+    # carry null and read the per-rig curve in ``saturation`` instead
+    rps_at_p99_lt_5ms = (
+        (max(s["rps"] for s in under_target) if under_target else 0.0)
+        if jax.devices()[0].platform == "tpu"
+        else None
+    )
 
     # -- shard mode: hot-machine cache latency (ROADMAP #3) -----------------
     # repeat-machine traffic promotes an unsharded copy after 2 cold hits;
@@ -229,6 +301,7 @@ def measure(
         assert engine.stats()["hot_requests"] >= 50
 
     stats = engine.stats()
+    on_tpu = jax.devices()[0].platform == "tpu"
     return {
         "metric": "serving_p50_ms",
         "value": round(device_ms, 3),
@@ -238,11 +311,35 @@ def measure(
             f"{rows}x{tags} request; end-to-end on this rig is "
             "tunnel-RTT-bound, see end_to_end/link_rtt fields)"
         ),
-        "vs_baseline": round(5.0 / device_ms, 2),  # target / measured
+        # the 5 ms north-star target is a TPU anchor: a CPU-measured value
+        # must not be compared against it (VERDICT r4 weak #6 — a degraded
+        # artifact carried "vs_baseline: 52.22" a reader could mistake for
+        # a cross-device win)
+        "vs_baseline": round(5.0 / device_ms, 2) if on_tpu else None,
+        # steady-state percentiles: measured AFTER the reported warmup
+        # pass, so first-dispatch compiles and promotion gathers can never
+        # masquerade as tail latency (VERDICT r4 weak #3)
         "end_to_end_p50_ms": round(e2e_p50, 3),
         "end_to_end_p99_ms": round(e2e_p99, 3),
+        "warmup": {
+            "requests": len(warmup_lat),
+            "p50_ms": round(float(np.percentile(warmup_ms, 50)), 3),
+            "max_ms": round(float(warmup_ms.max()), 3),
+            "note": (
+                "three round-robin passes over the fleet: pays every "
+                "first-dispatch compile, (shard mode) the hot-cache "
+                "promotion gathers, and the hot program's first dispatch; "
+                "excluded from steady-state percentiles"
+            ),
+        },
         "link_rtt_ms": round(link_rtt, 3),
         "concurrent_rps": round(throughput, 1),
+        "saturation": saturation,
+        # best rps among the rungs whose p99 beat the 5 ms target — the
+        # highest throughput achievable under the SLO, wherever on the
+        # worker curve it lands. 0.0 = no rung qualified; null = non-TPU
+        # run (the SLO is a TPU anchor, like vs_baseline)
+        "rps_at_p99_lt_5ms": rps_at_p99_lt_5ms,
         "compiled_programs": stats["compiled_programs"],
         "max_dispatch_batch": stats["max_dispatch_batch"],
         "shard_mesh_devices": stats["shard_mesh_devices"],

@@ -394,6 +394,17 @@ class _Bucket:
             # demotion below for the failure path
             self._account(k, hot=True)
             self._hot_last_use[idx] = self.dispatch_count
+            # a successful hot dispatch pays down the demotion backoff: a
+            # TRANSIENT past failure (device blip during another bucket's
+            # promotion) must not permanently escalate this machine's
+            # re-promotion threshold, while a deterministically failing
+            # program never reaches this line and keeps backing off
+            demotions = self._hot_demotions.get(idx)
+            if demotions:
+                if demotions > 1:
+                    self._hot_demotions[idx] = demotions - 1
+                else:
+                    del self._hot_demotions[idx]
             self._fill_results(items, x_tail, pred, scaled, total)
         except Exception:
             # a failing hot copy must not keep failing this machine's pure
@@ -468,13 +479,23 @@ class _Bucket:
             )
 
     # a full cache only evicts its LRU entry for a new promotion when that
-    # entry hasn't served a hot request within this many device
-    # dispatches: without the guard, spread traffic over more machines
-    # than hot_cap churns promote/evict cycles whose per-promotion gather
-    # (on the leader thread) was measured to cost ~15-30% concurrent
-    # throughput; with it, a saturated cache holds a stable working set
-    # and only genuinely-shifted traffic rotates it
+    # entry hasn't served a hot request within the freshness window:
+    # without the guard, spread traffic over more machines than hot_cap
+    # churns promote/evict cycles whose per-promotion gather (on the
+    # leader thread) was measured to cost ~15-30% concurrent throughput;
+    # with it, a saturated cache holds a stable working set and only
+    # genuinely-shifted traffic rotates it. The window is measured in
+    # device dispatches and scales with the bucket's fleet size (see
+    # _hot_evict_window): uniform round-robin over M machines touches
+    # each hot entry only every ~M dispatches, so a FIXED window < M
+    # would evict live entries on every fleet cycle — the exact churn
+    # the guard exists to stop. 0 disables the guard (tests).
     _HOT_EVICT_AFTER = 64
+
+    def _hot_evict_window(self) -> int:
+        if not self._HOT_EVICT_AFTER:
+            return 0
+        return max(self._HOT_EVICT_AFTER, 2 * len(self.names))
 
     def _maybe_promote(self, items: List[_Item]) -> None:
         """After a successful cold dispatch: machines scoring their 2nd+
@@ -505,7 +526,7 @@ class _Bucket:
             if len(self._hot) >= self._hot_cap:
                 victim = next(iter(self._hot))
                 age = self.dispatch_count - self._hot_last_use.get(victim, 0)
-                if age < self._HOT_EVICT_AFTER:
+                if age < self._hot_evict_window():
                     continue  # working set is live — don't thrash it
                 self._hot.pop(victim)
                 self._hot_last_use.pop(victim, None)

@@ -601,6 +601,91 @@ def test_mesh_sharded_hot_cache_freshness_guard(fitted_pair):
 
 
 @pytest.mark.slow
+def test_mesh_sharded_hot_cache_stable_under_uniform_spread():
+    """The freshness window scales with the bucket's fleet size: uniform
+    round-robin over M machines touches each hot entry only every ~M
+    dispatches, so the old FIXED 64-dispatch window evicted live entries
+    on every fleet cycle once M > 64 — promote/evict gather churn inside
+    what bench_serving reports as steady state. With the scaled window
+    the working set must not rotate at all under uniform spread."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench_serving
+
+    from gordo_components_tpu.parallel.mesh import fleet_mesh
+    from gordo_components_tpu.server.engine import ServingEngine
+
+    machines = 72  # > the 64-dispatch base window: the churn regime
+    models = bench_serving.build_models(machines, 64, 4)
+    engine = ServingEngine(models, mesh=fleet_mesh(8), hot_cap=2)
+    names = engine.machines()
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(64, 4)).astype(np.float32) * 2 + 4
+
+    for _ in range(2):  # pass 2 promotes the first hot_cap machines
+        for name in names:
+            engine.anomaly(name, X)
+    bucket, _ = engine._by_name[names[0]]
+    working_set = set(bucket._hot)
+    assert len(working_set) == 2
+    for _ in range(2):  # uniform spread: the set must hold, not rotate
+        for name in names:
+            engine.anomaly(name, X)
+    assert set(bucket._hot) == working_set
+    # ... and the hot machines really served hot through those passes
+    assert engine.stats()["hot_requests"] >= 4
+
+
+@pytest.mark.slow
+def test_mesh_sharded_steady_state_tail_latency_bounded():
+    """VERDICT r4 #4: steady-state sharded p99 must stay within a small
+    multiple of p50 under concurrent mixed-machine traffic. The r4
+    artifact's 540 ms p99 (170x the median) was first-dispatch compiles
+    and hot-program compiles landing inside the percentile window — after
+    a proper warmup (every machine served three times, every power-of-two
+    batch program executed once), nothing in the steady-state path may
+    cost compile-scale time."""
+    import sys
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench_serving
+
+    from gordo_components_tpu.parallel.mesh import fleet_mesh
+    from gordo_components_tpu.server.engine import ServingEngine
+
+    models = bench_serving.build_models(24, 64, 4)
+    engine = ServingEngine(models, mesh=fleet_mesh(8), hot_cap=4)
+    names = engine.machines()
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(64, 4)).astype(np.float32) * 2 + 4
+
+    for _ in range(3):  # compiles, promotions, first hot dispatches
+        for name in names:
+            engine.anomaly(name, X)
+
+    def one(i: int) -> float:
+        started = time.perf_counter()
+        engine.anomaly(names[i % len(names)], X)
+        return time.perf_counter() - started
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        list(pool.map(one, range(64)))  # warm coalesced batch sizes
+        lats = list(pool.map(one, range(200)))
+    lat_ms = np.asarray(lats) * 1000.0
+    p50 = float(np.percentile(lat_ms, 50))
+    p99 = float(np.percentile(lat_ms, 99))
+    # 10x p50 with an absolute floor for scheduler noise on a shared CI
+    # box; a compile (>150 ms measured) or promotion-thrash gather in the
+    # window blows straight through either bound
+    assert p99 <= max(10.0 * p50, 75.0), (p50, p99)
+
+
+@pytest.mark.slow
 def test_mesh_sharded_hot_cache_demotes_failing_entry(fitted_pair):
     """ADVICE r4: a failing hot copy must not permanently fail its
     machine's pure-hot batches. The engine demotes the entry on a hot
