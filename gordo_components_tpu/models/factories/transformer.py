@@ -181,8 +181,18 @@ class PatchTSTModule(nn.Module):
         dtype = resolve_dtype(self.compute_dtype)
         channels = jnp.swapaxes(x.astype(dtype), 1, 2)  # (B, F, L)
         starts = np.arange(0, window - self.patch_length + 1, self.stride)
-        idx = starts[:, None] + np.arange(self.patch_length)[None, :]
-        patches = channels[:, :, idx]  # (B, F, P, patch_len) static gather
+        # patching as P static contiguous slices + stack, not an
+        # advanced-index gather: slice/concat is XLA:TPU's fast layout
+        # path, while a (P, patch_len) index-matrix gather addresses
+        # every element through the scalar core — this runs every
+        # training step on the (B, F, L) tensor, so the lowering matters
+        patches = jnp.stack(
+            [
+                jax.lax.slice_in_dim(channels, s, s + self.patch_length, axis=2)
+                for s in starts
+            ],
+            axis=2,
+        )  # (B, F, P, patch_len)
         n_patches = len(starts)
         h = patches.reshape(batch * n_features, n_patches, self.patch_length)
         h = nn.Dense(self.d_model, dtype=dtype)(h)

@@ -1,0 +1,161 @@
+"""Bounded TPU ablation probe for the windowed-fleet step-time mystery.
+
+VERDICT r4 weak #1: the PatchTST fleet ran ~1000x below roofline on TPU
+(130 GFLOP/s on a 197 TFLOP/s part) with vs_single 0.99 — throughput-
+bound on something that is NOT the MXU. The r5 hypothesis (shipped in
+``ops/windowing.py`` / ``models/factories/transformer.py``) is gather
+lowering: advanced-index window gathers address ``batch x L`` scalar row
+indices through the scalar core, while the vmapped ``dynamic_slice``
+form gathers ``batch`` contiguous ``(L, F)`` slices.
+
+This probe times the PRIMITIVES side by side on the live chip, so the
+next artifact can attribute the fleet numbers instead of guessing:
+
+1. ``window_gather_slice_ms``   — the shipped contiguous-slice form
+2. ``window_gather_indexed_ms`` — the r4 advanced-indexing form
+   ... both at the bench shape (384x256 rows, 64 starts) and the plant
+   shape (384x10000 rows, 16 starts);
+3. ``patch_slice_ms`` / ``patch_gather_ms`` — the in-model patching on
+   a (64, 256, 32) batch, slice/stack vs index-matrix gather;
+4. ``train_step_ms`` / ``train_step_premat_ms`` — one PatchTST train
+   step at the bench shape with on-the-fly window gather vs
+   pre-materialized windows (isolates the gather share of a real step).
+
+Runtime is bounded (~2-3 min incl. compiles); every timing is the median
+of ``reps`` device-synced calls after one warm-up. Prints ONE JSON line.
+Usage: python tools/tpu_probe_gathers.py [reps]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _timed(fn, *args, reps: int = 20) -> float:
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        started = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - started)
+    return float(np.median(times) * 1000.0)
+
+
+def _indexed_gather(rows, starts, L):
+    # the r4 lowering, kept here verbatim for the A/B
+    return rows[starts[:, None] + jnp.arange(L)[None, :]]
+
+
+def main() -> None:
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    sys.path.insert(0, ".")
+    from gordo_components_tpu.ops.windowing import gather_windows
+
+    result = {"metric": "tpu_gather_probe", "device": jax.devices()[0].device_kind}
+    rng = np.random.default_rng(0)
+
+    for label, (n_rows, n_tags, batch) in {
+        "bench": (384, 256, 64),
+        "plant": (384, 10_000, 16),
+    }.items():
+        rows = jnp.asarray(
+            rng.normal(size=(n_rows, n_tags)).astype(np.float32)
+        )
+        starts = jnp.asarray(
+            rng.integers(0, n_rows - 33, size=batch).astype(np.int32)
+        )
+        L = 32
+        sliced = jax.jit(lambda r, s: gather_windows(r, s, L))
+        indexed = jax.jit(lambda r, s: _indexed_gather(r, s, L))
+        np.testing.assert_allclose(  # same windows, or the A/B is void
+            np.asarray(sliced(rows, starts)), np.asarray(indexed(rows, starts))
+        )
+        result[f"window_gather_slice_ms_{label}"] = _timed(
+            sliced, rows, starts, reps=reps
+        )
+        result[f"window_gather_indexed_ms_{label}"] = _timed(
+            indexed, rows, starts, reps=reps
+        )
+
+    # in-model patching A/B at the bench step shape
+    x = jnp.asarray(rng.normal(size=(64, 256, 32)).astype(np.float32))
+    starts_p = np.arange(0, 32 - 8 + 1, 4)
+
+    @jax.jit
+    def patch_slice(channels):
+        return jnp.stack(
+            [
+                jax.lax.slice_in_dim(channels, s, s + 8, axis=2)
+                for s in starts_p
+            ],
+            axis=2,
+        )
+
+    @jax.jit
+    def patch_gather(channels):
+        idx = starts_p[:, None] + np.arange(8)[None, :]
+        return channels[:, :, idx]
+
+    np.testing.assert_allclose(
+        np.asarray(patch_slice(x)), np.asarray(patch_gather(x))
+    )
+    result["patch_slice_ms"] = _timed(patch_slice, x, reps=reps)
+    result["patch_gather_ms"] = _timed(patch_gather, x, reps=reps)
+
+    # one real PatchTST train step, gather vs pre-materialized windows
+    from gordo_components_tpu.models.train import make_batch_step
+    from gordo_components_tpu.ops import windowing
+    from gordo_components_tpu.serializer import pipeline_from_definition
+
+    config = {
+        "PatchTSTAutoEncoder": {
+            "kind": "patchtst",
+            "lookback_window": 32,
+            "d_model": 64,
+            "n_layers": 2,
+            "batch_size": 64,
+            "compute_dtype": "bfloat16",
+        }
+    }
+    est = pipeline_from_definition({"Pipeline": {"steps": [config]}}).steps[-1][1]
+    spec = est._make_spec(256, 256)
+    rows = jnp.asarray(rng.normal(size=(384, 256)).astype(np.float32))
+    starts = jnp.asarray(rng.integers(0, 384 - 33, size=64).astype(np.int32))
+    targets = jnp.asarray(rng.normal(size=(64, 256)).astype(np.float32))
+    w = jnp.ones((64,), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    params = spec.module.init(
+        key, jnp.zeros((1, 32, 256), jnp.float32), deterministic=True
+    )["params"]
+    opt_state = spec.optimizer.init(params)
+
+    def apply_gathered(variables, s, **kw):
+        return spec.module.apply(
+            variables, windowing.gather_windows(rows, s, 32), **kw
+        )
+
+    step_g = jax.jit(
+        lambda p, o: make_batch_step(apply_gathered, spec.optimizer)(
+            (p, o), (starts, targets, w, key)
+        )[0][0]
+    )
+    windows = windowing.gather_windows(rows, starts, 32)
+    step_m = jax.jit(
+        lambda p, o: make_batch_step(spec.module.apply, spec.optimizer)(
+            (p, o), (windows, targets, w, key)
+        )[0][0]
+    )
+    result["train_step_ms"] = _timed(step_g, params, opt_state, reps=reps)
+    result["train_step_premat_ms"] = _timed(step_m, params, opt_state, reps=reps)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

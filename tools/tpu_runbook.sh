@@ -62,6 +62,14 @@ else
   CANARY_ENV=(BENCH_CV_PARALLEL=0)
 fi
 
+# gather-lowering ablation probe (r5): attributes the windowed fleets'
+# below-roofline step times (slice vs indexed gathers, real train step
+# with/without the gather). Cheap (~2-3 min) and strictly bounded, so it
+# runs BEFORE the long bench legs — its attribution is what makes the
+# bench numbers interpretable if the tunnel dies mid-session.
+run_leg gather_probe "$OUT/gather_probe_${TAG}_run${n}.json" \
+  timeout 300 python tools/tpu_probe_gathers.py
+
 run_leg bench        "$OUT/bench_tpu_${TAG}_run${n}.json"  \
   env "${CANARY_ENV[@]}" python bench.py
 run_leg bench_full   "$OUT/bench_tpu_${TAG}_full${n}.json" \
