@@ -1,16 +1,18 @@
-"""Child process for the 2-process distributed fleet tests (test_aux.py).
+"""Child process for the multi-process distributed fleet tests (test_aux.py).
 
 Run as: python multihost_child.py <process_id> <num_processes> <port>
         python multihost_child.py <process_id> <num_processes> <port> --build <dir>
 
 Each process joins the jax.distributed runtime (Gloo over localhost) and
-spans a global fleet mesh over BOTH processes' virtual CPU devices. The
+spans a global fleet mesh over EVERY process's virtual CPU devices. The
 default mode runs a sharded fleet train step where each process only holds
 its own machines' data. ``--build`` runs the FULL ``build_fleet`` pipeline
 multi-host: sliced buckets, process-local streaming ingest through the
 prefetcher, global-batch assembly, and per-process artifact writes
 (SURVEY.md §2.3: machine shards are process-local, collectives cross the
-process boundary).
+process boundary). Every mode is process-count-agnostic — the parents run
+the drills at 2 AND at 4 processes (the v5e-16 host count; VERDICT r4 #5:
+2-process symmetry hides rendezvous/barrier bugs that 2→4 exposes).
 """
 
 import os
@@ -191,14 +193,11 @@ def build_crash_mode(output_dir: str) -> None:
     build_mode(output_dir)
 
 
-def build_asym_crash_mode(output_dir: str) -> None:
-    """ASYMMETRIC failure drill (ROADMAP #5 / VERDICT r3 weak #5): only
-    process 1 dies — at the start of its second slice, after slice 0's
-    artifacts landed. Process 0 survives, stalls in the slice's collective
-    assembly (its peer is gone), and must be killed by the slice watchdog
-    (``GORDO_SLICE_TIMEOUT_S``, set by the parent test) with the RETRYABLE
-    exit code — never hang. The parent then re-runs a normal build, which
-    must resume slice 0 from the registry and complete the fleet."""
+def _install_die_at_slice1(victim_ranks) -> None:
+    """Monkeypatch shared by the asymmetric drills: the given ranks die at
+    the start of slice 1 (after slice 0's artifacts landed); every other
+    rank survives, stalls in the slice's collective assembly, and must be
+    freed by the slice watchdog with the RETRYABLE exit code."""
     import importlib
 
     bf = importlib.import_module("gordo_components_tpu.parallel.build_fleet")
@@ -206,12 +205,33 @@ def build_asym_crash_mode(output_dir: str) -> None:
     orig = bf._SliceWatchdog.start
 
     def start_or_die(self, bucket, sl):
-        if sl >= 1 and jax.process_index() == 1:
+        if sl >= 1 and jax.process_index() in victim_ranks:
             print("peer-died-asymmetrically", flush=True)
             os._exit(17)
         orig(self, bucket, sl)
 
     bf._SliceWatchdog.start = start_or_die
+
+
+def build_asym_crash_mode(output_dir: str) -> None:
+    """ASYMMETRIC failure drill (ROADMAP #5 / VERDICT r3 weak #5): only
+    process 1 dies — at the start of its second slice, after slice 0's
+    artifacts landed. The survivors stall in the slice's collective
+    assembly (their peer is gone) and must be killed by the slice watchdog
+    (``GORDO_SLICE_TIMEOUT_S``, set by the parent test) with the RETRYABLE
+    exit code — never hang. The parent then re-runs a normal build, which
+    must resume slice 0 from the registry and complete the fleet."""
+    _install_die_at_slice1({1})
+    build_mode(output_dir)
+
+
+def build_asym_crash2_mode(output_dir: str) -> None:
+    """TWO NON-ADJACENT ranks die (1 and 3, of 4): the failure shape
+    VERDICT r4 #5 calls out — with two separated holes in the rendezvous
+    ring, every survivor (0 and 2) has a dead neighbor on some collective
+    path, a topology 2-process symmetry can never produce. Survivors must
+    still fail fast via transport error or watchdog, retryably."""
+    _install_die_at_slice1({1, 3})
     build_mode(output_dir)
 
 
@@ -322,6 +342,9 @@ def main() -> None:
         return
     if len(sys.argv) >= 6 and sys.argv[4] == "--build-asym-crash":
         build_asym_crash_mode(sys.argv[5])
+        return
+    if len(sys.argv) >= 6 and sys.argv[4] == "--build-asym-crash2":
+        build_asym_crash2_mode(sys.argv[5])
         return
     if len(sys.argv) >= 6 and sys.argv[4] == "--build-hang":
         build_hang_mode(sys.argv[5])

@@ -1,0 +1,136 @@
+"""Port-a-real-config proof (VERDICT r4 #7): a fixture YAML in the
+reference's exact upstream shape — full CRD wrapper, globals+machines
+split, dotted-path sklearn./gordo_components. model definitions, legacy
+"10T" resolution, all three tag spellings — drives the WHOLE surface in
+one test with no hand edits:
+
+    workflow generate (both emitters) → fleet-build (CLI) → serve →
+    client predict → Influx forwarder.
+
+docs/PORTING.md documents the contract; this test is the contract.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+import yaml
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "ported_gordo_config.yaml"
+)
+
+
+def test_crd_wrapper_normalizes():
+    """The CRD wrapper (apiVersion/kind/metadata/spec.config) unwraps: the
+    project name comes from metadata.name, machines/globals from
+    spec.config, and the per-machine evaluation override survives."""
+    from gordo_components_tpu.workflow import NormalizedConfig
+
+    config = NormalizedConfig(open(FIXTURE).read())
+    assert config.project_name == "ported-project"
+    assert [m.name for m in config.machines] == ["ported-m1", "ported-m2"]
+    assert config.machines[0].evaluation.get("n_splits", 2) == 2
+    assert config.machines[1].evaluation["n_splits"] == 0
+    # dotted-path model carried through verbatim (resolution is the
+    # serializer's job, not the normalizer's)
+    assert (
+        "gordo_components.model.anomaly.diff.DiffBasedAnomalyDetector"
+        in config.machines[0].model
+    )
+    with pytest.raises(ValueError, match="spec.config"):
+        NormalizedConfig({"spec": {}, "metadata": {"name": "x"}})
+
+
+@pytest.mark.slow
+def test_ported_config_end_to_end(tmp_path):
+    """The full ported-user journey on the verbatim fixture."""
+    from click.testing import CliRunner
+    from werkzeug.serving import make_server
+
+    from gordo_components_tpu.cli import gordo
+    from gordo_components_tpu.client import Client, CsvForwarder
+    from gordo_components_tpu.client.forwarders import (
+        ForwardPredictionsIntoInflux,
+    )
+    from gordo_components_tpu.serializer import load_metadata
+    from gordo_components_tpu.server import build_app
+
+    runner = CliRunner()
+
+    # 1. workflow generate — both emitters accept the CRD config verbatim
+    for extra in ([], ["--tpu", "--tpu-hosts", "2"]):
+        result = runner.invoke(
+            gordo,
+            ["workflow", "generate", "--machine-config", FIXTURE, *extra],
+        )
+        assert result.exit_code == 0, result.output
+        docs = [d for d in yaml.safe_load_all(result.output) if d]
+        assert docs, "emitter produced no documents"
+        assert any("ported-project" in json.dumps(d) for d in docs)
+
+    # 2. fleet-build from the same file, no edits
+    out_dir = str(tmp_path / "models")
+    result = runner.invoke(
+        gordo,
+        ["fleet-build", "--machine-config", FIXTURE,
+         "--output-dir", out_dir, "--n-devices", "2"],
+    )
+    assert result.exit_code == 0, result.output
+    dirs = json.loads(result.output)
+    assert set(dirs) == {"ported-m1", "ported-m2"}
+    # the per-machine evaluation override from the CRD took effect
+    meta2 = load_metadata(dirs["ported-m2"])
+    cv2 = meta2["model"]["model_builder_metadata"]["cross_validation"]
+    assert cv2["n_splits"] == 0
+    meta1 = load_metadata(dirs["ported-m1"])
+    cv1 = meta1["model"]["model_builder_metadata"]["cross_validation"]
+    assert cv1["n_splits"] == 2
+
+    # 3. serve the built fleet (in-process werkzeug, real sockets)
+    app = build_app(dirs, project="ported-project")
+    server = make_server("127.0.0.1", 0, app, threaded=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+
+        # 4. client predict over the trained range (server-side data fetch
+        # through the machine's own dataset config)
+        client = Client(base, project="ported-project")
+        assert client.resolve_machines() == ["ported-m1", "ported-m2"]
+        frames = client.predict(
+            "2023-01-01T00:00:00+00:00",
+            "2023-01-02T00:00:00+00:00",
+        )
+        assert set(frames) == {"ported-m1", "ported-m2"}
+        for name, frame in frames.items():
+            scores = np.ravel(frame["total-anomaly-score"].values)
+            assert len(scores) and np.isfinite(scores).all(), name
+
+        # 5. forwarders: CSV to disk + the Influx forwarder (injected
+        # client — the reference's write_points surface)
+        csv_dir = tmp_path / "csv"
+        csv_dir.mkdir()
+        CsvForwarder(str(csv_dir)).forward("ported-m1", frames["ported-m1"])
+        assert (csv_dir / "ported-m1.csv").exists()
+
+        written = []
+
+        class FakeInflux:
+            def write_points(self, frame, measurement, tags=None):
+                written.append((measurement, tags, len(frame)))
+
+        fwd = ForwardPredictionsIntoInflux(
+            measurement="anomaly", client=FakeInflux()
+        )
+        for name, frame in frames.items():
+            fwd.forward(name, frame)
+        assert {t["machine"] for _, t, _ in written} == {
+            "ported-m1", "ported-m2"
+        }
+        assert all(count > 0 for _, _, count in written)
+    finally:
+        server.shutdown()
