@@ -87,20 +87,39 @@ def gather_windows(
     arithmetic as :func:`sliding_windows`, kept here so the off-by-one
     contract stays in this module.
 
-    Lowered as a vmapped ``dynamic_slice``, NOT advanced indexing: ``k``
-    gather slices of a contiguous ``(L, F)`` block each, instead of an
-    XLA gather addressed by ``k x L`` scalar row indices — on TPU the
-    contiguous-slice form is the fast path (the element-addressed form
-    serializes on the scalar core and was the lead suspect for the r4
-    windowed fleets' ~1000x-below-roofline step times). Semantics match
-    for every start in ``[0, n - L]`` — all starts the training loop can
-    produce (padding windows carry start 0); ``dynamic_slice`` clamps a
-    hypothetical out-of-range start where advanced indexing would clamp
-    each row index individually."""
+    Lowered as ONE ``lax.gather`` of ``k`` contiguous ``(L, F)`` slices
+    instead of advanced indexing (an XLA gather addressed by ``k x L``
+    scalar row starts with slice_sizes ``(1, F)``): on TPU the
+    element-addressed form serializes on the scalar core and is the
+    lead suspect for the r4 windowed fleets' ~1000x-below-roofline step
+    times; the big-slice form is the fast path.
+    ``tools/tpu_probe_gathers.py`` A/Bs both on hardware. Compile cost
+    is a wash — 13.5 s (this form) vs 13.2 s (indexed) for the full
+    LSTM fleet program on XLA:CPU, measured r5 with the backend
+    properly pinned (an earlier ">800 s blowup" reading was a
+    dead-tunnel axon probe hang, not a compile).
+
+    Out-of-bounds semantics differ from advanced indexing IN A WAY THAT
+    NEVER FIRES: ``mode="clip"`` clamps the window START to ``n - L``
+    (one shifted whole window, like ``dynamic_slice``), while advanced
+    indexing clamps each row index individually (a window whose tail
+    repeats row ``n-1``). Every start the training loop can produce is
+    in ``[0, n - L]`` — batches index real windows and padding windows
+    carry start 0 — so the two forms are bit-identical in use; do not
+    rely on either clamping behavior for a hypothetical OOB start."""
     n_features = rows.shape[1]
-    return jax.vmap(
-        lambda s: jax.lax.dynamic_slice(rows, (s, 0), (lookback_window, n_features))
-    )(starts)
+    dnums = jax.lax.GatherDimensionNumbers(
+        offset_dims=(1, 2),
+        collapsed_slice_dims=(),
+        start_index_map=(0,),
+    )
+    return jax.lax.gather(
+        rows,
+        starts[:, None],
+        dnums,
+        slice_sizes=(lookback_window, n_features),
+        mode="clip",
+    )
 
 
 def reconstruction_targets(x: jnp.ndarray, lookback_window: int) -> jnp.ndarray:

@@ -87,7 +87,14 @@ class NormalizedConfig:
             # kind: Gordo): machines/globals live under spec.config and the
             # project name under metadata.name — accepted verbatim so a
             # deployed gordo config ports with zero edits (VERDICT r4 #7)
-            crd_name = (config.get("metadata") or {}).get("name")
+            metadata = config.get("metadata")
+            if metadata is not None and not isinstance(metadata, dict):
+                raise ValueError(
+                    "CRD-shaped fleet config has a non-mapping metadata "
+                    f"({type(metadata).__name__}); expected e.g. "
+                    "{name: my-project}"
+                )
+            crd_name = (metadata or {}).get("name")
             inner = config["spec"].get("config")
             if not isinstance(inner, dict):
                 raise ValueError(

@@ -3,6 +3,7 @@ a missing benchmark artifact, so its measurement core and JSON schema are
 guarded here on a tiny CPU config."""
 
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -41,6 +42,8 @@ def test_bench_emits_valid_json_with_split_measurements(tmp_path):
             "BENCH_SERVE_MACHINES": "4",
             "BENCH_SERVE_REQUESTS": "8",
             "JAX_PLATFORMS": "cpu",
+            # smoke-shape rows must not pollute the checked-in history
+            "GORDO_BENCH_HISTORY": os.devnull,
         },
         capture_output=True,
         text=True,
@@ -271,6 +274,7 @@ def test_bench_failed_config_does_not_redden_artifact(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_bench_config", stubbed)
     monkeypatch.setenv("BENCH_CPU", "1")
     monkeypatch.setenv("BENCH_NO_SERVING", "1")
+    monkeypatch.setenv("GORDO_BENCH_HISTORY", os.devnull)
     monkeypatch.setenv(
         "BENCH_CONFIGS", "dense_ae_10tag,lstm_ae_50tag"
     )
@@ -298,6 +302,7 @@ def test_bench_failed_headline_reports_zero_not_substitute(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_bench_config", stubbed)
     monkeypatch.setenv("BENCH_CPU", "1")
     monkeypatch.setenv("BENCH_NO_SERVING", "1")
+    monkeypatch.setenv("GORDO_BENCH_HISTORY", os.devnull)
     monkeypatch.setenv(
         "BENCH_CONFIGS", "dense_ae_10tag,lstm_ae_50tag"
     )
@@ -366,6 +371,8 @@ def test_bench_degraded_mode_runs_headline_only(tmp_path):
             "BENCH_SERVE_MACHINES": "4",
             "BENCH_SERVE_REQUESTS": "8",
             "JAX_PLATFORMS": "cpu",
+            # smoke-shape rows must not pollute the checked-in history
+            "GORDO_BENCH_HISTORY": os.devnull,
         },
         capture_output=True,
         text=True,
@@ -392,6 +399,8 @@ def test_bench_serving_emits_valid_json(tmp_path):
             "BENCH_SERVE_MACHINES": "4",
             "BENCH_SERVE_REQUESTS": "8",
             "JAX_PLATFORMS": "cpu",
+            # smoke-shape rows must not pollute the checked-in history
+            "GORDO_BENCH_HISTORY": os.devnull,
         },
         capture_output=True,
         text=True,

@@ -32,7 +32,7 @@ def test_crd_wrapper_normalizes():
     config = NormalizedConfig(open(FIXTURE).read())
     assert config.project_name == "ported-project"
     assert [m.name for m in config.machines] == ["ported-m1", "ported-m2"]
-    assert config.machines[0].evaluation.get("n_splits", 2) == 2
+    assert config.machines[0].evaluation["n_splits"] == 2  # from globals
     assert config.machines[1].evaluation["n_splits"] == 0
     # dotted-path model carried through verbatim (resolution is the
     # serializer's job, not the normalizer's)

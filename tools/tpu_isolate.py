@@ -20,7 +20,24 @@ live tunnel. This canary answers that with a bounded cost:
   ``BENCH_CV_PARALLEL=0`` explicitly so even a stale =1 in the shell
   cannot burn ~25 min/config on compiles.
 
-Usage: ``python tools/tpu_isolate.py [budget_s]`` (default 420).
+A second mode (round 5) probes scan unrolling for the TRANSFORMER
+fleet: PatchTST's step body has no inner recurrent scan, so the LSTM
+unroll blowup may not apply — but "may not" is not a bet the unattended
+bench takes. ``mode=tst_unroll`` compiles the ``patchtst_bf16`` fleet
+with ``fit_unroll=4``; success unlocks ``BENCH_FIT_UNROLL=4`` for the
+bench's non-remat transformer configs only (LSTM configs never unroll).
+
+Usage: ``python tools/tpu_isolate.py [budget_s] [cv|tst_unroll]``
+(defaults 420, cv; args accepted in either order).
+
+LOCAL TESTING: the child deliberately does NOT pin a backend (on a live
+tunnel it must compile for the TPU). With the tunnel down,
+``JAX_PLATFORMS=cpu`` alone does NOT pin CPU once the axon plugin is
+installed — the child hangs probing the dead tunnel and the budget
+expiring reads exactly like a pathological compile (this bit round 5:
+three bogus ">800 s" readings). Export ``GORDO_ISOLATE_CPU=1`` to make
+the child pin the CPU backend via jax.config for a real local compile
+measurement.
 """
 
 import json
@@ -30,7 +47,10 @@ import sys
 import time
 
 CHILD = r"""
-import json, sys, time
+import json, os, sys, time
+if os.environ.get("GORDO_ISOLATE_CPU") == "1":  # local-testing pin; see
+    import jax                                  # module docstring
+    jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, %(repo)r)
 from gordo_components_tpu.utils.backend import enable_persistent_compile_cache
 enable_persistent_compile_cache()
@@ -39,26 +59,51 @@ from gordo_components_tpu.parallel.fleet import fleet_executable
 from gordo_components_tpu.serializer import pipeline_from_definition
 from bench import _configs
 
-cfg = _configs(False, 10, 128)["lstm_ae_50tag"]
+cfg = _configs(False, 10, 128)[%(config)r]
 probe = pipeline_from_definition(cfg["model"])
 spec = _spec_for(
     _analyze_model(probe), cfg["tags"], cfg["tags"], n_splits=cfg["n_splits"]
 )
-assert spec.cv_parallel and spec.fit_unroll == 1, spec
+%(spec_tweak)s
 t = time.perf_counter()
 fleet_executable(spec, cfg["machines"], cfg["rows"], cfg["tags"], cfg["tags"])
 print(json.dumps({"compile_s": round(time.perf_counter() - t, 1)}))
 """
 
+# mode -> (bench config, spec assertion/tweak line)
+MODES = {
+    "cv": (
+        "lstm_ae_50tag",
+        "assert spec.cv_parallel and spec.fit_unroll == 1, spec",
+    ),
+    "tst_unroll": (
+        "patchtst_bf16",
+        "spec = spec._replace(fit_unroll=4)",
+    ),
+}
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main() -> int:
-    budget_s = float(sys.argv[1]) if len(sys.argv) > 1 else 420.0
+    # args in any order: a numeric one is the budget, a known name the
+    # mode (`tpu_isolate.py tst_unroll` must not die in float())
+    budget_s, mode = 420.0, "cv"
+    for arg in sys.argv[1:]:
+        try:
+            budget_s = float(arg)
+        except ValueError:
+            mode = arg
+    if mode not in MODES:
+        print(json.dumps({"verdict": "failed",
+                          "note": f"unknown mode {mode!r}"}))
+        return 1
+    config, spec_tweak = MODES[mode]
+    child = CHILD % {"repo": REPO, "config": config, "spec_tweak": spec_tweak}
     started = time.time()
     try:
         out = subprocess.run(
-            [sys.executable, "-u", "-c", CHILD % {"repo": REPO}],
+            [sys.executable, "-u", "-c", child],
             capture_output=True,
             text=True,
             timeout=budget_s,
@@ -69,9 +114,10 @@ def main() -> int:
             json.dumps(
                 {
                     "verdict": "pathological",
+                    "mode": mode,
                     "timeout_s": budget_s,
-                    "note": "vmap-CV lstm fleet compile exceeded budget; "
-                    "bench keeps its scan-CV TPU default; the runbook pins =0",
+                    "note": "fleet compile exceeded budget; bench keeps "
+                    "its safe default; the runbook pins the knob off",
                 }
             )
         )
@@ -91,7 +137,7 @@ def main() -> int:
         )
         return 1
     result = json.loads(line)
-    result.update({"verdict": "ok", "wall_s": wall})
+    result.update({"verdict": "ok", "mode": mode, "wall_s": wall})
     print(json.dumps(result))
     return 0
 

@@ -2,11 +2,16 @@
 
 VERDICT r4 weak #1: the PatchTST fleet ran ~1000x below roofline on TPU
 (130 GFLOP/s on a 197 TFLOP/s part) with vs_single 0.99 — throughput-
-bound on something that is NOT the MXU. The r5 hypothesis (shipped in
-``ops/windowing.py`` / ``models/factories/transformer.py``) is gather
-lowering: advanced-index window gathers address ``batch x L`` scalar row
-indices through the scalar core, while the vmapped ``dynamic_slice``
-form gathers ``batch`` contiguous ``(L, F)`` slices.
+bound on something that is NOT the MXU. The r5 hypothesis is gather
+lowering: the r4 advanced-index window gathers addressed ``batch x L``
+scalar row indices through the scalar core, while a contiguous-slice
+gather moves ``batch`` whole ``(L, F)`` blocks. The slice form (one
+``lax.gather``) IS the shipped ``gather_windows`` as of r5 — compile
+cost is a wash on XLA:CPU (~14 s either way for the LSTM fleet program,
+properly backend-pinned) — and this probe settles the EXECUTION
+question on the live chip by timing the shipped form against the r4
+indexed form. (The in-model PatchTST patching similarly shipped as
+static slice+stack.)
 
 This probe times the PRIMITIVES side by side on the live chip, so the
 next artifact can attribute the fleet numbers instead of guessing:
@@ -49,7 +54,8 @@ def _timed(fn, *args, reps: int = 20) -> float:
 
 
 def _indexed_gather(rows, starts, L):
-    # the r4 lowering, kept here verbatim for the A/B
+    # the r4 lowering (k x L scalar row starts, slice_sizes (1, F)),
+    # kept verbatim as the A/B counterpart to the shipped gather_windows
     return rows[starts[:, None] + jnp.arange(L)[None, :]]
 
 
@@ -72,6 +78,7 @@ def main() -> None:
             rng.integers(0, n_rows - 33, size=batch).astype(np.int32)
         )
         L = 32
+        # the SHIPPED slice lowering vs the r4 indexed form
         sliced = jax.jit(lambda r, s: gather_windows(r, s, L))
         indexed = jax.jit(lambda r, s: _indexed_gather(r, s, L))
         np.testing.assert_allclose(  # same windows, or the A/B is void

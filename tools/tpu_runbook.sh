@@ -62,6 +62,27 @@ else
   CANARY_ENV=(BENCH_CV_PARALLEL=0)
 fi
 
+# canary 2 (r5) — transformer scan-unroll: PatchTST's step body has no
+# inner recurrent scan, so the LSTM unroll compile blowup (28.7 s ->
+# ~25 min, r4 TPU) may not apply to it; XLA:CPU compiles patchtst
+# unroll=4 in 32 s (vs 19 s at unroll=1, measured r5 properly pinned).
+# CPU does not predict TPU, so only a PASSING bounded probe on the live
+# chip unlocks fit_unroll=4 for the non-remat transformer bench configs
+# (BENCH_FIT_UNROLL; LSTM configs never unroll). Its compile also warms
+# the persistent cache for the bench leg.
+echo "$(date -Is) runbook leg: tst-unroll canary" | tee -a "$LOG"
+if TST_OUT=$(timeout 360 python tools/tpu_isolate.py 300 tst_unroll 2>> "$LOG"); then
+  echo "$(date -Is) tst-unroll canary OK: $TST_OUT — bench legs unlock" \
+    "fit_unroll=4 for transformer configs" | tee -a "$LOG"
+  CANARY_ENV+=(BENCH_FIT_UNROLL=4)
+else
+  echo "$(date -Is) tst-unroll canary PATHOLOGICAL: ${TST_OUT:-no output}" \
+    "— transformer configs keep fit_unroll=1" | tee -a "$LOG"
+  # explicit =1 (no-op value), NOT merely unset: a stale =4 in the
+  # operator's shell must not override the verdict
+  CANARY_ENV+=(BENCH_FIT_UNROLL=1)
+fi
+
 # gather-lowering ablation probe (r5): attributes the windowed fleets'
 # below-roofline step times (slice vs indexed gathers, real train step
 # with/without the gather). Cheap (~2-3 min) and strictly bounded, so it
