@@ -643,7 +643,8 @@ def _append_history(out: Dict[str, Any]) -> None:
             "env": {
                 k: os.environ[k]
                 for k in ("BENCH_MACHINES", "BENCH_EPOCHS", "BENCH_FULL",
-                          "BENCH_CONFIGS", "BENCH_CV_PARALLEL")
+                          "BENCH_CONFIGS", "BENCH_CV_PARALLEL", "BENCH_CPU",
+                          "BENCH_FIT_UNROLL")
                 if k in os.environ
             },
             "value": out.get("value"),
@@ -711,12 +712,18 @@ def main() -> None:
                 k: v for k, v in configs.items() if k not in skipped_plant
             }
     skipped_degraded: list = []
-    if degraded and not only:
-        # the fallback must finish inside the driver's budget: the windowed
-        # LSTM/PatchTST configs are MXU workloads (bf16 emulation, big
-        # einsums) that run for HOURS on CPU — measure the headline dense
-        # fleet honestly and say exactly what was skipped, instead of
-        # timing out with no artifact. BENCH_CONFIGS overrides.
+    # keyed off the ACTUAL backend (not env vars): a plain run on a host
+    # with no accelerator plugin must not walk into the trap either
+    if (degraded or not on_tpu) and not only:
+        # any CPU run must finish inside a sane budget — not just the
+        # driver's degraded fallback: the windowed LSTM/PatchTST configs
+        # are MXU workloads (bf16 emulation, big einsums) that run for
+        # HOURS on CPU (r3: config 5 killed after 55 min; r5: an operator
+        # BENCH_CPU=1 rehearsal walked into the same trap) — measure the
+        # headline dense fleet honestly and say exactly what was skipped,
+        # instead of timing out with no artifact. An explicit
+        # BENCH_CONFIGS naming a config overrides (their budget, their
+        # call).
         skipped_degraded = [
             k for k, v in configs.items() if not v.get("headline")
         ]
@@ -775,6 +782,8 @@ def main() -> None:
             out["degraded"] = (
                 "accelerator tunnel down; attempted on the CPU backend"
             )
+        elif skipped_degraded:
+            out["skipped_cpu_configs"] = skipped_degraded
         _append_history(out)
         print(json.dumps(out))
         return
@@ -808,6 +817,8 @@ def main() -> None:
             out["degraded"] = (
                 "accelerator tunnel down; measured on the CPU backend"
             )
+        elif skipped_degraded:
+            out["skipped_cpu_configs"] = skipped_degraded
         _append_history(out)
         print(json.dumps(out))
         return
@@ -847,6 +858,9 @@ def main() -> None:
                 else ""
             )
         )
+    elif skipped_degraded:
+        # explicit BENCH_CPU=1 run: same skip, surfaced under its own key
+        out["skipped_cpu_configs"] = skipped_degraded
     _append_history(out)
     print(json.dumps(out))
 
