@@ -162,3 +162,26 @@ def test_gather_windows_matches_sliding_windows():
     got = np.asarray(gather_windows(rows, starts, 4))
     for j, s in enumerate([9, 2, 17]):
         np.testing.assert_array_equal(got[j], np.asarray(rows[s : s + 4]))
+
+
+def test_gather_windows_lowers_to_contiguous_slice_gather():
+    """The TPU-fast-path contract (r5): gather_windows must stay ONE
+    gather of k contiguous (L, F) slices — not an advanced-indexing
+    gather addressed by k x L scalar rows (slice_sizes (1, F), the r4
+    lowering suspected of the below-roofline windowed step times). Pin
+    the HLO so a refactor can't silently regress the lowering."""
+    import jax
+    import jax.numpy as jnp
+
+    from gordo_components_tpu.ops.windowing import gather_windows
+
+    rows = jnp.zeros((40, 5), jnp.float32)
+    starts = jnp.zeros((8,), jnp.int32)
+    hlo = jax.jit(lambda r, s: gather_windows(r, s, 6)).lower(rows, starts)
+    text = hlo.as_text()
+    assert "stablehlo.gather" in text
+    # slice_sizes <6, 5> = one whole (L, F) window per index (the r4
+    # element-addressed form would read <1, 5> with a (k*L, 1) index)
+    assert "slice_sizes=array<i64:6,5>" in text.replace(" ", ""), (
+        text[-2000:]
+    )
