@@ -256,6 +256,38 @@ _FAKE_RESULT = {
 }
 
 
+def test_bench_cpu_backend_skips_mxu_configs(monkeypatch, capsys):
+    """Any non-TPU backend skips the windowed MXU-workload configs unless
+    BENCH_CONFIGS names them (r3: PatchTST-bf16 on CPU was killed after
+    55 min; r5: an operator BENCH_CPU=1 rehearsal hit the same trap) —
+    and the artifact says exactly what was skipped."""
+    import sys
+
+    sys.path.insert(0, _REPO_ROOT)
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_bench_config", lambda name, cfg: dict(_FAKE_RESULT)
+    )
+    monkeypatch.setattr(bench, "_calibration_ms", lambda: 1.0)
+    monkeypatch.setenv("BENCH_CPU", "1")
+    monkeypatch.setenv("BENCH_NO_SERVING", "1")
+    monkeypatch.setenv("GORDO_BENCH_HISTORY", os.devnull)
+    monkeypatch.delenv("BENCH_CONFIGS", raising=False)
+    bench.main()
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert list(payload["configs"]) == ["dense_ae_10tag"]
+    assert set(payload["skipped_cpu_configs"]) == {
+        "lstm_ae_50tag", "lstm_forecast_100tag", "patchtst_bf16",
+    }
+    # explicit BENCH_CONFIGS overrides the skip (operator's budget)
+    monkeypatch.setenv("BENCH_CONFIGS", "lstm_ae_50tag")
+    bench.main()
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert list(payload["configs"]) == ["lstm_ae_50tag"]
+    assert "skipped_cpu_configs" not in payload
+
+
 def test_bench_failed_config_does_not_redden_artifact(monkeypatch, capsys):
     """A config that raises (plant-scale OOM on a small chip) must record an
     error and leave the artifact parseable with the headline intact.
