@@ -669,6 +669,29 @@ def test_four_process_kill_mid_build_restores_from_checkpoint(tmp_path):
         assert os.path.isdir(os.path.join(out_dir, "models", f"mh-{i:02d}"))
 
 
+@pytest.mark.slow
+def test_four_process_ring_attention_parity():
+    """Ring attention across PROCESS boundaries (SURVEY §6.7 x §2.3): the
+    sequence axis shards over all 16 global devices of 4 Gloo processes,
+    so K/V ring hops traverse the inter-process transport — the CPU
+    stand-in for multi-host ICI/DCN. Every process must get dense-parity
+    output on its own shards."""
+    codes, outputs = _run_multihost_children(
+        ["--ring"], timeout=240, n_procs=4
+    )
+    if any(c != 0 for c in codes):  # possible port race — one retry
+        codes, outputs = _run_multihost_children(
+            ["--ring"], timeout=240, n_procs=4
+        )
+    assert all(c == 0 for c in codes), "children failed:\n" + "\n".join(outputs)
+    for pid in range(4):
+        assert any(
+            f"ring-attention@{pid} OK over 16 devices (dense+flash hops)"
+            in o
+            for o in outputs
+        ), pid
+
+
 # ------------------------------------------------------------ backend probe
 def test_call_with_timeout_paths():
     import time as _time
