@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: test testfast bench bench-serving images builder-image server-image watchman-image
+.PHONY: test testfast bench bench-serving metrics-smoke images builder-image server-image watchman-image
 
 test:
 	python -m pytest tests/ -q
@@ -14,6 +14,12 @@ bench:
 
 bench-serving:
 	python bench_serving.py
+
+# end-to-end exposition check: build a throwaway model, serve it, warm it,
+# scrape /metrics?format=prometheus, fail on malformed output or missing
+# standard series
+metrics-smoke:
+	JAX_PLATFORMS=cpu python tools/scrape_metrics.py --spawn
 
 images: builder-image server-image watchman-image
 

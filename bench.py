@@ -667,6 +667,18 @@ def _append_history(out: Dict[str, Any]) -> None:
         pass  # history is never worth failing an artifact over
 
 
+def _finish(out: Dict[str, Any]) -> None:
+    """Common artifact epilogue: attach the process metrics snapshot (the
+    run's compile counts, program-cache behavior, and build-phase totals
+    ride along in every BENCH_*.json for free — the regression context the
+    bare throughput number lacks), append the history line, print."""
+    from gordo_components_tpu.observability.registry import REGISTRY
+
+    out["metrics"] = REGISTRY.snapshot()
+    _append_history(out)
+    print(json.dumps(out))
+
+
 def main() -> None:
     from gordo_components_tpu.utils.backend import (
         enable_persistent_compile_cache,
@@ -784,8 +796,7 @@ def main() -> None:
             )
         elif skipped_degraded:
             out["skipped_cpu_configs"] = skipped_degraded
-        _append_history(out)
-        print(json.dumps(out))
+        _finish(out)
         return
     headline_candidates = [k for k in ok_names if configs[k].get("headline")]
     if not headline_candidates and any(
@@ -819,8 +830,7 @@ def main() -> None:
             )
         elif skipped_degraded:
             out["skipped_cpu_configs"] = skipped_degraded
-        _append_history(out)
-        print(json.dumps(out))
+        _finish(out)
         return
     # no config carries the headline flag only when BENCH_CONFIGS restricted
     # the set — the operator picked the config, and the unit string names it
@@ -861,8 +871,7 @@ def main() -> None:
     elif skipped_degraded:
         # explicit BENCH_CPU=1 run: same skip, surfaced under its own key
         out["skipped_cpu_configs"] = skipped_degraded
-    _append_history(out)
-    print(json.dumps(out))
+    _finish(out)
 
 
 if __name__ == "__main__":

@@ -369,6 +369,11 @@ def main() -> None:
             "accelerator tunnel down; measured on the CPU backend — "
             "NOT comparable to TPU anchors in BASELINE.md"
         )
+    # the run's own engine telemetry (program cache, compile/dispatch
+    # histograms) rides along — same block bench.py embeds
+    from gordo_components_tpu.observability.registry import REGISTRY
+
+    result["metrics"] = REGISTRY.snapshot()
     print(json.dumps(result))
 
 

@@ -28,10 +28,23 @@ from ..dataset import GordoBaseDataset
 from ..models.anomaly.base import AnomalyDetectorBase
 from ..models.metrics import METRICS
 from ..models.pipeline import clone_pipeline
+from ..observability.registry import REGISTRY
 from ..serializer import dump, pipeline_from_definition, pipeline_into_definition
 from ..utils import disk_registry
+from ..utils.profiling import PhaseTimer
 
 logger = logging.getLogger(__name__)
+
+_M_BUILD_SECONDS = REGISTRY.gauge(
+    "gordo_build_duration_seconds",
+    "Wall-clock duration of each machine's most recent single-machine build",
+    labels=("machine",),
+)
+_M_BUILDS = REGISTRY.counter(
+    "gordo_builds_total",
+    "Single-machine builds completed, by outcome (built / cached)",
+    labels=("outcome",),
+)
 
 
 def _dataset_from_config(data_config: Dict[str, Any]) -> GordoBaseDataset:
@@ -97,27 +110,37 @@ def build_model(
     n_splits = int(evaluation_config.get("n_splits", 3))
 
     build_started = time.perf_counter()
-    dataset = _dataset_from_config(data_config)
-    X, y = dataset.get_data()
+    timer = PhaseTimer()
+    with timer.phase("data_fetch"):
+        dataset = _dataset_from_config(data_config)
+        X, y = dataset.get_data()
 
     model = pipeline_from_definition(model_config)
 
     cv_metadata: Dict[str, Any] = {}
     if cv_mode != "build_only":
         cv_started = time.perf_counter()
-        if isinstance(model, AnomalyDetectorBase):
-            cv_metadata = model.cross_validate(X, y, n_splits=n_splits)
-        else:
-            X_arr = np.asarray(getattr(X, "values", X), dtype=np.float32)
-            y_arr = np.asarray(getattr(y, "values", y), dtype=np.float32)
-            cv_metadata = _generic_cross_validate(model, X_arr, y_arr, n_splits)
+        with timer.phase("cross_validation"):
+            if isinstance(model, AnomalyDetectorBase):
+                cv_metadata = model.cross_validate(X, y, n_splits=n_splits)
+            else:
+                X_arr = np.asarray(getattr(X, "values", X), dtype=np.float32)
+                y_arr = np.asarray(getattr(y, "values", y), dtype=np.float32)
+                cv_metadata = _generic_cross_validate(model, X_arr, y_arr, n_splits)
         cv_metadata["cv_duration_s"] = time.perf_counter() - cv_started
 
     fit_duration = None
     if cv_mode != "cross_val_only":
         fit_started = time.perf_counter()
-        model.fit(X, y)
+        with timer.phase("fit"):
+            model.fit(X, y)
         fit_duration = time.perf_counter() - fit_started
+
+    # phase accounting goes BOTH into the artifact's metadata (durable,
+    # per-machine) and the process registry (scrapeable, fleet-aggregated)
+    timer.publish()
+    _M_BUILD_SECONDS.labels(name).set(time.perf_counter() - build_started)
+    _M_BUILDS.labels("built").inc()
 
     build_metadata: Dict[str, Any] = {
         "name": name,
@@ -133,6 +156,7 @@ def build_model(
         },
         "dataset": dataset.get_metadata(),
         "build_duration_s": time.perf_counter() - build_started,
+        "build_phases": timer.report(),
         "user_defined": dict(metadata or {}),
     }
     return model, build_metadata
@@ -192,6 +216,7 @@ def provide_saved_model(
             logger.info(
                 "Model %r cache hit (key %s) -> %s", name, cache_key, cached
             )
+            _M_BUILDS.labels("cached").inc()
             return cached
         if cached:
             logger.warning(

@@ -71,18 +71,22 @@ def _load_config(value: Optional[str], kind: str) -> dict:
 @click.group("gordo")
 @click.option("--log-level", default="INFO", envvar="GORDO_LOG_LEVEL",
               show_default=True)
+@click.option("--log-format", default="text", envvar="GORDO_LOG_FORMAT",
+              show_default=True, type=click.Choice(["text", "json"]),
+              help="'json' emits one JSON object per record (trace/span ids "
+                   "as fields) for log pipelines; 'text' keeps the classic "
+                   "line format")
 @click.option("--debug-nans/--no-debug-nans", default=False,
               envvar="GORDO_DEBUG_NANS", show_default=True,
               help="Enable jax_debug_nans: compiled programs re-run op-by-op "
                    "at the first NaN and raise with the producing op "
                    "(SURVEY.md §6.2 — the rebuild's numeric sanitizer; "
                    "large slowdown, diagnostics only).")
-def gordo(log_level: str, debug_nans: bool):
+def gordo(log_level: str, log_format: str, debug_nans: bool):
     """gordo-components-tpu: fleet-scale TPU anomaly-model factory."""
-    logging.basicConfig(
-        level=log_level.upper(),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
+    from ..observability import configure_logging
+
+    configure_logging(log_level, log_format)
     import os
 
     platforms = os.environ.get("JAX_PLATFORMS")
@@ -137,6 +141,14 @@ _COMPILE_CACHE_OPT = click.option(
     "<output-dir>/.jax_compilation_cache; 'off' disables)",
 )
 
+_TRACE_DIR_OPT = click.option(
+    "--trace-dir",
+    envvar="GORDO_TRACE_DIR",
+    default=None,
+    help="write a jax.profiler device trace (TensorBoard/perfetto-loadable) "
+    "of the device work to this directory",
+)
+
 
 @gordo.command("build")
 @click.argument("name")
@@ -153,27 +165,31 @@ _COMPILE_CACHE_OPT = click.option(
 @click.option("--n-splits", default=3, show_default=True)
 @click.option("--print-cv-scores", is_flag=True, default=False)
 @_COMPILE_CACHE_OPT
+@_TRACE_DIR_OPT
 def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
-              metadata, cv_mode, n_splits, print_cv_scores, compile_cache_dir):
+              metadata, cv_mode, n_splits, print_cv_scores, compile_cache_dir,
+              trace_dir):
     """Build one machine's model (idempotent via the config-hash cache)."""
     from ..builder import provide_saved_model
     from ..dataset.dataset import InsufficientDataError
     from ..serializer import load_metadata
+    from ..utils.profiling import device_trace
 
     _enable_build_compile_cache(output_dir, compile_cache_dir)
     try:
         model_cfg = _load_config(model_config, "MODEL_CONFIG")
         data_cfg = _load_config(data_config, "DATA_CONFIG")
         user_meta = yaml.safe_load(metadata) if metadata else {}
-        model_dir = provide_saved_model(
-            name,
-            model_cfg,
-            data_cfg,
-            output_dir,
-            metadata=user_meta,
-            model_register_dir=model_register_dir,
-            evaluation_config={"cv_mode": cv_mode, "n_splits": n_splits},
-        )
+        with device_trace(trace_dir):
+            model_dir = provide_saved_model(
+                name,
+                model_cfg,
+                data_cfg,
+                output_dir,
+                metadata=user_meta,
+                model_register_dir=model_register_dir,
+                evaluation_config={"cv_mode": cv_mode, "n_splits": n_splits},
+            )
     except InsufficientDataError as exc:
         logger.error("Data error building %r: %s", name, exc)
         sys.exit(EXIT_DATA)
@@ -216,9 +232,10 @@ def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
 @click.option("--process-id", envvar="GORDO_PROCESS_ID", default=None,
               type=int, help="multi-host: this host's process index")
 @_COMPILE_CACHE_OPT
+@_TRACE_DIR_OPT
 def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
                     n_splits, seed, slice_size, coordinator_address,
-                    num_processes, process_id, compile_cache_dir):
+                    num_processes, process_id, compile_cache_dir, trace_dir):
     """Build an entire fleet: machines are bucketed and trained as vmapped
     programs sharded over the device mesh. With ``--coordinator-address``
     (or on a TPU pod with autodetectable cluster metadata plus explicit
@@ -277,6 +294,7 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
             mesh=mesh,
             seed=seed,
             n_splits=n_splits,
+            profile_dir=trace_dir,
             slice_size=slice_size or None,
         )
     except InsufficientDataError as exc:
@@ -331,7 +349,9 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
               help="shard every bucket's stacked params over all local "
                    "devices (HBM capacity mode for fleets whose stacked "
                    "weights exceed one chip; adds per-request gather hops)")
-def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet):
+@_TRACE_DIR_OPT
+def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
+                   trace_dir):
     """Serve built model(s) over REST."""
     import os
 
@@ -357,12 +377,14 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet):
         )
     if len(resolved) == 1 and not models_dir:
         run_server(next(iter(resolved.values())), host=host, port=port,
-                   project=project, shard_fleet=shard_fleet)
+                   project=project, shard_fleet=shard_fleet,
+                   trace_dir=trace_dir)
     else:
         # models_dir servers stay reload-capable (POST /reload picks up
         # machines a fleet build adds to the tree after startup)
         run_server(resolved, host=host, port=port, project=project,
-                   models_root=models_dir, shard_fleet=shard_fleet)
+                   models_root=models_dir, shard_fleet=shard_fleet,
+                   trace_dir=trace_dir)
 
 
 @gordo.command("run-watchman")

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from datetime import datetime
 from typing import Any, Dict, List, Optional, Sequence, Union
@@ -19,10 +20,24 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 import pandas as pd
 
+from ..observability import tracing
+from ..observability.registry import REGISTRY
 from .forwarders import PredictionForwarder
 from .utils import make_date_ranges
 
 logger = logging.getLogger(__name__)
+
+_M_RETRIES = REGISTRY.counter(
+    "gordo_client_retries_total",
+    "Client request retries, by cause (timeout / connection / http_5xx / "
+    "bad_body) — the client-side flakiness signal",
+    labels=("reason",),
+)
+_M_REQUESTS = REGISTRY.counter(
+    "gordo_client_requests_total",
+    "Client requests by terminal outcome (ok / permanent_4xx / exhausted)",
+    labels=("outcome",),
+)
 
 
 class ClientError(RuntimeError):
@@ -52,6 +67,13 @@ class Client:
         self.timeout = timeout
         self.forwarders = forwarders or []
 
+    def _backoff_delay(self, attempt: int) -> float:
+        """Exponential backoff with ±50% jitter: a fleet of clients whose
+        chunks all failed on the same server hiccup must not re-arrive in
+        one synchronized wave (the bare ``backoff * 2**(n-1)`` did exactly
+        that — every chunk of every machine retried on the same beat)."""
+        return self.retry_backoff * 2 ** (attempt - 1) * random.uniform(0.5, 1.5)
+
     # -- endpoint resolution -------------------------------------------------
     def resolve_machines(self) -> List[str]:
         """Explicit machine list, or discovery via the server's /models
@@ -73,27 +95,43 @@ class Client:
             f"/anomaly/prediction"
         )
         params = {"start": start.isoformat(), "end": end.isoformat()}
+        # one trace id per chunk request (adopting any id already bound to
+        # the calling context): the server echoes it and stamps it on its
+        # log records, so a slow chunk is grep-able end to end
+        headers = {tracing.TRACE_HEADER: tracing.current_or_new()}
         last_error: Optional[str] = None
         for attempt in range(self.retries + 1):
             if attempt:
-                await asyncio.sleep(self.retry_backoff * 2 ** (attempt - 1))
+                await asyncio.sleep(self._backoff_delay(attempt))
             try:
                 async with semaphore:
-                    async with session.post(url, params=params) as response:
+                    async with session.post(
+                        url, params=params, headers=headers
+                    ) as response:
                         if 400 <= response.status < 500:
                             body = await response.text()
+                            _M_REQUESTS.labels("permanent_4xx").inc()
                             raise ClientError(
                                 f"{machine} [{start}, {end}): "
                                 f"HTTP {response.status}: {body[:500]}"
                             )
                         if response.status >= 500:
                             last_error = f"HTTP {response.status}"
+                            _M_RETRIES.labels("http_5xx").inc()
                             continue
-                        return await response.json()
+                        payload = await response.json()
+                        _M_REQUESTS.labels("ok").inc()
+                        return payload
             except ClientError:
                 raise
+            except asyncio.TimeoutError as exc:  # distinct: a timing-out
+                # server looks healthy to connection-error counters
+                last_error = repr(exc)
+                _M_RETRIES.labels("timeout").inc()
             except Exception as exc:  # connection errors -> retry
                 last_error = repr(exc)
+                _M_RETRIES.labels("connection").inc()
+        _M_REQUESTS.labels("exhausted").inc()
         raise ClientError(
             f"{machine} [{start}, {end}): retries exhausted ({last_error})"
         )
@@ -172,33 +210,46 @@ class Client:
             raise ValueError(f"fmt must be 'parquet' or 'json', got {fmt!r}")
 
         # same retry contract as the async path (_fetch_chunk): 4xx is
-        # permanent, 5xx/connection errors retry with backoff, and every
-        # terminal failure surfaces as ClientError
+        # permanent, 5xx/connection errors retry with jittered backoff, and
+        # every terminal failure surfaces as ClientError
+        kwargs.setdefault("headers", {})[
+            tracing.TRACE_HEADER
+        ] = tracing.current_or_new()
         last_error: Optional[str] = None
         for attempt in range(self.retries + 1):
             if attempt:
-                time.sleep(self.retry_backoff * 2 ** (attempt - 1))
+                time.sleep(self._backoff_delay(attempt))
             try:
                 response = requests.post(url, timeout=self.timeout, **kwargs)
+            except requests.Timeout as exc:
+                last_error = repr(exc)
+                _M_RETRIES.labels("timeout").inc()
+                continue
             except requests.RequestException as exc:
                 last_error = repr(exc)
+                _M_RETRIES.labels("connection").inc()
                 continue
             if 400 <= response.status_code < 500:
+                _M_REQUESTS.labels("permanent_4xx").inc()
                 raise ClientError(
                     f"{machine}: HTTP {response.status_code}: "
                     f"{response.text[:500]}"
                 )
             if response.status_code >= 500:
                 last_error = f"HTTP {response.status_code}"
+                _M_RETRIES.labels("http_5xx").inc()
                 continue
             try:
                 payload = response.json()
             except ValueError:  # 2xx with a non-JSON body (broken proxy):
                 # retryable, and terminal failures stay ClientError
                 last_error = "2xx response with non-JSON body"
+                _M_RETRIES.labels("bad_body").inc()
                 continue
+            _M_REQUESTS.labels("ok").inc()
             chunk = self._chunk_frame(payload)
             return chunk if chunk is not None else pd.DataFrame()
+        _M_REQUESTS.labels("exhausted").inc()
         raise ClientError(
             f"{machine}: retries exhausted ({last_error})"
         )

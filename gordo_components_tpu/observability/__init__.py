@@ -1,0 +1,46 @@
+"""Unified observability: metrics registry, Prometheus exposition, and
+end-to-end request tracing.
+
+Three small modules every layer shares:
+
+- :mod:`.registry` — process-wide labeled Counter/Gauge/Histogram
+  primitives (``REGISTRY`` is the one instance telemetry records to).
+- :mod:`.exposition` — Prometheus text-format v0.0.4 rendering +
+  validation (``GET /metrics?format=prometheus``).
+- :mod:`.tracing` — contextvar trace/span ids propagated via the
+  ``X-Gordo-Trace-Id`` header and stamped onto every log record.
+- :mod:`.logsetup` — text/JSON logging configuration for the CLI.
+"""
+
+from .exposition import CONTENT_TYPE, parse_prometheus_text, render_prometheus
+from .logsetup import configure_logging
+from .registry import REGISTRY, Counter, Gauge, Histogram, Registry, get_registry
+from .tracing import (
+    TRACE_HEADER,
+    current_or_new,
+    get_trace_id,
+    install_log_record_factory,
+    new_trace_id,
+    span,
+    trace,
+)
+
+__all__ = [
+    "CONTENT_TYPE",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "REGISTRY",
+    "Registry",
+    "TRACE_HEADER",
+    "configure_logging",
+    "current_or_new",
+    "get_registry",
+    "get_trace_id",
+    "install_log_record_factory",
+    "new_trace_id",
+    "parse_prometheus_text",
+    "render_prometheus",
+    "span",
+    "trace",
+]

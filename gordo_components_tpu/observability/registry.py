@@ -1,0 +1,354 @@
+"""Process-wide labeled metric primitives: Counter / Gauge / Histogram.
+
+The reference has no metrics layer at all (SURVEY.md §6: debugging was
+kubectl logs); before this module the rebuild's only telemetry was the
+server's ad-hoc ``_Latency`` ring buffer and ``PhaseTimer`` durations that
+died with the build process. This registry is the ONE place every layer
+(client, server, engine, builder, fleet, watchman, bench) records to, so a
+single ``GET /metrics`` — JSON or Prometheus text — sees the whole process.
+
+Design (deliberately mirrors the retired ``_Latency``): lock-LIGHT, not
+lock-free — one ``threading.Lock`` per metric, held only for dict/list
+mutation; percentile math runs on a snapshot copied under the lock. A
+histogram keeps both cumulative buckets (Prometheus exposition) and a
+bounded rolling sample window (the JSON p50/p99 view a long-lived server
+can afford — unbounded per-request history is exactly what ``_Latency``'s
+``keep`` cap existed to prevent).
+
+Get-or-create semantics: ``registry.counter(name, ...)`` returns the
+existing metric when one is already registered under ``name`` (many
+ModelServer instances in one test process must share series, not crash),
+and raises on kind/label mismatch so two call sites can never silently
+write incompatible series under one name.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+INF = float("inf")
+
+# latency-oriented default buckets (seconds): sub-ms device dispatches up
+# through multi-second compiles land in distinct buckets
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, INF,
+)
+
+
+def _label_key(labelnames: Sequence[str], values: Sequence[str]) -> str:
+    """Canonical series key, rendered prometheus-style so the JSON snapshot
+    and the text exposition agree on identity: ``a="x",b="y"`` ('' when
+    unlabeled)."""
+    return ",".join(f'{n}="{v}"' for n, v in zip(labelnames, values))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _check_values(self, values: Tuple[str, ...]) -> Tuple[str, ...]:
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes {len(self.labelnames)} label value(s) "
+                f"{self.labelnames}, got {len(values)}"
+            )
+        return tuple(str(v) for v in values)
+
+
+class Counter(_Metric):
+    """Monotonically increasing float per label set."""
+
+    kind = "counter"
+
+    def __init__(self, name, help="", labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def labels(self, *values: str) -> "_BoundCounter":
+        return _BoundCounter(self, self._check_values(values))
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._inc((), amount)
+
+    def _inc(self, values: Tuple[str, ...], amount: float) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._values[values] = self._values.get(values, 0.0) + amount
+
+    def collect(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class _BoundCounter:
+    __slots__ = ("_metric", "_values")
+
+    def __init__(self, metric: Counter, values: Tuple[str, ...]):
+        self._metric = metric
+        self._values = values
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._metric._inc(self._values, amount)
+
+
+class Gauge(_Metric):
+    """Last-written float per label set (set/inc/dec)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help="", labelnames=()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def labels(self, *values: str) -> "_BoundGauge":
+        return _BoundGauge(self, self._check_values(values))
+
+    def set(self, value: float) -> None:
+        self._set((), value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._inc((), amount)
+
+    def _set(self, values: Tuple[str, ...], value: float) -> None:
+        with self._lock:
+            self._values[values] = float(value)
+
+    def _inc(self, values: Tuple[str, ...], amount: float) -> None:
+        with self._lock:
+            self._values[values] = self._values.get(values, 0.0) + amount
+
+    def collect(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._values)
+
+
+class _BoundGauge:
+    __slots__ = ("_metric", "_values")
+
+    def __init__(self, metric: Gauge, values: Tuple[str, ...]):
+        self._metric = metric
+        self._values = values
+
+    def set(self, value: float) -> None:
+        self._metric._set(self._values, value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._metric._inc(self._values, amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._metric._inc(self._values, -amount)
+
+
+class _HistSeries:
+    __slots__ = ("bucket_counts", "sum", "count", "samples")
+
+    def __init__(self, n_buckets: int):
+        self.bucket_counts = [0] * n_buckets  # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+        self.samples: List[float] = []  # bounded rolling window
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram + bounded sample window per label set.
+
+    Buckets serve the Prometheus exposition (exact, unbounded count);
+    the ``keep``-bounded sample window serves the JSON p50/p99 view with
+    ``_Latency``'s memory contract (a year-old server holds ``keep``
+    floats per series, not per-request history).
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS, keep: int = 1000):
+        super().__init__(name, help, labelnames)
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds or bounds[-1] != INF:
+            bounds.append(INF)
+        self.buckets = tuple(bounds)
+        self.keep = keep
+        self._series: Dict[Tuple[str, ...], _HistSeries] = {}
+
+    def labels(self, *values: str) -> "_BoundHistogram":
+        return _BoundHistogram(self, self._check_values(values))
+
+    def observe(self, value: float) -> None:
+        self._observe((), value)
+
+    def _observe(self, values: Tuple[str, ...], value: float) -> None:
+        value = float(value)
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            series = self._series.get(values)
+            if series is None:
+                series = self._series[values] = _HistSeries(len(self.buckets))
+            series.bucket_counts[i] += 1
+            series.sum += value
+            series.count += 1
+            series.samples.append(value)
+            if len(series.samples) > self.keep:
+                del series.samples[: -self.keep]
+
+    def collect(self) -> Dict[Tuple[str, ...], Dict[str, Any]]:
+        """Snapshot copy: ``{labelvalues: {"buckets": [(le, cumulative)],
+        "sum": s, "count": n, "samples": [...]}}``."""
+        with self._lock:
+            copied = {
+                values: (list(s.bucket_counts), s.sum, s.count, list(s.samples))
+                for values, s in self._series.items()
+            }
+        out: Dict[Tuple[str, ...], Dict[str, Any]] = {}
+        for values, (counts, total, count, samples) in copied.items():
+            cumulative, acc = [], 0
+            for le, n in zip(self.buckets, counts):
+                acc += n
+                cumulative.append((le, acc))
+            out[values] = {
+                "buckets": cumulative,
+                "sum": total,
+                "count": count,
+                "samples": samples,
+            }
+        return out
+
+    def stats(self) -> Dict[Tuple[str, ...], Dict[str, float]]:
+        """Percentile view per series (p50/p99/mean over the bounded sample
+        window, count over the full lifetime) — the JSON ``/metrics``
+        shape the retired ``_Latency.snapshot`` produced."""
+        out = {}
+        for values, data in self.collect().items():
+            samples = data["samples"]
+            if samples:
+                ordered = sorted(samples)
+                n = len(ordered)
+                p50 = ordered[min(n - 1, int(round(0.50 * (n - 1))))]
+                p99 = ordered[min(n - 1, int(round(0.99 * (n - 1))))]
+                mean = sum(samples) / n
+            else:
+                p50 = p99 = mean = 0.0
+            out[values] = {
+                "count": data["count"],
+                "p50": p50,
+                "p99": p99,
+                "mean": mean,
+            }
+        return out
+
+
+class _BoundHistogram:
+    __slots__ = ("_metric", "_values")
+
+    def __init__(self, metric: Histogram, values: Tuple[str, ...]):
+        self._metric = metric
+        self._values = values
+
+    def observe(self, value: float) -> None:
+        self._metric._observe(self._values, value)
+
+
+class Registry:
+    """Named metric collection with get-or-create registration."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name, help, labelnames, **kwargs) -> Any:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls) or existing.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind} with labels {existing.labelnames}; "
+                        f"requested {cls.kind} with labels {labelnames}"
+                    )
+                if isinstance(existing, Histogram):
+                    # same silent-incompatibility hazard as kind/labels:
+                    # observations from a call site expecting different
+                    # bucket bounds (or window size) would be binned wrong
+                    requested = Histogram(name, help, labelnames, **kwargs)
+                    if (existing.buckets != requested.buckets
+                            or existing.keep != requested.keep):
+                        raise ValueError(
+                            f"histogram {name!r} already registered with "
+                            f"buckets {existing.buckets} / keep "
+                            f"{existing.keep}; requested "
+                            f"{requested.buckets} / keep {requested.keep}"
+                        )
+                return existing
+            metric = cls(name, help, labelnames, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  keep: int = 1000) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labels, buckets=buckets, keep=keep
+        )
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return sorted(self._metrics.values(), key=lambda m: m.name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view of every metric: counters/gauges as plain values,
+        histograms as {count, sum, mean, p50, p99} per series (keyed
+        prometheus-style: ``endpoint="healthz"``)."""
+        out: Dict[str, Any] = {}
+        for metric in self.metrics():
+            if isinstance(metric, Histogram):
+                stats = metric.stats()
+                collected = metric.collect()
+                series = {
+                    _label_key(metric.labelnames, values): {
+                        "count": s["count"],
+                        "sum": collected[values]["sum"],
+                        "mean": s["mean"],
+                        "p50": s["p50"],
+                        "p99": s["p99"],
+                    }
+                    for values, s in stats.items()
+                }
+            else:
+                series = {
+                    _label_key(metric.labelnames, values): value
+                    for values, value in metric.collect().items()
+                }
+            out[metric.name] = {
+                "kind": metric.kind,
+                "help": metric.help,
+                "series": series,
+            }
+        return out
+
+
+# THE process-wide registry every layer records to. Tests exercising
+# registry semantics construct their own Registry; everything shipping
+# telemetry uses this one so one scrape sees the whole process.
+REGISTRY = Registry()
+
+
+def get_registry() -> Registry:
+    return REGISTRY

@@ -40,6 +40,7 @@ from ..builder.build_model import _dataset_from_config, calculate_model_key
 from ..models.analysis import Analyzed as _Analyzed
 from ..models.analysis import analyze_model as _analyze_model
 from ..models.transformers import MinMaxScaler, StandardScaler
+from ..observability.registry import REGISTRY
 from ..ops.scaling import ScalerParams
 from ..serializer import dump, pipeline_from_definition
 from ..utils import disk_registry
@@ -54,6 +55,18 @@ from .fleet import (
 from .mesh import pad_to_multiple
 
 logger = logging.getLogger(__name__)
+
+_M_FLEET_MACHINES = REGISTRY.counter(
+    "gordo_fleet_machines_total",
+    "Fleet-build machines resolved, by outcome (completed / cached)",
+    labels=("outcome",),
+)
+_M_MACHINE_BUILD_SECONDS = REGISTRY.gauge(
+    "gordo_fleet_machine_build_seconds",
+    "Amortized build duration of each machine's latest fleet build "
+    "(slice wall-clock / machines in slice)",
+    labels=("machine",),
+)
 
 # sliced builds round the padded row axis up to a multiple of this, so
 # heterogeneous-history slices collapse onto few compiled shapes
@@ -893,6 +906,7 @@ def build_fleet(
             if cached and os.path.isdir(cached):
                 logger.info("Fleet cache hit for %r -> %s", machine.name, cached)
                 results[machine.name] = cached
+                _M_FLEET_MACHINES.labels("cached").inc()
                 continue
         pending.append((machine, cache_key, eff_splits, eff_cv_parallel))
     if ignored_eval:
@@ -1169,6 +1183,10 @@ def build_fleet(
                                 model_register_dir, item["cache_key"], model_dir
                             )
                         results[machine.name] = model_dir
+                        _M_FLEET_MACHINES.labels("completed").inc()
+                        _M_MACHINE_BUILD_SECONDS.labels(machine.name).set(
+                            amortized
+                        )
                         manifest[machine.name] = {
                             "status": "completed",
                             "model_dir": model_dir,
@@ -1198,6 +1216,9 @@ def build_fleet(
         watchdog.stop()
         prefetcher.shutdown(wait=True, cancel_futures=True)
     checkpointer.close()
+    # phase totals land in the same registry serving scrapes, under the
+    # fleet prefix so single-machine and fleet builds stay distinguishable
+    timer.publish(prefix="gordo_fleet_build")
     logger.info(
         "Fleet build: %d machines in %.1fs (%d cached); phases: %s",
         len(machines),

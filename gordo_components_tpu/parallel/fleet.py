@@ -31,6 +31,7 @@ reference's canonical ``DiffBasedAnomalyDetector(TransformedTargetRegressor
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -38,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.train import FitResult, make_fit_fn, make_predict_fn
+from ..observability.registry import REGISTRY
 from ..ops import windowing
 from ..ops.scaling import ScalerParams
 from ..utils.cache import cached as _cached  # shared FIFO program memo
@@ -45,6 +47,19 @@ from .mesh import fleet_sharding, pad_to_multiple
 
 _EPS = 1e-12
 logger = logging.getLogger(__name__)
+
+_M_FLEET_PROGRAMS = REGISTRY.counter(
+    "gordo_fleet_programs_built_total",
+    "Fleet training programs constructed (jit = traced wrapper, compile "
+    "deferred to first call; aot = fleet_executable, compile paid here)",
+    labels=("kind",),
+)
+_M_FLEET_COMPILE_SECONDS = REGISTRY.histogram(
+    "gordo_fleet_compile_seconds",
+    "AOT lower+compile duration of fleet executables — the dominant "
+    "cold-build cost on TPU (tens of seconds per bucket shape)",
+    buckets=(0.1, 0.5, 1, 5, 10, 30, 60, 120, 300, 600, float("inf")),
+)
 
 
 class FleetSpec(NamedTuple):
@@ -559,6 +574,7 @@ def fleet_program(
     buffers and must keep the default)."""
 
     def build():
+        _M_FLEET_PROGRAMS.labels("jit").inc()
         program = jax.vmap(
             make_machine_program(spec, n_rows, n_features, n_targets)
         )
@@ -624,7 +640,12 @@ def fleet_executable(
             jax.ShapeDtypeStruct((n_machines, n_rows), jnp.float32),
             jax.ShapeDtypeStruct((n_machines, prng_key_width()), jnp.uint32),
         )
+        compile_started = time.perf_counter()
         compiled = program.lower(*avatars).compile()
+        _M_FLEET_PROGRAMS.labels("aot").inc()
+        _M_FLEET_COMPILE_SECONDS.observe(
+            time.perf_counter() - compile_started
+        )
         try:
             formats = compiled.input_formats[0]
         except (AttributeError, TypeError, IndexError):

@@ -38,6 +38,7 @@ import json
 import logging
 import os
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
@@ -48,10 +49,52 @@ import numpy as np
 
 from ..models.analysis import analyze_model
 from ..models.transformers import MinMaxScaler, StandardScaler
+from ..observability.registry import REGISTRY
 from ..ops import windowing
 from ..ops.scaling import ScalerParams
 
 logger = logging.getLogger(__name__)
+
+# -- engine telemetry (process-wide registry: every generation's buckets
+# record into the same series, so a scrape survives /reload swaps) ----------
+_M_PROGRAM_CACHE = REGISTRY.counter(
+    "gordo_engine_program_cache_total",
+    "Scoring-program cache lookups by result; a 'hit' means the request's "
+    "(rows, batch) shape was already compiled — the warm-row signal "
+    "(warmup() pre-pays the misses real traffic would see)",
+    labels=("kind", "outcome"),
+)
+_M_COMPILE_SECONDS = REGISTRY.histogram(
+    "gordo_engine_compile_seconds",
+    "Duration of dispatches that paid a first-call XLA compile",
+    labels=("kind",),
+    # compile-scale bounds, not DEFAULT_BUCKETS: first-call compiles run
+    # 20-40 s on TPU (see warmup()), which the default 30 s top bound
+    # would collapse into +Inf
+    buckets=(0.1, 0.5, 1, 5, 10, 30, 60, 120, 300, 600, float("inf")),
+)
+_M_DISPATCH_SECONDS = REGISTRY.histogram(
+    "gordo_engine_dispatch_seconds",
+    "Compile-free device dispatch latency, by path (cold=stacked gather, "
+    "hot=unsharded hot-cache copy)",
+    labels=("path",),
+)
+_M_DISPATCH_BATCH = REGISTRY.histogram(
+    "gordo_engine_dispatch_batch_size",
+    "Requests coalesced into one device dispatch (micro-batching)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+_M_REQUESTS = REGISTRY.counter(
+    "gordo_engine_requests_total",
+    "Requests scored on device, by dispatch path",
+    labels=("path",),
+)
+_M_HOT_EVENTS = REGISTRY.counter(
+    "gordo_engine_hot_cache_events_total",
+    "Hot-machine cache lifecycle: promote, evict, demote (dispatch "
+    "failure), backoff_defer (re-promotion blocked by demotion backoff)",
+    labels=("event",),
+)
 
 # ONE lock per PROCESS for sharded dispatches: collective rendezvous (CPU
 # backend) aborts the process if two sharded executions interleave, and the
@@ -222,6 +265,11 @@ class _Bucket:
         # (rows, k) -> stacked gather-by-idx program;
         # ("hot", rows, k) -> unsharded hot-machine program
         self._programs: Dict[Tuple[Any, ...], Any] = {}
+        # program keys built but not yet dispatched: their FIRST dispatch
+        # pays the XLA compile, so its duration is accounted to the compile
+        # histogram, not dispatch latency (touched only under _busy / by
+        # the warmup caller, like the hot-cache state above)
+        self._fresh_programs: set = set()
         self._cond = threading.Condition()
         self._busy = False
         self._pending: Dict[int, List[_Item]] = {}
@@ -266,7 +314,10 @@ class _Bucket:
         key = (rows, k)
         program = self._programs.get(key)
         if program is not None:
+            _M_PROGRAM_CACHE.labels("stacked", "hit").inc()
             return program
+        _M_PROGRAM_CACHE.labels("stacked", "miss").inc()
+        self._fresh_programs.add(key)
         machine_score = self._machine_score_fn()
 
         def score_one(stacked, idx, x):
@@ -295,10 +346,14 @@ class _Bucket:
         key = ("hot", rows, k)
         program = self._programs.get(key)
         if program is None:
+            _M_PROGRAM_CACHE.labels("hot", "miss").inc()
+            self._fresh_programs.add(key)
             program = jax.jit(
                 jax.vmap(self._machine_score_fn(), in_axes=(None, 0))
             )
             self._programs[key] = program
+        else:
+            _M_PROGRAM_CACHE.labels("hot", "hit").inc()
         return program
 
     def _gather_machine(self, idx: int):
@@ -378,8 +433,21 @@ class _Bucket:
         if hot:
             self.hot_request_count += k
         self.max_batch_seen = max(self.max_batch_seen, k)
+        _M_REQUESTS.labels("hot" if hot else "cold").inc(k)
+        _M_DISPATCH_BATCH.observe(k)
+
+    def _time_dispatch(self, key, kind: str, seconds: float) -> None:
+        """Account one dispatch's wall time: a program's FIRST dispatch is
+        compile time (tens of seconds on TPU), everything after is the
+        dispatch-latency series a tail-latency dashboard actually wants."""
+        if key in self._fresh_programs:
+            self._fresh_programs.discard(key)
+            _M_COMPILE_SECONDS.labels(kind).observe(seconds)
+        else:
+            _M_DISPATCH_SECONDS.labels(kind).observe(seconds)
 
     def _process_hot(self, rows: int, idx: int, items: List[_Item]) -> None:
+        key = None
         try:
             tree = self._hot[idx]
             self._hot.move_to_end(idx)  # LRU touch
@@ -387,7 +455,12 @@ class _Bucket:
             kb = _round_up_pow2(k)
             xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
             program = self._hot_program(rows, kb)
+            key = ("hot", rows, kb)
+            dispatch_started = time.perf_counter()
             x_tail, pred, scaled, total = jax.device_get(program(tree, xs))
+            self._time_dispatch(
+                key, "hot", time.perf_counter() - dispatch_started,
+            )
             # accounted before stamping so hot- and cold-path freshness
             # both record POST-dispatch counts (_maybe_promote stamps after
             # _process_cold's _account); stamped only on success — see the
@@ -417,10 +490,17 @@ class _Bucket:
                 "hot-cache dispatch failed for machine idx %d; demoting "
                 "the hot copy and retrying on the cold path", idx
             )
+            # a failed first dispatch never reaches _time_dispatch: drop
+            # the fresh marker (no sample) or the program's NEXT dispatch —
+            # milliseconds, compile long since paid — would be misrecorded
+            # as a compile
+            if key is not None:
+                self._fresh_programs.discard(key)
             self._hot.pop(idx, None)
             self._hot_last_use.pop(idx, None)
             self._hot_hits.pop(idx, None)
             self._hot_demotions[idx] = self._hot_demotions.get(idx, 0) + 1
+            _M_HOT_EVENTS.labels("demote").inc()
             self._process_cold(rows, items)
         except BaseException as exc:
             # KeyboardInterrupt/SystemExit must not vanish into a cold
@@ -434,6 +514,7 @@ class _Bucket:
                 it.done.set()
 
     def _process_cold(self, rows: int, items: List[_Item]) -> None:
+        key = None
         try:
             k = len(items)
             kb = _round_up_pow2(k)
@@ -442,13 +523,22 @@ class _Bucket:
             )
             xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
             program = self._program(rows, kb)
+            key = (rows, kb)
+            dispatch_started = time.perf_counter()
             with self._dispatch_lock or contextlib.nullcontext():
                 x_tail, pred, scaled, total = jax.device_get(
                     program(self.stacked, idxs, xs)
                 )
+            self._time_dispatch(
+                key, "cold", time.perf_counter() - dispatch_started
+            )
             self._account(k)
             self._fill_results(items, x_tail, pred, scaled, total)
         except BaseException as exc:  # surface on every waiting thread
+            # see _process_hot: a failed first dispatch must not leave the
+            # fresh-program marker behind
+            if key is not None:
+                self._fresh_programs.discard(key)
             for it in items:
                 it.error = exc
         finally:
@@ -522,6 +612,8 @@ class _Bucket:
             # failing hot program backs off geometrically instead of
             # re-entering the cache every other cold hit
             if hits < 2 * (8 ** self._hot_demotions.get(idx, 0)):
+                if self._hot_demotions.get(idx):
+                    _M_HOT_EVENTS.labels("backoff_defer").inc()
                 continue
             if len(self._hot) >= self._hot_cap:
                 victim = next(iter(self._hot))
@@ -533,8 +625,10 @@ class _Bucket:
                 # evicted machines must re-earn promotion, or the next
                 # cold hit would instantly thrash them back in
                 self._hot_hits.pop(victim, None)
+                _M_HOT_EVENTS.labels("evict").inc()
             self._hot[idx] = self._gather_machine(idx)
             self._hot_last_use[idx] = self.dispatch_count
+            _M_HOT_EVENTS.labels("promote").inc()
 
 
 class ServingEngine:
@@ -695,6 +789,22 @@ class ServingEngine:
                 len(self._by_name),
                 len(self._buckets),
             )
+        # last-write-wins gauges: a /reload's new generation overwrites the
+        # old one's values, which is exactly the current-state semantics a
+        # gauge carries
+        REGISTRY.gauge(
+            "gordo_engine_machines",
+            "Machines lifted into the stacked serving engine",
+        ).set(len(self._by_name))
+        REGISTRY.gauge(
+            "gordo_engine_buckets",
+            "Architecture buckets (one stacked pytree + program set each)",
+        ).set(len(self._buckets))
+        REGISTRY.gauge(
+            "gordo_engine_host_path_machines",
+            "Machines the engine could not lift (serving via the slow host "
+            "path; see /metrics JSON engine.host_path_machines for reasons)",
+        ).set(len(self.skipped))
 
     # -- public API ----------------------------------------------------------
     def warmup(self, rows: Optional[int] = None) -> int:

@@ -18,8 +18,17 @@ pure params + jitted apply fns, so a single TPU serves a whole fleet and
 dispatch is just a dict lookup on the machine segment. Bare paths
 (``/prediction``) work in single-model mode for drop-in parity. Flask is
 replaced by a dependency-light werkzeug WSGI app (flask is not in this
-image; werkzeug is its routing/WSGI core anyway). ``GET /metrics`` adds
-the per-endpoint latency counters the reference lacked (SURVEY.md §6.5).
+image; werkzeug is its routing/WSGI core anyway).
+
+Observability: request latencies and counts record into the process-wide
+metrics registry (``observability.registry``), so ``GET /metrics`` serves
+both the original JSON view (back-compat) and, with
+``?format=prometheus``, the text exposition a scraper ingests — engine
+compile/cache/dispatch series included, since every layer shares the one
+registry. Each request adopts (or mints) an ``X-Gordo-Trace-Id``, echoes
+it in the response, and binds it to the handler's context so every log
+record emitted while serving the request — including engine dispatch
+logs — carries the same id (SURVEY.md §6.5, grown into a real layer).
 """
 
 from __future__ import annotations
@@ -36,11 +45,24 @@ from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
 from ..models.anomaly.base import AnomalyDetectorBase
+from ..observability import exposition, tracing
+from ..observability.registry import REGISTRY
 from ..serializer import dumps as serializer_dumps
 from ..serializer import load, load_metadata
 from .engine import ScoreResult, ServingEngine
 
 logger = logging.getLogger(__name__)
+
+_M_REQUEST_SECONDS = REGISTRY.histogram(
+    "gordo_server_request_duration_seconds",
+    "End-to-end HTTP request latency by endpoint",
+    labels=("endpoint",),
+)
+_M_REQUESTS = REGISTRY.counter(
+    "gordo_server_requests_total",
+    "HTTP requests served, by endpoint and status code",
+    labels=("endpoint", "status"),
+)
 
 _URL_MAP = Map(
     [
@@ -67,44 +89,20 @@ _URL_MAP = Map(
 )
 
 
-class _Latency:
-    """Rolling per-endpoint latency stats for GET /metrics.
-
-    ``record`` runs on every handler thread of the threaded WSGI server, so
-    the sample lists are mutated under a lock; ``snapshot`` copies under the
-    same lock and computes percentiles outside it.
-    """
-
-    def __init__(self, keep: int = 1000):
-        self.keep = keep
-        self.samples: Dict[str, List[float]] = {}
-        self.counts: Dict[str, int] = {}
-        self._lock = threading.Lock()
-
-    def record(self, endpoint: str, seconds: float) -> None:
-        with self._lock:
-            samples = self.samples.setdefault(endpoint, [])
-            samples.append(seconds)
-            if len(samples) > self.keep:
-                del samples[: -self.keep]
-            self.counts[endpoint] = self.counts.get(endpoint, 0) + 1
-
-    def snapshot(self) -> Dict[str, Any]:
-        with self._lock:
-            copied = {
-                endpoint: (list(samples), self.counts[endpoint])
-                for endpoint, samples in self.samples.items()
-            }
-        out = {}
-        for endpoint, (samples, count) in copied.items():
-            arr = np.asarray(samples)
-            out[endpoint] = {
-                "count": count,
-                "p50_ms": float(np.percentile(arr, 50) * 1000),
-                "p99_ms": float(np.percentile(arr, 99) * 1000),
-                "mean_ms": float(arr.mean() * 1000),
-            }
-        return out
+def _latency_view() -> Dict[str, Any]:
+    """The original JSON ``/metrics`` latency block (count / p50_ms /
+    p99_ms / mean_ms per endpoint), now read off the registry histogram
+    that replaced the ad-hoc ``_Latency`` ring buffer — same shape, same
+    bounded-window percentile semantics, one storage."""
+    return {
+        labelvalues[0]: {
+            "count": stats["count"],
+            "p50_ms": stats["p50"] * 1000,
+            "p99_ms": stats["p99"] * 1000,
+            "mean_ms": stats["mean"] * 1000,
+        }
+        for labelvalues, stats in _M_REQUEST_SECONDS.stats().items()
+    }
 
 
 class _Machine:
@@ -242,7 +240,9 @@ class ModelServer:
         self._pinned = dict(machines) if models_root else {}
         self._reload_lock = threading.Lock()
         self._state = _ServerState(machines, shard_fleet=shard_fleet)
-        self.latency = _Latency()
+        # every record emitted while serving a request carries its trace id
+        # (idempotent; composes with logsetup.configure_logging)
+        tracing.install_log_record_factory()
         logger.info(
             "ModelServer serving %d model(s): %s",
             len(machines),
@@ -344,24 +344,49 @@ class ModelServer:
     def __call__(self, environ, start_response):
         request = Request(environ)
         started = time.perf_counter()
+        # adopt the client's trace id or mint one; bound to this handler
+        # thread's context for the whole request, so every log record down
+        # through the engine carries it, and echoed in the response
+        trace_id = request.headers.get(tracing.TRACE_HEADER) or tracing.new_trace_id()
+        token = tracing.set_trace_id(trace_id)
         adapter = _URL_MAP.bind_to_environ(environ)
         # ONE state snapshot per request: machines and engine must come from
         # the same generation even if a reload swaps mid-request
         state = self._state
         try:
-            endpoint, args = adapter.match()
-            response = self._dispatch(request, endpoint, args, state)
-        except HTTPException as exc:
-            if exc.response is not None:
-                response = exc.response
-            else:
-                response = Response(
-                    json.dumps({"error": exc.description}),
-                    status=exc.code or 500,
-                    mimetype="application/json",
-                )
-            endpoint = "error"
-        self.latency.record(endpoint, time.perf_counter() - started)
+            try:
+                endpoint, args = adapter.match()
+                response = self._dispatch(request, endpoint, args, state)
+            except HTTPException as exc:
+                if exc.response is not None:
+                    response = exc.response
+                else:
+                    response = Response(
+                        json.dumps({"error": exc.description}),
+                        status=exc.code or 500,
+                        mimetype="application/json",
+                    )
+                endpoint = "error"
+            response.headers[tracing.TRACE_HEADER] = trace_id
+            elapsed = time.perf_counter() - started
+            _M_REQUEST_SECONDS.labels(endpoint).observe(elapsed)
+            _M_REQUESTS.labels(endpoint, str(response.status_code)).inc()
+            # DEBUG for probe endpoints: a watchman polling N machines'
+            # /healthz plus scrapers hitting /metrics would otherwise
+            # double steady-state log volume (werkzeug's own access line
+            # already covers them); real work logs at INFO with its trace
+            logger.log(
+                logging.DEBUG if endpoint in ("healthz", "metrics")
+                else logging.INFO,
+                "%s %s -> %d in %.1f ms [trace=%s]",
+                request.method,
+                request.path,
+                response.status_code,
+                elapsed * 1000,
+                trace_id,
+            )
+        finally:
+            tracing.reset_trace_id(token)
         return response(environ, start_response)
 
     def _machine_for(self, args: Dict[str, Any], state: _ServerState) -> _Machine:
@@ -389,10 +414,18 @@ class ModelServer:
                 self._machine_for(args, state)
             return _json({"ok": True})
         if endpoint == "metrics":
+            if request.args.get("format") == "prometheus":
+                return Response(
+                    exposition.render_prometheus(REGISTRY),
+                    content_type=exposition.CONTENT_TYPE,
+                )
             return _json(
                 {
-                    "latency": self.latency.snapshot(),
+                    "latency": _latency_view(),
                     "engine": state.engine.stats(),
+                    # the full registry (engine, client, build series too):
+                    # the JSON twin of ?format=prometheus
+                    "registry": REGISTRY.snapshot(),
                 }
             )
         if endpoint == "models":
@@ -502,10 +535,11 @@ class ModelServer:
     ) -> Response:
         X, _ = self._parse_X(request, machine)
         try:
-            if state.engine.can_score(machine.name):
-                output = state.engine.predict(machine.name, X)
-            else:
-                output = machine.model.predict(X)
+            with tracing.span("server.predict"):
+                if state.engine.can_score(machine.name):
+                    output = state.engine.predict(machine.name, X)
+                else:
+                    output = machine.model.predict(X)
         except ValueError as exc:
             _abort(400, f"Prediction failed: {exc}")
         return _json(
@@ -571,7 +605,8 @@ class ModelServer:
         """Anomaly arrays via the stacked TPU engine when the machine is
         lifted into it, else the host path (``model.anomaly``)."""
         if state.engine.can_score(machine.name):
-            return state.engine.anomaly(machine.name, X)
+            with tracing.span("server.anomaly"):
+                return state.engine.anomaly(machine.name, X)
         cols = machine.target_columns
         if cols is None:
             frame = machine.model.anomaly(X)
@@ -647,6 +682,7 @@ def run_server(
     project: str = "project",
     models_root: Optional[str] = None,
     shard_fleet: bool = False,
+    trace_dir: Optional[str] = None,
 ) -> None:
     """Serve with werkzeug's multithreaded server.
 
@@ -660,8 +696,14 @@ def run_server(
     the ingress, not preforked workers. The built-in werkzeug server below is
     threaded and suffices for the single-host case; it is not hardened for
     untrusted public traffic.
+
+    ``trace_dir``: wrap the warm-up compiles in a ``jax.profiler`` device
+    trace (the compile-heavy phase worth profiling; steady-state serving
+    is better observed through ``/metrics``).
     """
     from werkzeug.serving import run_simple
+
+    from ..utils.profiling import device_trace
 
     app = build_app(
         model_dirs, project=project, models_root=models_root,
@@ -672,7 +714,8 @@ def run_server(
     # Best-effort — one broken bucket must not keep the healthy machines
     # from serving (its own requests will surface the error)
     try:
-        warmed = app.engine.warmup()
+        with device_trace(trace_dir):
+            warmed = app.engine.warmup()
     except Exception:
         logger.warning("Serving engine warm-up failed", exc_info=True)
     else:

@@ -49,6 +49,29 @@ class PhaseTimer:
             for name, total in sorted(self.durations.items())
         }
 
+    def publish(self, prefix: str = "gordo_build") -> None:
+        """Merge this timer's phase totals into the process-wide metrics
+        registry as ``<prefix>_phase_seconds_total{phase}`` (+ a run
+        counter), so build-phase accounting survives the build function
+        returning and lands in the same ``/metrics`` scrape as serving
+        telemetry. Counters (not gauges): repeated builds in one process
+        accumulate, mirroring ``add()``'s own accumulation semantics."""
+        from ..observability.registry import REGISTRY
+
+        seconds = REGISTRY.counter(
+            f"{prefix}_phase_seconds_total",
+            "Cumulative wall-clock seconds spent per build phase",
+            labels=("phase",),
+        )
+        runs = REGISTRY.counter(
+            f"{prefix}_phase_runs_total",
+            "Times each build phase ran",
+            labels=("phase",),
+        )
+        for name, total in self.durations.items():
+            seconds.labels(name).inc(total)
+            runs.labels(name).inc(self.counts[name])
+
 
 @contextlib.contextmanager
 def device_trace(log_dir: Optional[str]) -> Iterator[None]:

@@ -9,19 +9,40 @@ serving consolidation), so watchman polls
 ``{target}/gordo/v0/<project>/<machine>/healthz`` per machine — but the
 machine list may also point at several hosts (``{machine: base_url}``),
 matching the reference's one-deployment-per-model layout.
+
+Observability: every probe's duration and failure reason is surfaced
+per-target in ``status()`` (a 4.9 s probe against a 5 s timeout is a
+dying machine, not a healthy one) and counted into the process registry.
+``GET /metrics`` scrapes each distinct model-server base URL's own
+``/metrics`` JSON and aggregates the engine counters into ONE fleet-wide
+view — the scrape-of-scrapes the reference's watchman never had.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Union
 
 from werkzeug.wrappers import Request, Response
 
+from ..observability import exposition
+from ..observability.registry import REGISTRY
+
 logger = logging.getLogger(__name__)
+
+_M_PROBES = REGISTRY.counter(
+    "gordo_watchman_probes_total",
+    "Health probes issued, by outcome (healthy / unhealthy / unreachable)",
+    labels=("outcome",),
+)
+_M_PROBE_SECONDS = REGISTRY.histogram(
+    "gordo_watchman_probe_seconds",
+    "Per-target health-probe duration",
+)
 
 
 class WatchmanServer:
@@ -58,6 +79,12 @@ class WatchmanServer:
         self.timeout = timeout
         self.max_poll_workers = max(1, int(max_poll_workers))
         self.manifest_path = manifest_path
+        # last failure per target, kept ACROSS polls: a machine that is
+        # healthy right now but failed an hour ago reads differently from
+        # one that never failed (the reference's watchman forgot everything
+        # between GETs)
+        self._last_errors: Dict[str, str] = {}
+        self._errors_lock = threading.Lock()
 
     def _check(self, machine: str, base_url: str) -> Dict:
         import requests
@@ -66,17 +93,36 @@ class WatchmanServer:
             f"{base_url.rstrip('/')}/gordo/v0/{self.project}/{machine}/healthz"
         )
         started = time.perf_counter()
+        error: Optional[str] = None
         try:
             response = requests.get(url, timeout=self.timeout)
             healthy = response.status_code == 200
+            if not healthy:
+                error = f"HTTP {response.status_code}"
+            _M_PROBES.labels("healthy" if healthy else "unhealthy").inc()
         except requests.RequestException as exc:
             logger.warning("Watchman: %s unreachable: %r", machine, exc)
             healthy = False
+            error = repr(exc)
+            _M_PROBES.labels("unreachable").inc()
+        probe_s = time.perf_counter() - started
+        _M_PROBE_SECONDS.observe(probe_s)
+        if error is not None:
+            stamped = f"{time.strftime('%Y-%m-%d %H:%M:%S%z')} {error}"
+            with self._errors_lock:
+                self._last_errors[machine] = stamped
+        with self._errors_lock:
+            last_error = self._last_errors.get(machine)
         return {
             "endpoint": url,
             "target": machine,
             "healthy": healthy,
-            "latency_ms": (time.perf_counter() - started) * 1000,
+            "latency_ms": probe_s * 1000,
+            # current probe's failure ('' when this probe succeeded) and
+            # the most recent failure ever seen, timestamped — a slow/dead
+            # machine is distinguishable from a healthy one at a glance
+            "error": error or "",
+            "last_error": last_error or "",
         }
 
     def _build_progress(self) -> Optional[Dict]:
@@ -101,6 +147,71 @@ class WatchmanServer:
             body["build"] = build
         return body
 
+    # engine.stats() fields that are meaningfully summable across model
+    # servers — the fleet-wide totals a capacity dashboard wants
+    _SUMMED_ENGINE_STATS = (
+        "machines",
+        "buckets",
+        "compiled_programs",
+        "dispatches",
+        "batched_requests",
+        "hot_machines",
+        "hot_requests",
+    )
+
+    def _scrape_one(self, base_url: str) -> Dict:
+        import requests
+
+        url = f"{base_url.rstrip('/')}/metrics"
+        started = time.perf_counter()
+        try:
+            response = requests.get(url, timeout=self.timeout)
+            response.raise_for_status()
+            body = response.json()
+        except (requests.RequestException, ValueError) as exc:
+            return {"error": repr(exc), "scrape_ms": (time.perf_counter() - started) * 1000}
+        return {
+            "engine": body.get("engine") or {},
+            "latency": body.get("latency") or {},
+            "scrape_ms": (time.perf_counter() - started) * 1000,
+        }
+
+    def metrics(self) -> Dict:
+        """Scrape every distinct model-server base URL's ``/metrics`` JSON
+        and aggregate the engine counters fleet-wide. One multi-model
+        server hosting the whole fleet scrapes once; a per-host layout
+        scrapes each host — either way the ``fleet`` block is the single
+        place to read total dispatches, batched requests, and how many
+        machines serve via the slow host path."""
+        urls = sorted(set(self.machine_urls.values()))
+        workers = min(self.max_poll_workers, max(1, len(urls)))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            scraped = dict(zip(urls, pool.map(self._scrape_one, urls)))
+        fleet: Dict = {key: 0 for key in self._SUMMED_ENGINE_STATS}
+        fleet["host_path_machines"] = {}
+        up = 0
+        for url, result in scraped.items():
+            engine = result.get("engine")
+            if engine is None:
+                continue
+            up += 1
+            for key in self._SUMMED_ENGINE_STATS:
+                value = engine.get(key)
+                if isinstance(value, (int, float)):
+                    fleet[key] += value
+            # keep WHICH machines are slow, not just how many — prefixed
+            # by target when several servers report
+            for name, reason in (engine.get("host_path_machines") or {}).items():
+                key = name if len(urls) == 1 else f"{url}/{name}"
+                fleet["host_path_machines"][key] = reason
+        return {
+            "project-name": self.project,
+            "targets-up": up,
+            "targets-total": len(urls),
+            "fleet": fleet,
+            "targets": scraped,
+        }
+
     def __call__(self, environ, start_response):
         request = Request(environ)
         if request.path in ("/", ""):
@@ -108,6 +219,16 @@ class WatchmanServer:
             status = 200
         elif request.path == "/healthz":
             body, status = {"ok": True}, 200
+        elif request.path == "/metrics":
+            if request.args.get("format") == "prometheus":
+                # watchman's OWN series (probe counts/durations), text-form
+                response = Response(
+                    exposition.render_prometheus(REGISTRY),
+                    content_type=exposition.CONTENT_TYPE,
+                )
+                return response(environ, start_response)
+            body = self.metrics()
+            status = 200
         else:
             body, status = {"error": "not found"}, 404
         response = Response(

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Fetch and validate a live ``/metrics`` Prometheus exposition.
+
+    python tools/scrape_metrics.py http://localhost:5555/metrics
+    python tools/scrape_metrics.py --spawn        # throwaway server e2e
+
+Exit codes: 0 = valid exposition (series summary on stdout), 1 =
+malformed exposition or missing required series, 2 = endpoint
+unreachable. ``--spawn`` builds a tiny RandomDataset model, serves it
+from a background thread on an ephemeral port, issues one warm
+``/prediction``, then scrapes — the ``make metrics-smoke`` target, and
+the from-nothing repro for "is my scrape config pointed at a healthy
+server".
+
+CI-friendly on purpose: the parser is the repo's own
+``observability.exposition.parse_prometheus_text``, which rejects the
+malformed lines and inconsistent histograms a real Prometheus scraper
+would reject or silently mis-ingest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# runnable straight from a checkout (python tools/scrape_metrics.py):
+# sys.path[0] is tools/, the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# series any warm gordo model server must expose (--spawn / --require-gordo)
+REQUIRED_SERIES = (
+    "gordo_server_requests_total",
+    "gordo_server_request_duration_seconds_count",
+    "gordo_engine_program_cache_total",
+)
+
+
+def scrape(url: str, timeout: float = 10.0) -> str:
+    import requests
+
+    if "://" not in url:  # accept host:port/metrics shorthand
+        url = f"http://{url}"
+    if "?" not in url:
+        url += "?format=prometheus"
+    response = requests.get(url, timeout=timeout)
+    response.raise_for_status()
+    return response.text
+
+
+def validate(text: str, require_gordo: bool = False) -> int:
+    from gordo_components_tpu.observability.exposition import (
+        parse_prometheus_text,
+    )
+
+    try:
+        samples = parse_prometheus_text(text)
+    except ValueError as exc:
+        print(f"MALFORMED exposition: {exc}", file=sys.stderr)
+        return 1
+    total = sum(len(v) for v in samples.values())
+    print(f"OK: {len(samples)} metric families, {total} samples")
+    for name in sorted(samples):
+        print(f"  {name}: {len(samples[name])} series")
+    if require_gordo:
+        missing = [name for name in REQUIRED_SERIES if name not in samples]
+        if missing:
+            print(f"MISSING required series: {missing}", file=sys.stderr)
+            return 1
+    return 0
+
+
+def spawn_and_scrape() -> int:
+    """Build a toy model, serve it in-process, warm it, scrape it."""
+    import json
+    import tempfile
+
+    import requests
+    from werkzeug.serving import make_server
+
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.server import build_app
+
+    data_config = {
+        "type": "RandomDataset",
+        "train_start_date": "2023-01-01T00:00:00+00:00",
+        "train_end_date": "2023-01-04T00:00:00+00:00",
+        "tag_list": ["tag-a", "tag-b", "tag-c"],
+    }
+    model_config = {
+        "Pipeline": {
+            "steps": [
+                "MinMaxScaler",
+                {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                      "dims": [6], "epochs": 1,
+                                      "batch_size": 32}},
+            ]
+        }
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        print("building throwaway model ...", file=sys.stderr)
+        model_dir = provide_saved_model(
+            "smoke-machine", model_config, data_config, tmp,
+            evaluation_config={"cv_mode": "build_only"},
+        )
+        app = build_app({"smoke-machine": model_dir}, project="smoke")
+        server = make_server("127.0.0.1", 0, app, threaded=True)
+        import threading
+
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_port}"
+        try:
+            warm = requests.post(
+                f"{base}/gordo/v0/smoke/smoke-machine/prediction",
+                data=json.dumps({"X": [[0.1, 0.2, 0.3]]}),
+                headers={"Content-Type": "application/json"},
+                timeout=60,
+            )
+            warm.raise_for_status()
+            print(
+                f"warm /prediction OK "
+                f"(trace {warm.headers.get('X-Gordo-Trace-Id')})",
+                file=sys.stderr,
+            )
+            text = scrape(f"{base}/metrics")
+        finally:
+            server.shutdown()
+            thread.join(timeout=5)
+    return validate(text, require_gordo=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Fetch + validate a /metrics Prometheus exposition"
+    )
+    parser.add_argument("url", nargs="?",
+                        help="metrics URL, e.g. http://host:5555/metrics")
+    parser.add_argument("--spawn", action="store_true",
+                        help="build + serve a throwaway model, then scrape it")
+    parser.add_argument("--require-gordo", action="store_true",
+                        help="also fail when the standard gordo server "
+                             "series are absent")
+    parser.add_argument("--timeout", type=float, default=10.0)
+    args = parser.parse_args()
+
+    if args.spawn:
+        return spawn_and_scrape()
+    if not args.url:
+        parser.error("either a URL or --spawn is required")
+    try:
+        text = scrape(args.url, timeout=args.timeout)
+    except Exception as exc:
+        print(f"UNREACHABLE: {args.url}: {exc!r}", file=sys.stderr)
+        return 2
+    return validate(text, require_gordo=args.require_gordo)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
