@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: test testfast bench bench-serving metrics-smoke images builder-image server-image watchman-image
+.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke images builder-image server-image watchman-image
 
 test:
 	python -m pytest tests/ -q
@@ -20,6 +20,13 @@ bench-serving:
 # standard series
 metrics-smoke:
 	JAX_PLATFORMS=cpu python tools/scrape_metrics.py --spawn
+
+# end-to-end resilience check: boot a fleet server with injected faults
+# (one slow dispatch, one dead artifact) and assert degraded-but-alive:
+# healthy 200s, 503/504 + Retry-After on the sick machines, /healthz
+# degraded naming them, gordo_resilience_* series in the exposition
+chaos-smoke:
+	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
 images: builder-image server-image watchman-image
 

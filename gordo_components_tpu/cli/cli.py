@@ -349,14 +349,36 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
               help="shard every bucket's stacked params over all local "
                    "devices (HBM capacity mode for fleets whose stacked "
                    "weights exceed one chip; adds per-request gather hops)")
+@click.option("--max-inflight", default=None, type=int,
+              envvar="GORDO_MAX_INFLIGHT",
+              help="admission-gate bound on concurrently-scoring requests; "
+                   "beyond it (plus a small queue) the server sheds with "
+                   "503 + Retry-After instead of convoying threads "
+                   "(default 64)")
+@click.option("--faults", default=None, envvar="GORDO_FAULTS",
+              help="chaos-testing fault spec "
+                   "'point:target:kind[:param][;...]' (points: model-load, "
+                   "engine-dispatch, probe, data-fetch; kinds: error, "
+                   "latency, corrupt) — injects failures at the named "
+                   "boundaries; NEVER set in production")
 @_TRACE_DIR_OPT
 def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
-                   trace_dir):
+                   max_inflight, faults, trace_dir):
     """Serve built model(s) over REST."""
     import os
 
     from ..serializer import load_metadata
     from ..server import run_server
+
+    if faults is not None:
+        from ..resilience import faults as faults_mod
+
+        try:
+            # validated HERE so a typo'd spec fails the command loudly
+            # instead of silently injecting nothing
+            faults_mod.configure(faults)
+        except ValueError as exc:
+            raise click.UsageError(f"Bad --faults spec: {exc}")
 
     resolved: dict = {}
     for model_dir in model_dirs:
@@ -378,13 +400,13 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
     if len(resolved) == 1 and not models_dir:
         run_server(next(iter(resolved.values())), host=host, port=port,
                    project=project, shard_fleet=shard_fleet,
-                   trace_dir=trace_dir)
+                   trace_dir=trace_dir, max_inflight=max_inflight)
     else:
         # models_dir servers stay reload-capable (POST /reload picks up
         # machines a fleet build adds to the tree after startup)
         run_server(resolved, host=host, port=port, project=project,
                    models_root=models_dir, shard_fleet=shard_fleet,
-                   trace_dir=trace_dir)
+                   trace_dir=trace_dir, max_inflight=max_inflight)
 
 
 @gordo.command("run-watchman")

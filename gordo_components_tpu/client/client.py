@@ -22,6 +22,8 @@ import pandas as pd
 
 from ..observability import tracing
 from ..observability.registry import REGISTRY
+from ..resilience import deadline
+from ..resilience.breaker import BreakerBoard
 from .forwarders import PredictionForwarder
 from .utils import make_date_ranges
 
@@ -35,7 +37,8 @@ _M_RETRIES = REGISTRY.counter(
 )
 _M_REQUESTS = REGISTRY.counter(
     "gordo_client_requests_total",
-    "Client requests by terminal outcome (ok / permanent_4xx / exhausted)",
+    "Client requests by terminal outcome (ok / permanent_4xx / exhausted "
+    "/ circuit_open / budget_exhausted)",
     labels=("outcome",),
 )
 
@@ -55,8 +58,15 @@ class Client:
         retries: int = 3,
         retry_backoff: float = 0.5,
         timeout: float = 60.0,
+        retry_budget: Optional[float] = None,
+        breaker_recovery: float = 30.0,
         forwarders: Optional[List[PredictionForwarder]] = None,
     ):
+        """``retry_budget``: wall-clock cap (seconds) on one call's retries
+        + backoff, so a flapping server cannot stretch a call past what the
+        caller budgeted (any bound ``resilience.deadline`` tightens it
+        further). ``breaker_recovery``: seconds an endpoint's circuit stays
+        open after tripping before one probe request tests it again."""
         self.base_url = base_url.rstrip("/")
         self.project = project
         self.machines = list(machines) if machines else None
@@ -65,6 +75,12 @@ class Client:
         self.retries = retries
         self.retry_backoff = retry_backoff
         self.timeout = timeout
+        self.retry_budget = retry_budget
+        # ONE circuit per endpoint, shared by every chunk fetch this client
+        # fires: a dead server trips after a few failures and the remaining
+        # machine × chunk requests fail in microseconds instead of each
+        # paying a full connect/read timeout
+        self._breakers = BreakerBoard(recovery_time=breaker_recovery)
         self.forwarders = forwarders or []
 
     def _backoff_delay(self, attempt: int) -> float:
@@ -73,6 +89,70 @@ class Client:
         one synchronized wave (the bare ``backoff * 2**(n-1)`` did exactly
         that — every chunk of every machine retried on the same beat)."""
         return self.retry_backoff * 2 ** (attempt - 1) * random.uniform(0.5, 1.5)
+
+    def _breaker(self):
+        return self._breakers.get(self.base_url)
+
+    def _budget_left(self, started: float) -> Optional[float]:
+        """Seconds of retry budget remaining for a call begun at
+        ``started`` — the tighter of the per-call ``retry_budget`` and any
+        deadline bound on the calling context. None = unbounded."""
+        candidates = []
+        if self.retry_budget is not None:
+            candidates.append(self.retry_budget - (time.monotonic() - started))
+        bound = deadline.remaining()
+        if bound is not None:
+            candidates.append(bound)
+        return min(candidates) if candidates else None
+
+    def _retry_delay(
+        self,
+        attempt: int,
+        started: float,
+        retry_after: Optional[float] = None,
+    ) -> Optional[float]:
+        """How long to sleep before retry ``attempt`` — honoring a server's
+        ``Retry-After`` hint when it exceeds our own backoff — or None when
+        the remaining budget cannot cover the wait plus one more attempt
+        (retrying past the caller's deadline only produces answers nobody
+        is waiting for)."""
+        delay = self._backoff_delay(attempt)
+        if retry_after is not None:
+            delay = max(delay, retry_after)
+        left = self._budget_left(started)
+        if left is not None and delay >= left:
+            return None
+        return delay
+
+    @staticmethod
+    def _parse_retry_after(value: Optional[str]) -> Optional[float]:
+        """``Retry-After`` seconds form only (our server always sends it);
+        an HTTP-date or garbage value forfeits the hint, never errors."""
+        if not value:
+            return None
+        try:
+            return max(0.0, float(value))
+        except (TypeError, ValueError):
+            return None
+
+    def _headers(self) -> Dict[str, str]:
+        """Per-request headers: trace id always; the context deadline's
+        remaining budget rides ``X-Gordo-Deadline`` so the server can 504
+        work we have already given up on."""
+        headers = {tracing.TRACE_HEADER: tracing.current_or_new()}
+        budget = deadline.header_value()
+        if budget is not None:
+            headers[deadline.DEADLINE_HEADER] = budget
+        return headers
+
+    @staticmethod
+    def _refresh_deadline_header(headers: Dict[str, str]) -> None:
+        """Retries re-stamp the REMAINING budget (the trace id stays fixed
+        for the call): a header frozen at first attempt would overstate
+        what the caller still has, and the server would under-504."""
+        budget = deadline.header_value()
+        if budget is not None:
+            headers[deadline.DEADLINE_HEADER] = budget
 
     # -- endpoint resolution -------------------------------------------------
     def resolve_machines(self) -> List[str]:
@@ -98,17 +178,39 @@ class Client:
         # one trace id per chunk request (adopting any id already bound to
         # the calling context): the server echoes it and stamps it on its
         # log records, so a slow chunk is grep-able end to end
-        headers = {tracing.TRACE_HEADER: tracing.current_or_new()}
+        headers = self._headers()
+        breaker = self._breaker()
+        started = time.monotonic()
         last_error: Optional[str] = None
+        retry_after: Optional[float] = None
         for attempt in range(self.retries + 1):
             if attempt:
-                await asyncio.sleep(self._backoff_delay(attempt))
+                delay = self._retry_delay(attempt, started, retry_after)
+                if delay is None:
+                    _M_REQUESTS.labels("budget_exhausted").inc()
+                    raise ClientError(
+                        f"{machine} [{start}, {end}): retry budget "
+                        f"exhausted ({last_error})"
+                    )
+                await asyncio.sleep(delay)
+                self._refresh_deadline_header(headers)
+            retry_after = None
+            if not breaker.allow():
+                # every chunk to this base URL shares the circuit: a dead
+                # endpoint costs the few calls that tripped it, the rest
+                # fail here in microseconds
+                _M_REQUESTS.labels("circuit_open").inc()
+                raise ClientError(
+                    f"{machine} [{start}, {end}): circuit open for "
+                    f"{self.base_url} ({last_error or 'recent failures'})"
+                )
             try:
                 async with semaphore:
                     async with session.post(
                         url, params=params, headers=headers
                     ) as response:
                         if 400 <= response.status < 500:
+                            breaker.record(True)  # alive — the REQUEST is bad
                             body = await response.text()
                             _M_REQUESTS.labels("permanent_4xx").inc()
                             raise ClientError(
@@ -116,19 +218,34 @@ class Client:
                                 f"HTTP {response.status}: {body[:500]}"
                             )
                         if response.status >= 500:
+                            hint = self._parse_retry_after(
+                                response.headers.get("Retry-After")
+                            )
+                            # flow control from a LIVE server — a 503 shed
+                            # carrying Retry-After, or a 504 for OUR expired
+                            # deadline — must not count toward tripping the
+                            # circuit; bare 5xx (dead proxy, crash) does
+                            breaker.record(
+                                response.status == 504
+                                or (response.status == 503 and hint is not None)
+                            )
+                            retry_after = hint
                             last_error = f"HTTP {response.status}"
                             _M_RETRIES.labels("http_5xx").inc()
                             continue
                         payload = await response.json()
+                        breaker.record(True)
                         _M_REQUESTS.labels("ok").inc()
                         return payload
             except ClientError:
                 raise
             except asyncio.TimeoutError as exc:  # distinct: a timing-out
                 # server looks healthy to connection-error counters
+                breaker.record(False)
                 last_error = repr(exc)
                 _M_RETRIES.labels("timeout").inc()
             except Exception as exc:  # connection errors -> retry
+                breaker.record(False)
                 last_error = repr(exc)
                 _M_RETRIES.labels("connection").inc()
         _M_REQUESTS.labels("exhausted").inc()
@@ -210,32 +327,62 @@ class Client:
             raise ValueError(f"fmt must be 'parquet' or 'json', got {fmt!r}")
 
         # same retry contract as the async path (_fetch_chunk): 4xx is
-        # permanent, 5xx/connection errors retry with jittered backoff, and
+        # permanent, 5xx/connection errors retry with jittered backoff
+        # (honoring any Retry-After and the call's retry budget), the
+        # endpoint's shared circuit short-circuits a dead server, and
         # every terminal failure surfaces as ClientError
-        kwargs.setdefault("headers", {})[
-            tracing.TRACE_HEADER
-        ] = tracing.current_or_new()
+        kwargs.setdefault("headers", {}).update(self._headers())
+        breaker = self._breaker()
+        started = time.monotonic()
         last_error: Optional[str] = None
+        retry_after: Optional[float] = None
         for attempt in range(self.retries + 1):
             if attempt:
-                time.sleep(self._backoff_delay(attempt))
+                delay = self._retry_delay(attempt, started, retry_after)
+                if delay is None:
+                    _M_REQUESTS.labels("budget_exhausted").inc()
+                    raise ClientError(
+                        f"{machine}: retry budget exhausted ({last_error})"
+                    )
+                time.sleep(delay)
+                self._refresh_deadline_header(kwargs["headers"])
+            retry_after = None
+            if not breaker.allow():
+                _M_REQUESTS.labels("circuit_open").inc()
+                raise ClientError(
+                    f"{machine}: circuit open for {self.base_url} "
+                    f"({last_error or 'recent failures'})"
+                )
             try:
                 response = requests.post(url, timeout=self.timeout, **kwargs)
             except requests.Timeout as exc:
+                breaker.record(False)
                 last_error = repr(exc)
                 _M_RETRIES.labels("timeout").inc()
                 continue
             except requests.RequestException as exc:
+                breaker.record(False)
                 last_error = repr(exc)
                 _M_RETRIES.labels("connection").inc()
                 continue
             if 400 <= response.status_code < 500:
+                breaker.record(True)  # alive — the REQUEST is bad
                 _M_REQUESTS.labels("permanent_4xx").inc()
                 raise ClientError(
                     f"{machine}: HTTP {response.status_code}: "
                     f"{response.text[:500]}"
                 )
             if response.status_code >= 500:
+                hint = self._parse_retry_after(
+                    response.headers.get("Retry-After")
+                )
+                # same live-server carve-outs as the async path: 503+hint
+                # and 504 are answers, not deaths
+                breaker.record(
+                    response.status_code == 504
+                    or (response.status_code == 503 and hint is not None)
+                )
+                retry_after = hint
                 last_error = f"HTTP {response.status_code}"
                 _M_RETRIES.labels("http_5xx").inc()
                 continue
@@ -243,9 +390,11 @@ class Client:
                 payload = response.json()
             except ValueError:  # 2xx with a non-JSON body (broken proxy):
                 # retryable, and terminal failures stay ClientError
+                breaker.record(False)
                 last_error = "2xx response with non-JSON body"
                 _M_RETRIES.labels("bad_body").inc()
                 continue
+            breaker.record(True)
             _M_REQUESTS.labels("ok").inc()
             chunk = self._chunk_frame(payload)
             return chunk if chunk is not None else pd.DataFrame()

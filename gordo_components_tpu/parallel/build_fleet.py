@@ -42,6 +42,7 @@ from ..models.analysis import analyze_model as _analyze_model
 from ..models.transformers import MinMaxScaler, StandardScaler
 from ..observability.registry import REGISTRY
 from ..ops.scaling import ScalerParams
+from ..resilience import faults
 from ..serializer import dump, pipeline_from_definition
 from ..utils import disk_registry
 from .fleet import (
@@ -58,7 +59,12 @@ logger = logging.getLogger(__name__)
 
 _M_FLEET_MACHINES = REGISTRY.counter(
     "gordo_fleet_machines_total",
-    "Fleet-build machines resolved, by outcome (completed / cached)",
+    "Fleet-build machines resolved, by outcome (completed / cached / failed)",
+    labels=("outcome",),
+)
+_M_BUILD_FETCH = REGISTRY.counter(
+    "gordo_resilience_build_fetch_total",
+    "Fleet-build per-machine data-fetch outcomes (ok / retry / failed)",
     labels=("outcome",),
 )
 _M_MACHINE_BUILD_SECONDS = REGISTRY.gauge(
@@ -85,6 +91,54 @@ EXIT_RETRYABLE = 75
 SLICE_TIMEOUT_ENV = "GORDO_SLICE_TIMEOUT_S"
 _CKPT_SUBDIR = ".slice_checkpoints"
 
+# per-machine data-fetch retry knobs (build-time resilience): transient
+# lake hiccups get a few backed-off retries; a machine that STILL fails is
+# isolated (built as zero-weight padding, recorded failed in the manifest)
+# instead of killing the other N-1 machines' build
+FETCH_RETRIES_ENV = "GORDO_BUILD_FETCH_RETRIES"
+FETCH_BACKOFF_ENV = "GORDO_BUILD_FETCH_BACKOFF"
+
+
+def _fetch_machine_data(item: dict, retries: int, backoff: float) -> Optional[str]:
+    """Fetch one machine's training data into ``item`` (X/y/metadata),
+    retrying transient provider failures with exponential backoff. Returns
+    None on success, else the terminal error string — the caller decides
+    isolation. Permanently-diagnosable failures (bad config, insufficient
+    rows) skip the retry loop: re-reading the lake cannot grow history."""
+    from ..dataset.dataset import InsufficientDataError
+
+    name = item["machine"].name
+    last_error: Optional[str] = None
+    for attempt in range(max(0, retries) + 1):
+        if attempt:
+            _M_BUILD_FETCH.labels("retry").inc()
+            time.sleep(backoff * 2 ** (attempt - 1))
+        try:
+            # chaos seam: `data-fetch:<machine>:error` stands in for a
+            # dead lake / revoked credential for exactly one machine
+            faults.inject("data-fetch", name)
+            X_frame, y_frame = item["dataset"].get_data()
+            item["X"] = np.asarray(
+                getattr(X_frame, "values", X_frame), np.float32
+            )
+            item["y"] = np.asarray(
+                getattr(y_frame, "values", y_frame), np.float32
+            )
+            item["dataset_metadata"] = item["dataset"].get_metadata()
+            _M_BUILD_FETCH.labels("ok").inc()
+            return None
+        except (InsufficientDataError, ValueError) as exc:  # permanent
+            last_error = f"{type(exc).__name__}: {exc}"
+            break
+        except Exception as exc:
+            last_error = f"{type(exc).__name__}: {exc}"
+            logger.warning(
+                "Fleet fetch failed for %r (attempt %d/%d): %s",
+                name, attempt + 1, max(0, retries) + 1, last_error,
+            )
+    _M_BUILD_FETCH.labels("failed").inc()
+    return last_error
+
 
 def _prepare_slice(
     slice_items: List[dict],
@@ -94,6 +148,8 @@ def _prepare_slice(
     quantize_rows: bool,
     span: Optional[Tuple[int, int]] = None,
     place: Optional[Tuple[Any, Any, bool]] = None,
+    fetch_retries: int = 2,
+    fetch_backoff: float = 0.5,
 ):
     """Host-side ingest for one slice: provider fetch + padded stacked
     assembly. Runs on the prefetch worker so slice ``s+1``'s data-lake reads
@@ -139,10 +195,20 @@ def _prepare_slice(
     fetch_started = time.perf_counter()
 
     def fetch_one(item: dict) -> None:
-        X_frame, y_frame = item["dataset"].get_data()
-        item["X"] = np.asarray(getattr(X_frame, "values", X_frame), np.float32)
-        item["y"] = np.asarray(getattr(y_frame, "values", y_frame), np.float32)
-        item["dataset_metadata"] = item["dataset"].get_metadata()
+        # per-machine failure isolation: a machine whose fetch fails after
+        # retries trains as zero-weight padding (fold masks already handle
+        # empty machines) and is reported failed — it must not take the
+        # other N-1 machines of the slice down with it
+        error = _fetch_machine_data(item, fetch_retries, fetch_backoff)
+        if error is not None:
+            logger.error(
+                "Isolating machine %r from fleet build: %s",
+                item["machine"].name, error,
+            )
+            item["build_error"] = error
+            item["X"] = np.zeros((0, n_features), np.float32)
+            item["y"] = np.zeros((0, n_targets), np.float32)
+            item["dataset_metadata"] = {}
 
     # items the width probe already fetched are skipped
     to_fetch = [item for item in local_items if "X" not in item]
@@ -158,13 +224,14 @@ def _prepare_slice(
             max_workers=min(8, len(to_fetch)),
             thread_name_prefix="fleet-fetch",
         ) as pool:
-            # list() so the first provider exception propagates verbatim
             list(pool.map(fetch_one, to_fetch))
     else:
         for item in to_fetch:
             fetch_one(item)
 
-    n_rows = max((len(item["X"]) for item in local_items), default=1)
+    # max(…, 1): an all-isolated slice (every fetch failed) still needs a
+    # nonzero row axis for the padded program
+    n_rows = max(max((len(item["X"]) for item in local_items), default=1), 1)
     if quantize_rows:
         # quantize the row axis so slices with slightly different history
         # lengths share one (n_padded, n_rows, F) shape and the bucket
@@ -823,8 +890,20 @@ def build_fleet(
     n_splits: int = 3,
     profile_dir: Optional[str] = None,
     slice_size: Optional[int] = 256,
+    fetch_retries: Optional[int] = None,
+    fetch_backoff: Optional[float] = None,
 ) -> Dict[str, str]:
     """Build every machine; returns ``{name: model_dir}``.
+
+    **Per-machine failure isolation**: a machine whose data fetch fails
+    (after ``fetch_retries`` backed-off retries — defaults from
+    ``GORDO_BUILD_FETCH_RETRIES``/``GORDO_BUILD_FETCH_BACKOFF``, else 2 /
+    0.5 s) is built as zero-weight padding and recorded ``failed`` in the
+    fleet manifest instead of aborting the other machines' build; it is
+    absent from the returned mapping and, being unregistered, retried by
+    the next run. (Single-host only for the width-probe path — multi-host
+    bucketing must stay process-identical, so probe failures there still
+    abort.)
 
     Machines whose config hash is already registered are skipped (idempotent
     resume). Remaining machines are bucketed by (model config, data shape)
@@ -863,6 +942,10 @@ def build_fleet(
         raise ValueError(
             f"slice_size must be a positive integer or None, got {slice_size!r}"
         )
+    if fetch_retries is None:
+        fetch_retries = int(os.environ.get(FETCH_RETRIES_ENV, "2"))
+    if fetch_backoff is None:
+        fetch_backoff = float(os.environ.get(FETCH_BACKOFF_ENV, "0.5"))
     multihost = jax.process_count() > 1
     if multihost:
         if mesh is None:
@@ -941,13 +1024,27 @@ def build_fleet(
         if hasattr(dataset, "_columns_for"):
             n_features = len(dataset._columns_for(dataset.tag_list))
             n_targets = len(dataset._columns_for(dataset.target_tag_list))
-        else:  # non-TimeSeriesDataset: widths require a fetch — keep the
-            # probe's data so the fetch phase doesn't read it twice
+        elif multihost:  # non-TimeSeriesDataset: widths require a fetch —
+            # and multi-host bucketing must stay identical on every
+            # process, so a probe failure aborts (job-level retry) rather
+            # than diverging the collective program
             X_probe, y_probe = dataset.get_data()
             n_features, n_targets = X_probe.shape[1], y_probe.shape[1]
             item["X"] = np.asarray(getattr(X_probe, "values", X_probe), np.float32)
             item["y"] = np.asarray(getattr(y_probe, "values", y_probe), np.float32)
             item["dataset_metadata"] = dataset.get_metadata()
+        else:  # single-host width probe: fetch with retry, isolating a
+            # terminally-failing machine BEFORE it ever buckets
+            error = _fetch_machine_data(item, fetch_retries, fetch_backoff)
+            if error is not None:
+                logger.error(
+                    "Isolating machine %r from fleet build (width probe): %s",
+                    machine.name, error,
+                )
+                manifest[machine.name] = {"status": "failed", "error": error}
+                _M_FLEET_MACHINES.labels("failed").inc()
+                continue
+            n_features, n_targets = item["X"].shape[1], item["y"].shape[1]
         item["F"], item["T"] = n_features, n_targets
         item["n_splits"] = eff_splits
         # resolve the fold-execution mode NOW (None → the remat-derived
@@ -972,6 +1069,16 @@ def build_fleet(
             default=str,
         )
         buckets.setdefault(sig, []).append(item)
+
+    if any(
+        entry.get("status") == "failed" for entry in manifest.values()
+    ):
+        # probe-isolated machines must land in the on-disk manifest even
+        # when every remaining machine is cached (no slice write follows)
+        _write_manifest(
+            output_dir, manifest,
+            [m.name for m, *_ in pending if m.name not in manifest],
+        )
 
     master_key = jax.random.PRNGKey(seed)
     checkpointer = _SliceCheckpointer(output_dir, mesh=mesh)
@@ -1033,7 +1140,7 @@ def build_fleet(
             prepared = prefetcher.submit(
                 _prepare_slice,
                 slices[0], n_padded, n_features, n_targets, quantize_rows,
-                span, place,
+                span, place, fetch_retries, fetch_backoff,
             )
             for s, slice_items in enumerate(slices):
                 # armed only multi-host + GORDO_SLICE_TIMEOUT_S: if THIS
@@ -1049,7 +1156,8 @@ def build_fleet(
                     prepared = prefetcher.submit(
                         _prepare_slice,
                         slices[s + 1], n_padded, n_features, n_targets,
-                        quantize_rows, span, place,
+                        quantize_rows, span, place, fetch_retries,
+                        fetch_backoff,
                     )
                 keys = jax.random.split(
                     jax.random.fold_in(jax.random.fold_in(master_key, b), s),
@@ -1135,6 +1243,18 @@ def build_fleet(
                     # the in-flight slice ------------------------------------------
                     for i, item in indexed_items:
                         machine = item["machine"]
+                        if "build_error" in item:
+                            # isolated at fetch: trained as zero-weight
+                            # padding; no artifact, no registry key — the
+                            # next run retries it from scratch
+                            manifest[machine.name] = {
+                                "status": "failed",
+                                "error": item["build_error"],
+                                "bucket": b,
+                                "slice": s,
+                            }
+                            _M_FLEET_MACHINES.labels("failed").inc()
+                            continue
                         model = pipeline_from_definition(machine.model_config)
                         _install_result(
                             model, result, i, n_features, n_targets, bucket_splits

@@ -1,0 +1,41 @@
+"""Fleet resilience layer: the reflexes under the PR-1 eyes.
+
+The reference leaned on Kubernetes for every failure mode — one model per
+pod, restart anything that misbehaves. This rebuild serves an entire
+fleet from ONE process, so containment must live in-process. Five
+dependency-light primitives, wired through every layer and all publishing
+``gordo_resilience_*`` series into the shared metrics registry:
+
+- :mod:`.deadline`   — ``X-Gordo-Deadline`` header → contextvar → checks
+  at the expensive boundaries; expired work 504s instead of queueing.
+- :mod:`.admission`  — bounded in-flight gate; saturation sheds with
+  503 + ``Retry-After`` instead of convoying werkzeug threads.
+- :mod:`.breaker`    — closed/open/half-open circuit breakers so a dead
+  endpoint costs one timeout, not N × timeout per scrape.
+- :mod:`.quarantine` — per-machine hard/soft failure ledger; one broken
+  machine 503s while the fleet keeps serving, with probe-based recovery.
+- :mod:`.faults`     — env/CLI-driven fault injection at the seams
+  (latency, exceptions, corrupt payloads) for chaos tests and
+  ``make chaos-smoke``.
+"""
+
+from .admission import AdmissionController, AdmissionRejected
+from .breaker import BreakerBoard, CircuitBreaker, CircuitOpen
+from .deadline import DEADLINE_HEADER, DeadlineExceeded, deadline_scope
+from .faults import ENV_VAR as FAULTS_ENV_VAR
+from .faults import FaultInjected
+from .quarantine import Quarantine
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "BreakerBoard",
+    "CircuitBreaker",
+    "CircuitOpen",
+    "DEADLINE_HEADER",
+    "DeadlineExceeded",
+    "FAULTS_ENV_VAR",
+    "FaultInjected",
+    "Quarantine",
+    "deadline_scope",
+]

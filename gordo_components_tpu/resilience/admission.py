@@ -1,0 +1,176 @@
+"""Admission control: a bounded in-flight gate that sheds load early.
+
+Without it, every request werkzeug accepts parks a thread on the engine's
+per-bucket leader latch: under a traffic spike the server accumulates an
+unbounded convoy of threads, memory, and latency, and by the time a
+request reaches the device its caller has long since timed out. The gate
+bounds BOTH the concurrently-scoring requests (``max_inflight``) and the
+waiters behind them (``max_queue``); everything beyond that is shed
+immediately with 503 + ``Retry-After`` — the signal a well-behaved client
+(ours honors it, see client.py) uses to back off instead of re-piling on.
+
+A shed costs microseconds; an admitted-but-doomed request costs a thread,
+a queue slot, and a device dispatch. Deadline-aware: a queued waiter never
+waits past its request's remaining deadline budget.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..observability.registry import REGISTRY
+from . import deadline
+
+_M_INFLIGHT = REGISTRY.gauge(
+    "gordo_resilience_inflight",
+    "Requests currently admitted and scoring (admission gate occupancy)",
+)
+_M_QUEUE_DEPTH = REGISTRY.gauge(
+    "gordo_resilience_queue_depth",
+    "Requests waiting at the admission gate for an in-flight slot",
+)
+_M_ADMISSION = REGISTRY.counter(
+    "gordo_resilience_admission_total",
+    "Admission-gate decisions (admitted / shed_queue_full / shed_timeout "
+    "/ shed_deadline)",
+    labels=("outcome",),
+)
+
+
+class AdmissionRejected(Exception):
+    """The gate shed this request; HTTP layers translate to 503 with
+    ``Retry-After: retry_after``."""
+
+    def __init__(self, reason: str, retry_after: float):
+        super().__init__(reason)
+        self.retry_after = retry_after
+
+
+class AdmissionController:
+    """``with gate.admit(): score()`` — raises :class:`AdmissionRejected`
+    when saturated.
+
+    ``max_inflight``: concurrent admitted requests (size to the engine's
+    useful parallelism — roughly max_batch per bucket, not werkzeug's
+    thread count). ``max_queue``: waiters allowed behind a full gate
+    (micro-burst absorption). ``queue_timeout``: how long a waiter holds
+    its thread before shedding anyway. ``retry_after``: the backoff hint
+    shed responses carry.
+    """
+
+    def __init__(
+        self,
+        max_inflight: int = 64,
+        max_queue: int = 32,
+        queue_timeout: float = 1.0,
+        retry_after: float = 1.0,
+    ):
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        self.max_inflight = max_inflight
+        self.max_queue = max(0, int(max_queue))
+        self.queue_timeout = queue_timeout
+        self.retry_after = retry_after
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._waiting = 0
+
+    # -- stats ---------------------------------------------------------------
+    @property
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return self._waiting
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "inflight": self._inflight,
+                "queue_depth": self._waiting,
+                "max_inflight": self.max_inflight,
+                "max_queue": self.max_queue,
+            }
+
+    # -- gate ----------------------------------------------------------------
+    def admit(self) -> "_Admission":
+        """Acquire an in-flight slot or raise :class:`AdmissionRejected`.
+
+        Fast path: slot free → admitted. Full: join the bounded queue and
+        wait up to ``queue_timeout`` (clipped to the request's remaining
+        deadline — a waiter whose caller has given up must not keep
+        holding a queue slot)."""
+        with self._cond:
+            if self._inflight < self.max_inflight:
+                self._inflight += 1
+                _M_INFLIGHT.set(self._inflight)
+                _M_ADMISSION.labels("admitted").inc()
+                return _Admission(self)
+            if self._waiting >= self.max_queue:
+                _M_ADMISSION.labels("shed_queue_full").inc()
+                raise AdmissionRejected(
+                    f"saturated: {self._inflight} in flight, "
+                    f"{self._waiting} queued",
+                    self.retry_after,
+                )
+            budget: Optional[float] = self.queue_timeout
+            left = deadline.remaining()
+            if left is not None:
+                if left <= 0:
+                    _M_ADMISSION.labels("shed_deadline").inc()
+                    raise AdmissionRejected(
+                        "deadline expired while queueing", self.retry_after
+                    )
+                budget = min(budget, left)
+            self._waiting += 1
+            _M_QUEUE_DEPTH.set(self._waiting)
+            try:
+                end = time.monotonic() + budget
+                while self._inflight >= self.max_inflight:
+                    left = end - time.monotonic()
+                    if left <= 0:
+                        _M_ADMISSION.labels("shed_timeout").inc()
+                        raise AdmissionRejected(
+                            f"queued {budget:.2f}s without a slot freeing",
+                            self.retry_after,
+                        )
+                    self._cond.wait(timeout=left)
+                self._inflight += 1
+                _M_INFLIGHT.set(self._inflight)
+                _M_ADMISSION.labels("admitted").inc()
+                return _Admission(self)
+            finally:
+                self._waiting -= 1
+                _M_QUEUE_DEPTH.set(self._waiting)
+
+    def _release(self) -> None:
+        with self._cond:
+            self._inflight -= 1
+            _M_INFLIGHT.set(self._inflight)
+            self._cond.notify()
+
+
+class _Admission:
+    """Context manager releasing the slot exactly once."""
+
+    __slots__ = ("_gate", "_released")
+
+    def __init__(self, gate: AdmissionController):
+        self._gate = gate
+        self._released = False
+
+    def __enter__(self) -> "_Admission":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._gate._release()

@@ -1,0 +1,200 @@
+"""Circuit breaker: closed → open → half-open → closed, failure-ratio
+tripped, probe-based recovery.
+
+Why the fleet needs one: watchman polls N machines per ``GET /`` and the
+client fires machine × chunk requests per predict — against a DEAD
+endpoint each of those costs a full connect/read timeout, so one downed
+host turns a 5 s status poll into N × timeout. With a breaker the first
+few failures trip the circuit and every later call fails in microseconds
+until the recovery window elapses, when ONE probe is let through to test
+the water (half-open); its outcome re-closes or re-opens the circuit.
+
+Deliberately synchronous and lock-light: ``allow()`` + ``record(ok)``
+around the guarded call. The clock is injectable so state-machine tests
+advance time instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..observability.registry import REGISTRY
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# gauge encoding (dashboards alert on == 1)
+_STATE_VALUE = {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}
+
+_M_TRANSITIONS = REGISTRY.counter(
+    "gordo_resilience_breaker_transitions_total",
+    "Circuit-breaker state transitions, by breaker name and new state",
+    labels=("name", "to"),
+)
+_M_STATE = REGISTRY.gauge(
+    "gordo_resilience_breaker_state",
+    "Current breaker state (0 closed, 1 open, 2 half-open)",
+    labels=("name",),
+)
+_M_SHORT_CIRCUITS = REGISTRY.counter(
+    "gordo_resilience_breaker_short_circuits_total",
+    "Calls refused instantly because the breaker was open",
+    labels=("name",),
+)
+
+
+class CircuitOpen(Exception):
+    """The circuit is open; the call was refused without being attempted.
+    ``retry_after`` is the seconds until the next half-open probe."""
+
+    def __init__(self, name: str, retry_after: float):
+        super().__init__(
+            f"circuit {name!r} is open; retry in {retry_after:.1f}s"
+        )
+        self.retry_after = max(0.0, retry_after)
+
+
+class CircuitBreaker:
+    """``allow()`` before the guarded call, ``record(ok)`` after.
+
+    Trips open when, among the last ``window`` outcomes (with at least
+    ``min_calls`` seen), the failure ratio reaches ``failure_ratio``.
+    After ``recovery_time`` seconds open, ONE caller gets a half-open
+    probe; its success closes the circuit (history cleared), its failure
+    re-opens it for another ``recovery_time``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        failure_ratio: float = 0.5,
+        window: int = 10,
+        min_calls: int = 3,
+        recovery_time: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.name = name
+        self.failure_ratio = failure_ratio
+        self.window = max(1, int(window))
+        self.min_calls = max(1, int(min_calls))
+        self.recovery_time = recovery_time
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._outcomes: list = []  # rolling 1/0 window, newest last
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._probe_started = 0.0
+        _M_STATE.labels(name).set(_STATE_VALUE[CLOSED])
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _transition(self, to: str) -> None:
+        # caller holds self._lock
+        if to == self._state:
+            return
+        self._state = to
+        _M_TRANSITIONS.labels(self.name, to).inc()
+        _M_STATE.labels(self.name).set(_STATE_VALUE[to])
+
+    def allow(self) -> bool:
+        """True when the caller may attempt the guarded call (and MUST then
+        ``record`` its outcome). False = short-circuit: fail fast."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            now = self._clock()
+            if self._state == OPEN:
+                if now - self._opened_at < self.recovery_time:
+                    _M_SHORT_CIRCUITS.labels(self.name).inc()
+                    return False
+                self._transition(HALF_OPEN)
+                self._probe_in_flight = True
+                self._probe_started = now
+                return True
+            # HALF_OPEN: exactly one probe at a time; everyone else waits.
+            # A probe whose caller died between allow() and record()
+            # (cancelled task, unexpected exception) would otherwise wedge
+            # the breaker open FOREVER — reclaim the slot after a full
+            # recovery window of silence.
+            if self._probe_in_flight:
+                if now - self._probe_started < self.recovery_time:
+                    _M_SHORT_CIRCUITS.labels(self.name).inc()
+                    return False
+            self._probe_in_flight = True
+            self._probe_started = now
+            return True
+
+    def retry_after(self) -> float:
+        """Seconds until the next half-open probe would be allowed."""
+        with self._lock:
+            if self._state != OPEN:
+                return 0.0
+            return max(
+                0.0, self.recovery_time - (self._clock() - self._opened_at)
+            )
+
+    def guard(self) -> None:
+        """``allow()`` or raise :class:`CircuitOpen` — the exception-style
+        entry point for call sites that propagate errors upward."""
+        if not self.allow():
+            raise CircuitOpen(self.name, self.retry_after())
+
+    def record(self, ok: bool) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._probe_in_flight = False
+                if ok:
+                    # the probe proved the endpoint back: clean slate
+                    self._outcomes.clear()
+                    self._transition(CLOSED)
+                else:
+                    self._opened_at = self._clock()
+                    self._transition(OPEN)
+                return
+            self._outcomes.append(1 if ok else 0)
+            if len(self._outcomes) > self.window:
+                del self._outcomes[: -self.window]
+            if (
+                self._state == CLOSED
+                and len(self._outcomes) >= self.min_calls
+            ):
+                failures = self._outcomes.count(0)
+                if failures / len(self._outcomes) >= self.failure_ratio:
+                    self._opened_at = self._clock()
+                    self._transition(OPEN)
+
+
+class BreakerBoard:
+    """Get-or-create breakers keyed by name — one per downstream endpoint,
+    shared across a component's call sites (all of a client's chunk
+    fetches to one base URL share one circuit)."""
+
+    def __init__(self, **defaults):
+        self._defaults = defaults
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str, **overrides) -> CircuitBreaker:
+        with self._lock:
+            breaker = self._breakers.get(name)
+            if breaker is None:
+                kwargs = dict(self._defaults)
+                kwargs.update(overrides)
+                breaker = self._breakers[name] = CircuitBreaker(
+                    name, **kwargs
+                )
+            return breaker
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return {
+                name: breaker.state
+                for name, breaker in sorted(self._breakers.items())
+            }

@@ -1,0 +1,121 @@
+"""Deadline propagation: ``X-Gordo-Deadline`` header → contextvar → checks.
+
+The reference never bounded work: a request that arrived with 50 ms of
+client patience left would still queue behind the engine, fetch a day of
+data, and compute an answer nobody was waiting for. Here the client sends
+its REMAINING budget (seconds, as a decimal string — relative, so no
+cross-host clock sync is assumed), the server binds it to the handler's
+context as an absolute monotonic deadline, and the expensive boundaries
+(engine dispatch, server-side data fetch) check it BEFORE starting:
+expired work returns 504 immediately instead of occupying a werkzeug
+thread and a device slot.
+
+``contextvars`` (not thread-locals) for the same reason as tracing: the
+deadline must flow through both the threaded WSGI server and the client's
+asyncio fan-out without any call site threading it by hand.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import time
+from contextvars import ContextVar
+from typing import Iterator, Optional
+
+from ..observability.registry import REGISTRY
+
+DEADLINE_HEADER = "X-Gordo-Deadline"
+
+# absolute time.monotonic() deadline; 0.0 = no deadline bound
+_deadline: ContextVar[float] = ContextVar("gordo_deadline", default=0.0)
+
+_M_EXPIRED = REGISTRY.counter(
+    "gordo_resilience_deadline_expired_total",
+    "Work refused because the request's deadline had already passed, "
+    "by the boundary that caught it",
+    labels=("where",),
+)
+
+
+class DeadlineExceeded(Exception):
+    """The bound deadline passed before (or while) doing the work; HTTP
+    layers translate this to 504."""
+
+
+def parse_header(value: Optional[str]) -> Optional[float]:
+    """Header value → remaining seconds, or None when absent/garbage.
+    Unparseable deadlines are ignored rather than 400'd: a misconfigured
+    proxy header must not break scoring, only forfeit deadline cover."""
+    if not value:
+        return None
+    try:
+        seconds = float(value)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(seconds):
+        # 'nan'/'inf' parse as floats but are garbage: min(nan, cap)
+        # would silently bind an already-expired deadline and 504 every
+        # request — forfeit cover instead, like any other junk value
+        return None
+    # negative budgets are already expired; cap absurd values so an
+    # overflowing header cannot bind a deadline past float precision
+    return max(0.0, min(seconds, 86400.0))
+
+
+def set_deadline(seconds: float):
+    """Bind ``now + seconds`` as the context deadline; returns the reset
+    token."""
+    return _deadline.set(time.monotonic() + seconds)
+
+
+def reset(token) -> None:
+    _deadline.reset(token)
+
+
+def remaining() -> Optional[float]:
+    """Seconds left (may be negative), or None when no deadline is bound."""
+    bound = _deadline.get()
+    if not bound:
+        return None
+    return bound - time.monotonic()
+
+
+def expired() -> bool:
+    left = remaining()
+    return left is not None and left <= 0.0
+
+
+def check(where: str) -> None:
+    """Raise :class:`DeadlineExceeded` if the bound deadline has passed —
+    the pre-flight gate every expensive boundary calls. No-op when no
+    deadline is bound (warmup, CLI batch jobs)."""
+    left = remaining()
+    if left is not None and left <= 0.0:
+        _M_EXPIRED.labels(where).inc()
+        raise DeadlineExceeded(
+            f"deadline exceeded {-left:.3f}s ago (checked at {where})"
+        )
+
+
+def header_value() -> Optional[str]:
+    """The remaining budget as an outbound header value, or None when no
+    deadline is bound — how a caller propagates its own deadline
+    downstream (client → server)."""
+    left = remaining()
+    if left is None:
+        return None
+    return f"{max(0.0, left):.3f}"
+
+
+@contextlib.contextmanager
+def deadline_scope(seconds: Optional[float]) -> Iterator[None]:
+    """Bind a deadline for the duration of the block (no-op on None)."""
+    if seconds is None:
+        yield
+        return
+    token = set_deadline(seconds)
+    try:
+        yield
+    finally:
+        _deadline.reset(token)

@@ -1,0 +1,200 @@
+"""Fault injection: env/CLI-driven failures at the system's seams.
+
+Chaos testing a fleet server needs failures on demand — a model dir that
+won't load, a dispatch that hangs, a probe target that errors — without
+hand-crafted monkeypatching per test. This module is the ONE switchboard:
+production code calls :func:`inject` / :func:`corrupt` at its boundaries
+(no-ops unless faults are configured, a dict lookup when they are), and
+the chaos suite + ``tools/chaos_smoke.py`` + ``GORDO_FAULTS`` drive it.
+
+Spec grammar (``GORDO_FAULTS`` env var or ``--faults`` CLI flag)::
+
+    point:target:kind[:param][;point:target:kind[:param]...]
+
+- ``point``   — where: ``model-load``, ``engine-dispatch``, ``probe``,
+  ``data-fetch`` (the wired boundaries; unknown points simply never fire)
+- ``target``  — machine/endpoint name, or ``*`` for any
+- ``kind``    — ``error`` (raise :class:`FaultInjected`; param = message),
+  ``latency`` (sleep; param = seconds, default 0.05), or
+  ``corrupt`` (NaN-poison the payload via :func:`corrupt`)
+
+Example: one machine slow, another broken at load::
+
+    GORDO_FAULTS='engine-dispatch:mach-slow:latency:0.2;model-load:mach-dead:error'
+
+Injected faults count into ``gordo_resilience_faults_injected_total`` so
+a chaos run's metrics are self-describing — a 503 spike with a matching
+fault count is an experiment, without one an incident.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..observability.registry import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "GORDO_FAULTS"
+
+POINTS = ("model-load", "engine-dispatch", "probe", "data-fetch")
+KINDS = ("error", "latency", "corrupt")
+
+_M_INJECTED = REGISTRY.counter(
+    "gordo_resilience_faults_injected_total",
+    "Faults fired by the injection harness, by boundary and kind",
+    labels=("point", "kind"),
+)
+
+
+class FaultInjected(RuntimeError):
+    """An injected ``error`` fault fired — the stand-in for a real crash."""
+
+
+class _Rule:
+    __slots__ = ("point", "target", "kind", "param")
+
+    def __init__(self, point: str, target: str, kind: str, param: str):
+        self.point = point
+        self.target = target
+        self.kind = kind
+        self.param = param
+
+    def matches(self, point: str, target: Optional[str]) -> bool:
+        if self.point != point:
+            return False
+        return self.target == "*" or (
+            target is not None and self.target == target
+        )
+
+
+_lock = threading.Lock()
+_rules: List[_Rule] = []
+_configured = False  # has configure()/clear() run (beats lazy env read)
+
+
+def parse_spec(spec: str) -> List[_Rule]:
+    """Parse a fault spec string; raises ValueError on bad grammar so a
+    typo'd ``--faults`` fails the CLI loudly instead of silently injecting
+    nothing."""
+    rules: List[_Rule] = []
+    for chunk in spec.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":", 3)
+        if len(parts) < 3:
+            raise ValueError(
+                f"fault rule {chunk!r} must be point:target:kind[:param]"
+            )
+        point, target, kind = parts[0], parts[1], parts[2]
+        param = parts[3] if len(parts) > 3 else ""
+        if kind not in KINDS:
+            raise ValueError(
+                f"fault kind {kind!r} not one of {KINDS} in rule {chunk!r}"
+            )
+        if kind == "latency":
+            try:
+                float(param or "0.05")
+            except ValueError:
+                raise ValueError(
+                    f"latency param must be seconds, got {param!r}"
+                ) from None
+        rules.append(_Rule(point, target, kind, param))
+    return rules
+
+
+def configure(spec: str) -> int:
+    """Install a fault spec (replacing any active one); returns the rule
+    count. Empty string clears."""
+    global _configured
+    rules = parse_spec(spec)
+    with _lock:
+        _rules[:] = rules
+        _configured = True
+    if rules:
+        logger.warning(
+            "FAULT INJECTION ACTIVE: %d rule(s) [%s]",
+            len(rules),
+            "; ".join(f"{r.point}:{r.target}:{r.kind}" for r in rules),
+        )
+    return len(rules)
+
+
+def clear() -> None:
+    configure("")
+
+
+def _active_rules() -> List[_Rule]:
+    global _configured
+    with _lock:
+        if not _configured:
+            # lazy env pickup: a server started with GORDO_FAULTS set needs
+            # no code-level configure() call. A malformed env spec logs and
+            # injects nothing — it must not crash request paths.
+            spec = os.environ.get(ENV_VAR, "")
+            try:
+                _rules[:] = parse_spec(spec) if spec else []
+            except ValueError as exc:
+                logger.error("Ignoring malformed %s: %s", ENV_VAR, exc)
+                _rules[:] = []
+            _configured = True
+            if _rules:
+                logger.warning(
+                    "FAULT INJECTION ACTIVE from %s: %d rule(s)",
+                    ENV_VAR,
+                    len(_rules),
+                )
+        return list(_rules)
+
+
+def active() -> bool:
+    return bool(_active_rules())
+
+
+def inject(point: str, target: Optional[str] = None) -> None:
+    """Fire any matching ``latency``/``error`` faults at this boundary.
+    Production call sites sprinkle this at their seams; with no rules
+    configured it is one lock-free-ish list read."""
+    rules = _active_rules()
+    if not rules:
+        return
+    for rule in rules:
+        if not rule.matches(point, target):
+            continue
+        if rule.kind == "latency":
+            seconds = float(rule.param or "0.05")
+            _M_INJECTED.labels(point, "latency").inc()
+            time.sleep(seconds)
+        elif rule.kind == "error":
+            _M_INJECTED.labels(point, "error").inc()
+            raise FaultInjected(
+                rule.param
+                or f"injected fault at {point} (target {target!r})"
+            )
+
+
+def corrupt(point: str, target: Optional[str], payload: Any) -> Any:
+    """Apply any matching ``corrupt`` fault: NaN-poison a float array
+    payload (first column) and return it; non-array payloads pass
+    through untouched. Callers route their payload through this at the
+    boundary: ``X = faults.corrupt("engine-dispatch", name, X)``."""
+    rules = _active_rules()
+    if not rules:
+        return payload
+    for rule in rules:
+        if rule.kind == "corrupt" and rule.matches(point, target):
+            try:
+                import numpy as np
+
+                poisoned = np.array(payload, dtype=np.float32, copy=True)
+                poisoned[..., 0] = np.nan
+            except (TypeError, ValueError, IndexError):
+                return payload
+            _M_INJECTED.labels(point, "corrupt").inc()
+            return poisoned
+    return payload

@@ -52,6 +52,7 @@ from ..models.transformers import MinMaxScaler, StandardScaler
 from ..observability.registry import REGISTRY
 from ..ops import windowing
 from ..ops.scaling import ScalerParams
+from ..resilience import deadline, faults
 
 logger = logging.getLogger(__name__)
 
@@ -864,11 +865,22 @@ class ServingEngine:
         (overlap = the windowing offset, so chunked and unchunked results
         are identical) — backfills never compile outsized programs."""
         bucket, idx = self._by_name[name]
+        # resilience seams, both no-ops in the common case: expired work
+        # must not queue behind the bucket's leader latch (the 504 path),
+        # and the chaos harness injects latency/error/corruption HERE —
+        # the boundary a real device hang or memory corruption would hit
+        deadline.check("engine.dispatch")
+        faults.inject("engine-dispatch", name)
+        X = faults.corrupt("engine-dispatch", name, X)
         X = np.asarray(getattr(X, "values", X), np.float32)
         if X.ndim == 1:
             X = X[None, :]
         cap = self.max_rows_dispatch
         if X.shape[0] <= cap:
+            # re-check after the seams: a pre-dispatch stall (injected
+            # latency, or a real one) must surface as 504, not as an
+            # answer delivered after the caller gave up
+            deadline.check("engine.dispatch")
             x_padded, m_valid = self._prepare(bucket, X)
             return bucket.submit(idx, x_padded, m_valid)
 
@@ -886,6 +898,10 @@ class ServingEngine:
         start = 0
         n = X.shape[0]
         while start < n:
+            # long backfills re-check between chunks: a deadline that
+            # expires mid-request stops after the current dispatch instead
+            # of burning the device for the remaining chunks
+            deadline.check("engine.dispatch_chunk")
             chunk = X[start : start + cap]
             if len(chunk) <= offset:  # fully covered by the previous chunk
                 break

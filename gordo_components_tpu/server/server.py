@@ -29,12 +29,23 @@ registry. Each request adopts (or mints) an ``X-Gordo-Trace-Id``, echoes
 it in the response, and binds it to the handler's context so every log
 record emitted while serving the request — including engine dispatch
 logs — carries the same id (SURVEY.md §6.5, grown into a real layer).
+
+Resilience: serving a whole fleet from one process means one slow or
+corrupt machine could take down every machine at once — so requests carry
+deadlines (``X-Gordo-Deadline`` → 504 before the engine queues expired
+work), a bounded admission gate sheds overload with 503 + ``Retry-After``
+instead of convoying werkzeug threads, broken machines are QUARANTINED
+per-machine (503 + probe-based recovery) while the fleet keeps serving,
+and ``/healthz`` is tri-state (live/ready/degraded) naming the sick
+machines. See ``resilience/`` and ARCHITECTURE.md §8.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import math
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Union
@@ -47,6 +58,10 @@ from werkzeug.wrappers import Request, Response
 from ..models.anomaly.base import AnomalyDetectorBase
 from ..observability import exposition, tracing
 from ..observability.registry import REGISTRY
+from ..resilience import deadline, faults
+from ..resilience.admission import AdmissionController, AdmissionRejected
+from ..resilience.deadline import DeadlineExceeded
+from ..resilience.quarantine import Quarantine
 from ..serializer import dumps as serializer_dumps
 from ..serializer import load, load_metadata
 from .engine import ScoreResult, ServingEngine
@@ -107,6 +122,9 @@ def _latency_view() -> Dict[str, Any]:
 
 class _Machine:
     def __init__(self, name: str, model_dir: str):
+        # chaos seam: a `model-load:<name>:error` fault stands in for a
+        # corrupt artifact dir without having to corrupt one on disk
+        faults.inject("model-load", name)
         self.name = name
         self.model_dir = model_dir
         # mtime FIRST: if a rebuild lands between this stat and load(),
@@ -173,11 +191,20 @@ def _artifact_mtime(model_dir: str) -> float:
 
 class _ServerState:
     """Everything a request needs, swapped as ONE reference on reload so a
-    handler never sees machines and engine from different generations."""
+    handler never sees machines and engine from different generations.
 
-    __slots__ = ("machines", "single", "engine")
+    Each request ``enter()``s the generation it snapshot and ``exit()``s
+    when done; ``drain()`` lets a reload wait for the old generation's
+    in-flight requests to finish BEFORE dropped machines (and their
+    device-resident params) are released — without it, a reload racing a
+    long request could free the very stacked tree that request is
+    scoring against."""
+
+    __slots__ = ("machines", "single", "engine", "_inflight", "_cond")
 
     def __init__(self, machines: Dict[str, _Machine], shard_fleet: bool = False):
+        self._inflight = 0
+        self._cond = threading.Condition()
         self.machines = machines
         self.single = (
             next(iter(machines.values())) if len(machines) == 1 else None
@@ -202,6 +229,28 @@ class _ServerState:
             mesh=mesh,
         )
 
+    def enter(self) -> None:
+        with self._cond:
+            self._inflight += 1
+
+    def exit(self) -> None:
+        with self._cond:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._cond.notify_all()
+
+    def drain(self, timeout: float) -> bool:
+        """Wait until every request that entered this generation has
+        exited (True), or ``timeout`` elapsed first (False)."""
+        end = time.monotonic() + timeout
+        with self._cond:
+            while self._inflight > 0:
+                left = end - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=left)
+        return True
+
 
 class ModelServer:
     """WSGI app serving one or many built model dirs.
@@ -216,21 +265,63 @@ class ModelServer:
         project: str = "project",
         models_root: Optional[str] = None,
         shard_fleet: bool = False,
+        max_inflight: Optional[int] = None,
+        quarantine_cooldown: float = 30.0,
+        drain_timeout: float = 10.0,
     ):
         """``models_root``: optional directory whose immediate subdirs are
         model dirs; enables ``POST /reload`` so machines built AFTER server
         start (a fleet build appending to the same tree) become servable
         without a restart. ``shard_fleet``: shard every bucket's stacked
-        params over all local devices (HBM capacity mode)."""
+        params over all local devices (HBM capacity mode).
+
+        ``max_inflight``: admission-gate bound on concurrently-scoring
+        requests (default ``GORDO_MAX_INFLIGHT`` env or 64; see
+        resilience.admission). ``quarantine_cooldown``: seconds a
+        hard-failed machine waits before a recovery probe is allowed.
+        ``drain_timeout``: how long a reload waits for the old
+        generation's in-flight requests before releasing dropped models.
+        """
         self.shard_fleet = shard_fleet
+        if max_inflight is None:
+            max_inflight = int(os.environ.get("GORDO_MAX_INFLIGHT", "64"))
+        self.admission = AdmissionController(
+            max_inflight=max_inflight,
+            max_queue=int(os.environ.get("GORDO_MAX_QUEUE", "32")),
+            queue_timeout=float(os.environ.get("GORDO_QUEUE_TIMEOUT", "1.0")),
+            retry_after=1.0,
+        )
+        self.quarantine = Quarantine(cooldown=quarantine_cooldown)
+        self.drain_timeout = drain_timeout
+        # machines that failed to LOAD, by name -> dir: quarantined (not
+        # served), retried on every /reload — the fleet analogue of the
+        # reference's crash-looping pod that heals when its artifact is
+        # rebuilt
+        self._quarantined_dirs: Dict[str, str] = {}
         if isinstance(model_dirs, str):
+            # single-model mode: nothing to degrade to — a broken dir is
+            # a startup error, exactly as before
             machine = _Machine("default", model_dirs)
             machine.name = machine.metadata.get("name", "default")
             machines = {machine.name: machine}
         else:
-            machines = {
-                name: _Machine(name, path) for name, path in model_dirs.items()
-            }
+            machines = {}
+            for name, path in model_dirs.items():
+                try:
+                    machines[name] = _Machine(name, path)
+                except Exception as exc:
+                    # one corrupt artifact must not keep the whole fleet
+                    # from serving: quarantine it, serve the rest
+                    logger.exception("Failed to load machine %r", name)
+                    self.quarantine.quarantine(
+                        name, f"{type(exc).__name__}: {exc}", "load"
+                    )
+                    self._quarantined_dirs[name] = path
+            if not machines:
+                raise ValueError(
+                    "No machine loaded successfully; quarantined: "
+                    f"{sorted(self._quarantined_dirs)}"
+                )
         self.project = project
         self.models_root = models_root
         # explicitly-registered machines survive every rescan, whatever
@@ -306,9 +397,39 @@ class ModelServer:
                         machines[name] = current
                 except Exception as exc:  # half-written or corrupt dir:
                     # keep the old generation if we have one, else skip
+                    # AND quarantine — the machine exists but can't serve,
+                    # which /healthz must say out loud
                     errors[name] = f"{type(exc).__name__}: {exc}"
                     if current is not None:
                         machines[name] = current
+                    else:
+                        self.quarantine.quarantine(name, errors[name], "load")
+                        self._quarantined_dirs.setdefault(name, path)
+            # retry load-quarantined machines living OUTSIDE models_root
+            # (explicitly-registered dirs the scan can't see); in-root
+            # dirs were already attempted by the scan above — retrying
+            # them here would pay the load cost twice per reload
+            for name, path in list(self._quarantined_dirs.items()):
+                if name in machines or name in seen:
+                    continue
+                if not os.path.isdir(path):
+                    # dir deleted = machine decommissioned: drop it the way
+                    # a healthy vanished machine is dropped, else /healthz
+                    # would report it degraded forever
+                    self._quarantined_dirs.pop(name, None)
+                    self.quarantine.recover(name)
+                    continue
+                try:
+                    machines[name] = _Machine(name, path)
+                    added.append(name)
+                except Exception as exc:
+                    errors[name] = f"{type(exc).__name__}: {exc}"
+                    self.quarantine.quarantine(name, errors[name], "load")
+            # a machine that (re)loaded in THIS generation is healthy by
+            # construction: lift its quarantine and forget the failed dir
+            for name in added + refreshed:
+                self._quarantined_dirs.pop(name, None)
+                self.quarantine.recover(name)
             removed = sorted(set(state.machines) - set(machines))
             if added or removed or refreshed:
                 new_state = _ServerState(machines, shard_fleet=self.shard_fleet)
@@ -317,6 +438,15 @@ class ModelServer:
                 # ever races the compile (the reload POST waits instead)
                 self._warm_engine(new_state)
                 self._state = new_state
+                # drain the OLD generation before returning: dropped
+                # machines' device-resident params must not be released
+                # while a request is still scoring against them
+                if not state.drain(self.drain_timeout):
+                    logger.warning(
+                        "Reload: old generation still has in-flight "
+                        "requests after %.1fs drain; releasing anyway",
+                        self.drain_timeout,
+                    )
                 logger.info(
                     "Reload: +%d / -%d / refreshed %d -> %d machine(s)%s",
                     len(added),
@@ -349,6 +479,16 @@ class ModelServer:
         # through the engine carries it, and echoed in the response
         trace_id = request.headers.get(tracing.TRACE_HEADER) or tracing.new_trace_id()
         token = tracing.set_trace_id(trace_id)
+        # the client's remaining patience rides the X-Gordo-Deadline header
+        # (seconds); bound to this handler's context so every expensive
+        # boundary below (admission queue, engine dispatch, data fetch)
+        # can refuse work nobody is waiting for anymore
+        budget = deadline.parse_header(
+            request.headers.get(deadline.DEADLINE_HEADER)
+        )
+        deadline_token = (
+            deadline.set_deadline(budget) if budget is not None else None
+        )
         adapter = _URL_MAP.bind_to_environ(environ)
         # ONE state snapshot per request: machines and engine must come from
         # the same generation even if a reload swaps mid-request
@@ -357,6 +497,17 @@ class ModelServer:
             try:
                 endpoint, args = adapter.match()
                 response = self._dispatch(request, endpoint, args, state)
+            except AdmissionRejected as exc:
+                # load shed: tell the client WHEN to come back, not just no
+                response = _json({"error": f"overloaded: {exc}"}, status=503)
+                response.headers["Retry-After"] = _retry_after(exc.retry_after)
+            except DeadlineExceeded as exc:
+                # Retry-After 1: the work itself is fine — the caller just
+                # needs to come back with a fresh (or larger) budget
+                response = _json(
+                    {"error": str(exc)}, status=504,
+                    headers={"Retry-After": _retry_after(1.0)},
+                )
             except HTTPException as exc:
                 if exc.response is not None:
                     response = exc.response
@@ -386,6 +537,8 @@ class ModelServer:
                 trace_id,
             )
         finally:
+            if deadline_token is not None:
+                deadline.reset(deadline_token)
             tracing.reset_trace_id(token)
         return response(environ, start_response)
 
@@ -403,16 +556,65 @@ class ModelServer:
         try:
             return state.machines[name]
         except KeyError:
+            if self.quarantine.is_quarantined(name):
+                # the machine EXISTS but failed to load: 503 (try later),
+                # not 404 (never heard of it) — a watchman probing this
+                # path must see a sick machine, not a vanished one
+                self._abort_quarantined(name)
             raise NotFound(f"Unknown machine {name!r}") from None
+
+    def _abort_quarantined(self, name: str) -> None:
+        _abort(
+            503,
+            f"Machine {name!r} is quarantined: "
+            f"{self.quarantine.last_error(name)}",
+            headers={
+                "Retry-After": _retry_after(self.quarantine.retry_after(name))
+            },
+        )
 
     def _dispatch(
         self, request: Request, endpoint: str, args, state: _ServerState
     ) -> Response:
         if endpoint == "healthz":
             if args.get("machine") is not None:
-                # machine-scoped health: 404 if absent
+                # machine-scoped health: 404 if absent, 503 if quarantined
+                name = args["machine"]
+                if self.quarantine.is_quarantined(name):
+                    return _json(
+                        {
+                            "ok": False,
+                            "status": "quarantined",
+                            "error": self.quarantine.last_error(name),
+                        },
+                        status=503,
+                        headers={
+                            "Retry-After": _retry_after(
+                                self.quarantine.retry_after(name)
+                            )
+                        },
+                    )
                 self._machine_for(args, state)
-            return _json({"ok": True})
+                return _json({"ok": True, "status": "ok"})
+            # fleet health is TRI-STATE: live (process answers), ready (at
+            # least one machine servable), degraded (quarantined or
+            # suspect machines named below) — k8s probes read live/ready,
+            # operators read WHO is sick and why
+            quarantined = self.quarantine.quarantined()
+            suspects = self.quarantine.suspects()
+            ready = len(state.machines) > 0
+            degraded = bool(quarantined or suspects)
+            return _json(
+                {
+                    "ok": ready and not degraded,
+                    "status": "degraded" if degraded else "ok",
+                    "live": True,
+                    "ready": ready,
+                    "quarantined": quarantined,
+                    "suspect": suspects,
+                },
+                status=200 if ready else 503,
+            )
         if endpoint == "metrics":
             if request.args.get("format") == "prometheus":
                 return Response(
@@ -423,6 +625,14 @@ class ModelServer:
                 {
                     "latency": _latency_view(),
                     "engine": state.engine.stats(),
+                    # gate occupancy + who is sick, for operators reading
+                    # the JSON view (the prometheus twin carries the same
+                    # as gordo_resilience_* series)
+                    "resilience": {
+                        "admission": self.admission.stats(),
+                        "quarantined": self.quarantine.quarantined(),
+                        "suspect": self.quarantine.suspects(),
+                    },
                     # the full registry (engine, client, build series too):
                     # the JSON twin of ?format=prometheus
                     "registry": REGISTRY.snapshot(),
@@ -445,11 +655,59 @@ class ModelServer:
                 serializer_dumps(machine.model),
                 mimetype="application/octet-stream",
             )
-        if endpoint == "prediction":
-            return self._predict(request, machine, state)
-        if endpoint == "anomaly":
-            return self._anomaly(request, machine, state)
+        if endpoint in ("prediction", "anomaly"):
+            # pin THIS generation while scoring: a concurrent reload
+            # drains these before releasing dropped machines' params
+            state.enter()
+            try:
+                return self._score_endpoint(request, endpoint, machine, state)
+            finally:
+                state.exit()
         raise NotFound(endpoint)
+
+    def _score_endpoint(
+        self, request: Request, endpoint: str, machine: _Machine,
+        state: _ServerState,
+    ) -> Response:
+        """Common resilience wrapper for the scoring endpoints: quarantine
+        gate (with probe-based recovery), then the bounded admission gate,
+        then the handler. Success clears the machine's health marks."""
+        name = machine.name
+        probing = False
+        if self.quarantine.is_quarantined(name):
+            if not self.quarantine.probe_allowed(name):
+                self._abort_quarantined(name)
+            # cooldown elapsed: this request is the recovery probe
+            probing = True
+            logger.info("Quarantine recovery probe for machine %r", name)
+        try:
+            with self.admission.admit():
+                if endpoint == "prediction":
+                    response = self._predict(request, machine, state)
+                else:
+                    response = self._anomaly(request, machine, state)
+        except (AdmissionRejected, DeadlineExceeded):
+            if probing:  # the model was never exercised: don't burn the
+                # one-per-cooldown probe on a shed or an expired caller
+                self.quarantine.release_probe(name)
+            raise
+        except HTTPException as exc:
+            if (
+                probing
+                and exc.response is not None
+                and exc.response.status_code < 500
+            ):
+                # client error (bad payload, 400): proves nothing about the
+                # machine either way — leave the probe window open so a
+                # well-formed request can still recover it immediately
+                self.quarantine.release_probe(name)
+            raise
+        if probing:
+            self.quarantine.recover(name)
+            logger.info("Machine %r recovered from quarantine", name)
+        else:
+            self.quarantine.clear_suspect(name)
+        return response
 
     # -- payload handling ----------------------------------------------------
     _PARQUET_TYPES = (
@@ -534,14 +792,16 @@ class ModelServer:
         self, request: Request, machine: _Machine, state: _ServerState
     ) -> Response:
         X, _ = self._parse_X(request, machine)
-        try:
+        self._validate_X(X, machine)
+
+        def run():
             with tracing.span("server.predict"):
                 if state.engine.can_score(machine.name):
-                    output = state.engine.predict(machine.name, X)
-                else:
-                    output = machine.model.predict(X)
-        except ValueError as exc:
-            _abort(400, f"Prediction failed: {exc}")
+                    return state.engine.predict(machine.name, X)
+                deadline.check("server.predict")
+                return machine.model.predict(X)
+
+        output = self._guarded(machine, run, "Prediction failed")
         return _json(
             {
                 "data": {
@@ -567,20 +827,14 @@ class ModelServer:
         if start or end:
             X_frame = self._fetch_range(machine, start, end)
             timestamps_all = [ts.isoformat() for ts in X_frame.index]
-            try:
-                scored = self._score(machine, X_frame, state)
-            except ValueError as exc:  # permanently-bad range (e.g. too few
-                # rows for the lookback window) must be 4xx, not a retryable 500
-                _abort(400, f"Anomaly scoring failed: {exc}")
+            scored = self._score_guarded(machine, X_frame, state)
             timestamps = timestamps_all[
                 len(timestamps_all) - len(scored.total_anomaly_score) :
             ]
         else:
             X, timestamps_all = self._parse_X(request, machine)
-            try:
-                scored = self._score(machine, X, state)
-            except ValueError as exc:
-                _abort(400, f"Anomaly scoring failed: {exc}")
+            self._validate_X(X, machine)
+            scored = self._score_guarded(machine, X, state)
             if timestamps_all is not None:  # parquet DatetimeIndex
                 timestamps = timestamps_all[
                     len(timestamps_all) - len(scored.total_anomaly_score) :
@@ -601,12 +855,79 @@ class ModelServer:
             }
         return _json({"data": data, **thresholds})
 
+    def _score_guarded(self, machine: _Machine, X, state: _ServerState):
+        return self._guarded(
+            machine,
+            lambda: self._score(machine, X, state),
+            "Anomaly scoring failed",
+        )
+
+    def _guarded(self, machine: _Machine, fn, error_prefix: str):
+        """ONE failure taxonomy for every scoring callable: bad input →
+        400 (permanently-bad, e.g. too few rows for the lookback window —
+        must be 4xx, not a retryable 500), expired deadline → 504 with the
+        machine marked suspect, anything else → quarantine the machine and
+        503 — never a bare 500 from inside a jitted program."""
+        try:
+            return fn()
+        except ValueError as exc:
+            _abort(400, f"{error_prefix}: {exc}")
+        except DeadlineExceeded:
+            # repeatedly missing its deadline makes a machine SUSPECT
+            # (healthz names it) without refusing its future requests
+            self.quarantine.mark_suspect(
+                machine.name, "deadline expired at dispatch"
+            )
+            raise
+        except HTTPException:
+            raise
+        except Exception as exc:
+            self._quarantine_scoring_failure(machine, exc)
+
+    def _quarantine_scoring_failure(self, machine: _Machine, exc: Exception):
+        """An unexpected scoring exception (not a client error): isolate
+        THIS machine — the rest of the fleet keeps serving — and answer
+        503 with the recovery-probe horizon."""
+        logger.exception("Scoring failed for machine %r; quarantining",
+                         machine.name)
+        self.quarantine.quarantine(
+            machine.name, f"{type(exc).__name__}: {exc}", "score"
+        )
+        self._abort_quarantined(machine.name)
+
+    @staticmethod
+    def _validate_X(arr: np.ndarray, machine: _Machine) -> None:
+        """Pre-dispatch payload validation: wrong width and non-finite
+        values answer a STRUCTURED 400 naming the offending columns —
+        never a 500 (or NaN scores) from inside a jitted program."""
+        tags = machine.tag_list
+        if tags and arr.shape[1] != len(tags):
+            _abort(
+                400,
+                f"Machine {machine.name!r} expects {len(tags)} features, "
+                f"got {arr.shape[1]}",
+                expected_features=len(tags),
+                got_features=int(arr.shape[1]),
+            )
+        finite = np.isfinite(arr)
+        if not finite.all():
+            bad = sorted(int(c) for c in np.unique(np.where(~finite)[1]))
+            _abort(
+                400,
+                "Payload contains non-finite (NaN/Inf) values in "
+                f"column(s) {bad}",
+                non_finite_columns=bad,
+            )
+
     def _score(self, machine: _Machine, X, state: _ServerState):
         """Anomaly arrays via the stacked TPU engine when the machine is
         lifted into it, else the host path (``model.anomaly``)."""
         if state.engine.can_score(machine.name):
             with tracing.span("server.anomaly"):
                 return state.engine.anomaly(machine.name, X)
+        # host path: the engine's own pre-dispatch deadline check doesn't
+        # cover these machines, so gate here before the slow scoring
+        deadline.check("server.anomaly_host")
         cols = machine.target_columns
         if cols is None:
             frame = machine.model.anomaly(X)
@@ -623,8 +944,12 @@ class ModelServer:
 
     def _fetch_range(self, machine: _Machine, start, end):
         """?start&end server-side fetch: rebuild the dataset from the config
-        embedded in build metadata with overridden dates."""
+        embedded in build metadata with overridden dates. Deadline-checked
+        BEFORE the provider round-trip: a lake read for an expired request
+        is pure waste."""
         from ..dataset import GordoBaseDataset
+
+        deadline.check("server.data_fetch")
 
         config = machine.metadata.get("dataset", {}).get("dataset_config")
         if not config:
@@ -639,6 +964,7 @@ class ModelServer:
         config["train_start_date"] = start
         config["train_end_date"] = end
         try:
+            faults.inject("data-fetch", machine.name)  # chaos: dead lake
             dataset = GordoBaseDataset.from_dict(config)
             X, _ = dataset.get_data()
         except Exception as exc:  # provider/parse errors → client error
@@ -646,18 +972,39 @@ class ModelServer:
         return X
 
 
-def _json(payload: Dict[str, Any], status: int = 200) -> Response:
-    return Response(
+def _json(
+    payload: Dict[str, Any],
+    status: int = 200,
+    headers: Optional[Dict[str, str]] = None,
+) -> Response:
+    response = Response(
         json.dumps(payload, default=str),
         status=status,
         mimetype="application/json",
     )
+    for key, value in (headers or {}).items():
+        response.headers[key] = value
+    return response
 
 
-def _abort(code: int, message: str) -> None:
+def _retry_after(seconds: float) -> str:
+    """HTTP ``Retry-After`` wants integer seconds; never advertise 0 (a
+    zero invites an instant retry storm)."""
+    return str(max(1, int(math.ceil(seconds))))
+
+
+def _abort(
+    code: int,
+    message: str,
+    headers: Optional[Dict[str, str]] = None,
+    **extra: Any,
+) -> None:
+    """Raise an HTTP error with a JSON body; ``extra`` fields ride along
+    (structured 400s name offending columns, 503s carry quarantine
+    context) so clients can react programmatically, not by parsing prose."""
     raise HTTPException(
-        response=Response(
-            json.dumps({"error": message}), status=code, mimetype="application/json"
+        response=_json(
+            {"error": message, **extra}, status=code, headers=headers
         )
     )
 
@@ -667,11 +1014,14 @@ def build_app(
     project: str = "project",
     models_root: Optional[str] = None,
     shard_fleet: bool = False,
+    max_inflight: Optional[int] = None,
+    quarantine_cooldown: float = 30.0,
 ) -> ModelServer:
     """App factory (reference: ``server.build_app``)."""
     return ModelServer(
         model_dirs, project=project, models_root=models_root,
-        shard_fleet=shard_fleet,
+        shard_fleet=shard_fleet, max_inflight=max_inflight,
+        quarantine_cooldown=quarantine_cooldown,
     )
 
 
@@ -683,6 +1033,7 @@ def run_server(
     models_root: Optional[str] = None,
     shard_fleet: bool = False,
     trace_dir: Optional[str] = None,
+    max_inflight: Optional[int] = None,
 ) -> None:
     """Serve with werkzeug's multithreaded server.
 
@@ -707,7 +1058,7 @@ def run_server(
 
     app = build_app(
         model_dirs, project=project, models_root=models_root,
-        shard_fleet=shard_fleet,
+        shard_fleet=shard_fleet, max_inflight=max_inflight,
     )
     # compile each bucket's scoring program BEFORE accepting traffic: the
     # first request must pay dispatch (ms), not XLA compile (tens of s).

@@ -16,6 +16,13 @@ dying machine, not a healthy one) and counted into the process registry.
 ``GET /metrics`` scrapes each distinct model-server base URL's own
 ``/metrics`` JSON and aggregates the engine counters into ONE fleet-wide
 view — the scrape-of-scrapes the reference's watchman never had.
+
+Resilience: each target's probe runs behind a circuit breaker — an
+UNREACHABLE endpoint (connect/read timeout, not an HTTP error answer)
+trips its circuit after a few failures, and until the recovery window
+elapses its probes short-circuit in microseconds. Without this, a
+1000-machine fleet with a handful of dead hosts pays ``n_dead × timeout``
+per ``GET /`` even with the thread pool absorbing most of it.
 """
 
 from __future__ import annotations
@@ -31,12 +38,15 @@ from werkzeug.wrappers import Request, Response
 
 from ..observability import exposition
 from ..observability.registry import REGISTRY
+from ..resilience import faults
+from ..resilience.breaker import BreakerBoard
 
 logger = logging.getLogger(__name__)
 
 _M_PROBES = REGISTRY.counter(
     "gordo_watchman_probes_total",
-    "Health probes issued, by outcome (healthy / unhealthy / unreachable)",
+    "Health probes issued, by outcome (healthy / unhealthy / unreachable "
+    "/ short_circuit)",
     labels=("outcome",),
 )
 _M_PROBE_SECONDS = REGISTRY.histogram(
@@ -54,6 +64,8 @@ class WatchmanServer:
         timeout: float = 5.0,
         max_poll_workers: int = 32,
         manifest_path: Optional[str] = None,
+        breaker_recovery: float = 30.0,
+        breaker_clock=time.monotonic,
     ):
         """``machines``: list of names served at ``target_url``, or an
         explicit ``{machine: base_url}`` map. Health polls fan out over a
@@ -66,7 +78,11 @@ class WatchmanServer:
         counts and the pending names) read from the manifest — the
         reference's later watchman evolution replaced HTTP polling with
         k8s CRD status; the manifest is this rebuild's equivalent build
-        source of truth (rewritten atomically after every slice)."""
+        source of truth (rewritten atomically after every slice).
+
+        ``breaker_recovery``: seconds a tripped target's circuit stays
+        open before one probe tests it again (``breaker_clock`` is
+        injectable so state-machine tests advance time, not sleep)."""
         if isinstance(machines, dict):
             self.machine_urls = dict(machines)
         else:
@@ -85,6 +101,19 @@ class WatchmanServer:
         # between GETs)
         self._last_errors: Dict[str, str] = {}
         self._errors_lock = threading.Lock()
+        # one circuit per HOST (base URL), shared by every machine probed
+        # there: unreachability is a host property, so a dead host is
+        # contained after min_calls timeouts TOTAL, not min_calls × N
+        # machines. Only unreachability trips it — an endpoint that
+        # ANSWERS (even 503) keeps its circuit closed.
+        self._breakers = BreakerBoard(
+            recovery_time=breaker_recovery, clock=breaker_clock
+        )
+
+    def _note_error(self, machine: str, error: str) -> None:
+        stamped = f"{time.strftime('%Y-%m-%d %H:%M:%S%z')} {error}"
+        with self._errors_lock:
+            self._last_errors[machine] = stamped
 
     def _check(self, machine: str, base_url: str) -> Dict:
         import requests
@@ -92,25 +121,48 @@ class WatchmanServer:
         url = (
             f"{base_url.rstrip('/')}/gordo/v0/{self.project}/{machine}/healthz"
         )
+        breaker = self._breakers.get(base_url.rstrip("/"))
+        if not breaker.allow():
+            # open circuit: the target was unreachable recently — answer
+            # from state in microseconds instead of burning another timeout
+            _M_PROBES.labels("short_circuit").inc()
+            with self._errors_lock:
+                last_error = self._last_errors.get(machine)
+            return {
+                "endpoint": url,
+                "target": machine,
+                "healthy": False,
+                "latency_ms": 0.0,
+                "error": (
+                    f"circuit open (unreachable; next probe in "
+                    f"{breaker.retry_after():.0f}s)"
+                ),
+                "last_error": last_error or "",
+                "circuit": breaker.state,
+            }
         started = time.perf_counter()
         error: Optional[str] = None
+        reachable = True
         try:
+            # chaos seam: a `probe:<machine>:error` fault stands in for a
+            # dead endpoint without anything actually dying
+            faults.inject("probe", machine)
             response = requests.get(url, timeout=self.timeout)
             healthy = response.status_code == 200
             if not healthy:
                 error = f"HTTP {response.status_code}"
             _M_PROBES.labels("healthy" if healthy else "unhealthy").inc()
-        except requests.RequestException as exc:
+        except (requests.RequestException, faults.FaultInjected) as exc:
             logger.warning("Watchman: %s unreachable: %r", machine, exc)
             healthy = False
+            reachable = False
             error = repr(exc)
             _M_PROBES.labels("unreachable").inc()
+        breaker.record(reachable)
         probe_s = time.perf_counter() - started
         _M_PROBE_SECONDS.observe(probe_s)
         if error is not None:
-            stamped = f"{time.strftime('%Y-%m-%d %H:%M:%S%z')} {error}"
-            with self._errors_lock:
-                self._last_errors[machine] = stamped
+            self._note_error(machine, error)
         with self._errors_lock:
             last_error = self._last_errors.get(machine)
         return {
@@ -123,6 +175,7 @@ class WatchmanServer:
             # machine is distinguishable from a healthy one at a glance
             "error": error or "",
             "last_error": last_error or "",
+            "circuit": breaker.state,
         }
 
     def _build_progress(self) -> Optional[Dict]:
@@ -141,6 +194,13 @@ class WatchmanServer:
             "project-name": self.project,
             "ok": all(e["healthy"] for e in endpoints),
             "endpoints": endpoints,
+            # non-closed circuits only: the interesting subset at a glance
+            # (every endpoint entry carries its own "circuit" field too)
+            "open-circuits": {
+                name: state
+                for name, state in self._breakers.states().items()
+                if state != "closed"
+            },
         }
         build = self._build_progress()
         if build is not None:

@@ -917,8 +917,12 @@ def test_prepare_slice_fetches_machines_concurrently():
     """One slice's per-machine provider reads run concurrently (the
     reference's pod-per-machine fan-out gave it this for free): 4 fake
     datasets each sleeping 0.2s must fetch in well under the 0.8s serial
-    sum, land in item order, and propagate a provider exception verbatim."""
+    sum and land in item order. A provider exception no longer kills the
+    slice: the failing machine is ISOLATED (zero-weight padding +
+    build_error) while its neighbors' data lands intact (the resilience
+    layer's per-machine failure-containment contract)."""
     import time as _time
+    from types import SimpleNamespace
 
     from gordo_components_tpu.parallel.build_fleet import _prepare_slice
 
@@ -934,7 +938,10 @@ def test_prepare_slice_fetches_machines_concurrently():
         def get_metadata(self):
             return {"v": self.value}
 
-    items = [{"dataset": SlowDataset(float(i))} for i in range(4)]
+    def _item(dataset, name):
+        return {"dataset": dataset, "machine": SimpleNamespace(name=name)}
+
+    items = [_item(SlowDataset(float(i)), f"c-{i}") for i in range(4)]
     started = _time.perf_counter()
     X, y, w, n_rows, fetch_s = _prepare_slice(items, 4, 3, 3, False)
     wall = _time.perf_counter() - started
@@ -947,8 +954,11 @@ def test_prepare_slice_fetches_machines_concurrently():
         def get_data(self):
             raise RuntimeError("lake exploded")
 
-    with pytest.raises(RuntimeError, match="lake exploded"):
-        _prepare_slice(
-            [{"dataset": SlowDataset(0.0)}, {"dataset": BoomDataset(1.0)}],
-            2, 3, 3, False,
-        )
+    items = [_item(SlowDataset(7.0), "ok-m"), _item(BoomDataset(1.0), "boom-m")]
+    X, y, w, n_rows, _ = _prepare_slice(
+        items, 2, 3, 3, False, None, None, 0,  # fetch_retries=0: no backoff
+    )
+    assert "build_error" not in items[0]
+    assert "lake exploded" in items[1]["build_error"]
+    assert np.all(np.asarray(X)[0, -8:] == 7.0)
+    assert np.all(np.asarray(w)[1] == 0.0)  # isolated = zero-weight padding

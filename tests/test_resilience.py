@@ -1,0 +1,939 @@
+"""Resilience layer: unit tests for the five primitives plus chaos-driven
+end-to-end scenarios (ISSUE 2): quarantine-and-recover, deadline-expired
+504, admission-shed 503, breaker open→half-open→closed on watchman probes
+— all driven through ``resilience.faults``, no sleeps > 0.1s (breaker and
+quarantine clocks are injected, never slept on)."""
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from gordo_components_tpu.builder import provide_saved_model
+from gordo_components_tpu.resilience import deadline, faults
+from gordo_components_tpu.resilience.admission import (
+    AdmissionController,
+    AdmissionRejected,
+)
+from gordo_components_tpu.resilience.breaker import (
+    BreakerBoard,
+    CircuitBreaker,
+    CircuitOpen,
+)
+from gordo_components_tpu.resilience.quarantine import Quarantine
+from gordo_components_tpu.server import build_app
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-04T00:00:00+00:00",
+    "tag_list": ["tag-a", "tag-b", "tag-c"],
+}
+
+PLAIN_MODEL = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric", "dims": [6],
+                                  "epochs": 1, "batch_size": 32}},
+        ]
+    }
+}
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Fault rules are process-global: every test starts and ends clean."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_trips_on_failure_ratio():
+    clock = FakeClock()
+    breaker = CircuitBreaker("t", min_calls=3, failure_ratio=0.5,
+                             recovery_time=30.0, clock=clock)
+    assert breaker.state == "closed"
+    breaker.record(True)
+    breaker.record(False)
+    assert breaker.state == "closed"  # min_calls not reached
+    breaker.record(False)  # 2/3 failed >= 0.5 -> open
+    assert breaker.state == "open"
+    assert not breaker.allow()  # short-circuit while open
+    assert 0.0 < breaker.retry_after() <= 30.0
+
+
+def test_breaker_half_open_probe_closes_on_success():
+    clock = FakeClock()
+    breaker = CircuitBreaker("t", min_calls=2, failure_ratio=0.5,
+                             recovery_time=10.0, clock=clock)
+    breaker.record(False)
+    breaker.record(False)
+    assert breaker.state == "open"
+    clock.advance(10.1)
+    assert breaker.allow()  # recovery elapsed -> half-open probe
+    assert breaker.state == "half_open"
+    assert not breaker.allow()  # exactly ONE probe at a time
+    breaker.record(True)
+    assert breaker.state == "closed"
+    # history cleared: one new failure must not instantly re-trip
+    breaker.record(False)
+    assert breaker.state == "closed"
+
+
+def test_breaker_half_open_probe_reopens_on_failure():
+    clock = FakeClock()
+    breaker = CircuitBreaker("t", min_calls=2, failure_ratio=0.5,
+                             recovery_time=10.0, clock=clock)
+    breaker.record(False)
+    breaker.record(False)
+    clock.advance(10.1)
+    assert breaker.allow()
+    breaker.record(False)  # probe failed -> re-open for another window
+    assert breaker.state == "open"
+    assert not breaker.allow()
+    clock.advance(10.1)
+    assert breaker.allow()  # and the cycle repeats
+
+
+def test_breaker_reclaims_abandoned_half_open_probe():
+    """A probe whose caller died between allow() and record() must not
+    wedge the breaker open forever: after a recovery window of silence
+    the slot is reclaimed by the next caller."""
+    clock = FakeClock()
+    breaker = CircuitBreaker("t", min_calls=2, failure_ratio=0.5,
+                             recovery_time=10.0, clock=clock)
+    breaker.record(False)
+    breaker.record(False)
+    clock.advance(10.1)
+    assert breaker.allow()  # probe claimed ... and its caller vanishes
+    assert not breaker.allow()
+    clock.advance(10.1)
+    assert breaker.allow()  # reclaimed, not wedged
+    breaker.record(True)
+    assert breaker.state == "closed"
+
+
+def test_breaker_guard_raises_circuit_open():
+    clock = FakeClock()
+    breaker = CircuitBreaker("t", min_calls=1, failure_ratio=0.1,
+                             recovery_time=5.0, clock=clock)
+    breaker.record(False)
+    with pytest.raises(CircuitOpen) as err:
+        breaker.guard()
+    assert err.value.retry_after <= 5.0
+
+
+def test_breaker_board_shares_and_lists():
+    board = BreakerBoard(min_calls=1, failure_ratio=0.1)
+    a = board.get("a")
+    assert board.get("a") is a  # same endpoint -> same circuit
+    a.record(False)
+    board.get("b")
+    assert board.states() == {"a": "open", "b": "closed"}
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_admission_admits_and_releases():
+    gate = AdmissionController(max_inflight=2, max_queue=0)
+    with gate.admit():
+        assert gate.inflight == 1
+        with gate.admit():
+            assert gate.inflight == 2
+    assert gate.inflight == 0
+
+
+def test_admission_sheds_when_queue_full():
+    gate = AdmissionController(max_inflight=1, max_queue=0, retry_after=2.0)
+    with gate.admit():
+        with pytest.raises(AdmissionRejected) as err:
+            gate.admit()
+        assert err.value.retry_after == 2.0
+
+
+def test_admission_queue_times_out():
+    gate = AdmissionController(max_inflight=1, max_queue=4, queue_timeout=0.05)
+    with gate.admit():
+        started = time.monotonic()
+        with pytest.raises(AdmissionRejected, match="queued"):
+            gate.admit()
+        assert time.monotonic() - started < 0.5
+
+
+def test_admission_sheds_expired_deadline_waiter():
+    gate = AdmissionController(max_inflight=1, max_queue=4, queue_timeout=5.0)
+    with gate.admit():
+        with deadline.deadline_scope(0.0):  # already expired
+            with pytest.raises(AdmissionRejected, match="deadline"):
+                gate.admit()
+
+
+def test_admission_queued_waiter_gets_freed_slot():
+    gate = AdmissionController(max_inflight=1, max_queue=4, queue_timeout=1.0)
+    slot = gate.admit()
+    got = []
+
+    def waiter():
+        with gate.admit():
+            got.append(True)
+
+    thread = threading.Thread(target=waiter)
+    thread.start()
+    time.sleep(0.05)  # let the waiter queue up
+    assert gate.queue_depth == 1
+    slot.release()
+    thread.join(timeout=2)
+    assert got == [True]
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation
+# ---------------------------------------------------------------------------
+
+def test_deadline_parse_header():
+    assert deadline.parse_header(None) is None
+    assert deadline.parse_header("") is None
+    assert deadline.parse_header("garbage") is None
+    assert deadline.parse_header("2.5") == 2.5
+    assert deadline.parse_header("-3") == 0.0  # already expired, not an error
+    assert deadline.parse_header("1e300") == 86400.0  # capped
+    # nan/inf parse as floats but are garbage — forfeit cover, never bind
+    # an instantly-expired deadline that would 504 every request
+    assert deadline.parse_header("nan") is None
+    assert deadline.parse_header("inf") is None
+    assert deadline.parse_header("-inf") is None
+
+
+def test_deadline_scope_and_check():
+    assert deadline.remaining() is None  # unbound: checks are no-ops
+    deadline.check("anywhere")
+    with deadline.deadline_scope(30.0):
+        left = deadline.remaining()
+        assert left is not None and 29.0 < left <= 30.0
+        deadline.check("ok")
+        assert deadline.header_value() is not None
+    assert deadline.remaining() is None  # scope unwound
+
+
+def test_deadline_expired_check_raises():
+    with deadline.deadline_scope(0.0):
+        assert deadline.expired()
+        with pytest.raises(deadline.DeadlineExceeded, match="boundary-x"):
+            deadline.check("boundary-x")
+
+
+def test_deadline_header_value_propagates_remaining():
+    with deadline.deadline_scope(10.0):
+        value = deadline.header_value()
+        assert 9.0 < float(value) <= 10.0
+    assert deadline.header_value() is None
+
+
+# ---------------------------------------------------------------------------
+# fault injection harness
+# ---------------------------------------------------------------------------
+
+def test_faults_spec_grammar_rejected_loudly():
+    with pytest.raises(ValueError, match="point:target:kind"):
+        faults.parse_spec("engine-dispatch:error")
+    with pytest.raises(ValueError, match="not one of"):
+        faults.parse_spec("engine-dispatch:m:explode")
+    with pytest.raises(ValueError, match="seconds"):
+        faults.parse_spec("engine-dispatch:m:latency:soon")
+
+
+def test_faults_error_and_target_matching():
+    faults.configure("engine-dispatch:mach-1:error:boom")
+    with pytest.raises(faults.FaultInjected, match="boom"):
+        faults.inject("engine-dispatch", "mach-1")
+    faults.inject("engine-dispatch", "mach-2")  # other target: no-op
+    faults.inject("model-load", "mach-1")  # other point: no-op
+    faults.configure("engine-dispatch:*:error")
+    with pytest.raises(faults.FaultInjected):
+        faults.inject("engine-dispatch", "anything")
+    faults.clear()
+    faults.inject("engine-dispatch", "mach-1")  # cleared: no-op
+
+
+def test_faults_latency_sleeps():
+    faults.configure("probe:*:latency:0.05")
+    started = time.monotonic()
+    faults.inject("probe", "m")
+    assert time.monotonic() - started >= 0.04
+
+
+def test_faults_corrupt_nan_poisons_payload():
+    faults.configure("engine-dispatch:m:corrupt")
+    X = np.ones((4, 3), np.float32)
+    poisoned = faults.corrupt("engine-dispatch", "m", X)
+    assert np.isnan(poisoned[:, 0]).all()
+    assert (poisoned[:, 1:] == 1.0).all()
+    assert (X == 1.0).all()  # original untouched (copy semantics)
+    clean = faults.corrupt("engine-dispatch", "other", X)
+    assert (clean == 1.0).all()
+
+
+def test_faults_env_pickup(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "probe:m:error:from-env")
+    monkeypatch.setattr(faults, "_configured", False)
+    with pytest.raises(faults.FaultInjected, match="from-env"):
+        faults.inject("probe", "m")
+    # malformed env spec must not crash request paths — only inject nothing
+    monkeypatch.setenv(faults.ENV_VAR, "not-a-spec")
+    monkeypatch.setattr(faults, "_configured", False)
+    faults.inject("probe", "m")
+
+
+# ---------------------------------------------------------------------------
+# quarantine ledger
+# ---------------------------------------------------------------------------
+
+def test_quarantine_cooldown_and_recovery():
+    clock = FakeClock()
+    ledger = Quarantine(cooldown=30.0, clock=clock)
+    assert not ledger.is_quarantined("m")
+    assert ledger.probe_allowed("m")  # healthy machines are never gated
+    ledger.quarantine("m", "boom", "score")
+    assert ledger.is_quarantined("m")
+    assert not ledger.probe_allowed("m")  # cooldown not elapsed
+    assert 0.0 < ledger.retry_after("m") <= 30.0
+    clock.advance(30.1)
+    assert ledger.probe_allowed("m")  # ONE probe claims the window...
+    assert not ledger.probe_allowed("m")  # ...concurrent requests stay out
+    assert ledger.recover("m")
+    assert not ledger.is_quarantined("m")
+    assert not ledger.recover("m")  # idempotent
+
+
+def test_quarantine_release_probe_reopens_window():
+    clock = FakeClock()
+    ledger = Quarantine(cooldown=30.0, clock=clock)
+    ledger.quarantine("m", "boom", "score")
+    clock.advance(30.1)
+    assert ledger.probe_allowed("m")  # claimed
+    assert not ledger.probe_allowed("m")
+    ledger.release_probe("m")  # the probe never exercised the machine
+    assert ledger.probe_allowed("m")  # immediately available again
+
+
+def test_quarantine_suspect_tier():
+    ledger = Quarantine()
+    ledger.mark_suspect("m", "slow dispatch")
+    assert ledger.degraded()
+    assert "m" in ledger.suspects()
+    ledger.mark_suspect("m", "again")
+    assert ledger.suspects()["m"]["count"] == 2
+    ledger.clear_suspect("m")
+    assert not ledger.degraded()
+    # hard quarantine outranks suspect
+    ledger.quarantine("m", "dead", "load")
+    ledger.mark_suspect("m", "slow")
+    assert "m" not in ledger.suspects()
+    assert ledger.last_error("m") == "dead"
+    assert ledger.quarantined()["m"]["phase"] == "load"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos: server
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("resilience-models")
+    dirs = {}
+    for name in ("mach-a", "mach-b"):
+        dirs[name] = provide_saved_model(
+            name, PLAIN_MODEL, DATA_CONFIG, str(root / name),
+            evaluation_config={"cv_mode": "build_only"},
+        )
+    return dirs
+
+
+@pytest.fixture(scope="module")
+def served(model_dirs):
+    app = build_app(model_dirs, project="proj", quarantine_cooldown=0.05)
+    return app, Client(app)
+
+
+def _post_X(client, machine, X):
+    return client.post(
+        f"/gordo/v0/proj/{machine}/prediction",
+        data=json.dumps({"X": X}),
+        content_type="application/json",
+    )
+
+
+GOOD_X = [[0.1, 0.2, 0.3]] * 3
+
+
+def test_expired_deadline_504_and_suspect(served):
+    app, client = served
+    response = client.post(
+        "/gordo/v0/proj/mach-a/prediction",
+        data=json.dumps({"X": GOOD_X}),
+        content_type="application/json",
+        headers={deadline.DEADLINE_HEADER: "0"},
+    )
+    assert response.status_code == 504
+    assert "deadline" in response.get_json()["error"]
+    # a machine that misses its deadline is SUSPECT (named, still serving)
+    health = client.get("/healthz").get_json()
+    assert health["status"] == "degraded"
+    assert "mach-a" in health["suspect"]
+    assert health["live"] is True and health["ready"] is True
+    # the next on-time success clears the mark
+    assert _post_X(client, "mach-a", GOOD_X).status_code == 200
+    health = client.get("/healthz").get_json()
+    assert health["status"] == "ok" and health["suspect"] == {}
+
+
+def test_generous_deadline_still_serves(served):
+    _, client = served
+    response = client.post(
+        "/gordo/v0/proj/mach-a/prediction",
+        data=json.dumps({"X": GOOD_X}),
+        content_type="application/json",
+        headers={deadline.DEADLINE_HEADER: "30"},
+    )
+    assert response.status_code == 200
+
+
+def test_admission_shed_503_with_retry_after(model_dirs):
+    app = build_app(model_dirs, project="proj", max_inflight=1)
+    app.admission.max_queue = 0  # no waiting room: shed instantly
+    client = Client(app)
+    with app.admission.admit():  # saturate the gate
+        response = _post_X(client, "mach-a", GOOD_X)
+        assert response.status_code == 503
+        assert int(response.headers["Retry-After"]) >= 1
+        assert "overloaded" in response.get_json()["error"]
+    # slot released: traffic flows again
+    assert _post_X(client, "mach-a", GOOD_X).status_code == 200
+
+
+def test_scoring_fault_quarantines_machine_and_recovers(model_dirs):
+    app = build_app(model_dirs, project="proj", quarantine_cooldown=0.05)
+    client = Client(app)
+    faults.configure("engine-dispatch:mach-a:error:injected dispatch crash")
+    try:
+        response = _post_X(client, "mach-a", GOOD_X)
+        assert response.status_code == 503
+        assert "quarantined" in response.get_json()["error"]
+        assert "Retry-After" in response.headers
+        # blast radius is ONE machine: its neighbor keeps serving 200s
+        assert _post_X(client, "mach-b", GOOD_X).status_code == 200
+        # within the cooldown requests are refused without touching the
+        # engine (the fault would re-fire if they did reach it)
+        assert _post_X(client, "mach-a", GOOD_X).status_code == 503
+        health = client.get("/healthz").get_json()
+        assert health["status"] == "degraded" and health["ready"] is True
+        assert health["quarantined"]["mach-a"]["phase"] == "score"
+        assert "injected dispatch crash" in health["quarantined"]["mach-a"]["error"]
+        # machine-scoped healthz says quarantined, not vanished
+        scoped = client.get("/gordo/v0/proj/mach-a/healthz")
+        assert scoped.status_code == 503
+        assert scoped.get_json()["status"] == "quarantined"
+    finally:
+        faults.clear()
+    time.sleep(0.06)  # cooldown elapses -> next request is the probe
+    assert _post_X(client, "mach-a", GOOD_X).status_code == 200
+    health = client.get("/healthz").get_json()
+    assert health["status"] == "ok" and health["quarantined"] == {}
+
+
+def test_probe_not_burned_by_client_error(model_dirs):
+    """A recovery probe that 400s (bad payload) proved nothing about the
+    machine: the window stays open and the next well-formed request
+    recovers it WITHOUT waiting another full cooldown."""
+    app = build_app(model_dirs, project="proj", quarantine_cooldown=0.05)
+    client = Client(app)
+    faults.configure("engine-dispatch:mach-a:error:one-off crash")
+    try:
+        assert _post_X(client, "mach-a", GOOD_X).status_code == 503
+    finally:
+        faults.clear()
+    time.sleep(0.06)  # cooldown elapses
+    # the probe request is malformed -> 400, machine untouched
+    assert _post_X(client, "mach-a", [[1.0, 2.0]]).status_code == 400
+    # no fresh cooldown owed: the very next good request recovers it
+    assert _post_X(client, "mach-a", GOOD_X).status_code == 200
+    assert client.get("/healthz").get_json()["quarantined"] == {}
+
+
+def test_load_fault_quarantines_at_startup(model_dirs, tmp_path):
+    bogus = tmp_path / "corrupt-machine"
+    bogus.mkdir()
+    app = build_app(
+        {"mach-a": model_dirs["mach-a"], "mach-dead": str(bogus)},
+        project="proj",
+    )
+    client = Client(app)
+    # the corrupt artifact is quarantined; the fleet serves without it
+    assert client.get("/models").get_json()["models"] == ["mach-a"]
+    assert _post_X(client, "mach-a", GOOD_X).status_code == 200
+    response = _post_X(client, "mach-dead", GOOD_X)
+    assert response.status_code == 503  # sick, not vanished (404)
+    assert "Retry-After" in response.headers
+    health = client.get("/healthz").get_json()
+    assert health["status"] == "degraded"
+    assert health["quarantined"]["mach-dead"]["phase"] == "load"
+
+
+def test_deleted_quarantined_dir_clears_on_reload(model_dirs, tmp_path):
+    """Decommissioning a quarantined machine (deleting its dir) must drop
+    it from the ledger on the next reload — not leave /healthz degraded
+    forever re-failing a path that no longer exists."""
+    import os
+    import shutil
+
+    root = tmp_path / "root"
+    root.mkdir()
+    bogus = tmp_path / "outside-bogus"
+    bogus.mkdir()
+    # pin a healthy in-root machine so the server starts
+    ok_dir = os.path.join(str(root), "ok-q")
+    shutil.copytree(model_dirs["mach-a"], ok_dir)
+    app = build_app(
+        {"ok-q": ok_dir, "gone-m": str(bogus)},
+        project="proj", models_root=str(root),
+    )
+    client = Client(app)
+    assert client.get("/healthz").get_json()["status"] == "degraded"
+    shutil.rmtree(str(bogus))  # operator decommissions the machine
+    assert client.post("/reload").status_code == 200
+    health = client.get("/healthz").get_json()
+    assert health["status"] == "ok" and health["quarantined"] == {}
+
+
+def test_all_machines_failing_to_load_is_startup_error(tmp_path):
+    bogus = tmp_path / "nothing"
+    bogus.mkdir()
+    with pytest.raises(ValueError, match="No machine loaded"):
+        build_app({"only": str(bogus)}, project="proj")
+
+
+def test_nonfinite_payload_structured_400(served):
+    _, client = served
+    response = _post_X(
+        client, "mach-a",
+        [[0.1, float("nan"), 0.3], [0.1, 0.2, float("inf")]],
+    )
+    assert response.status_code == 400
+    body = response.get_json()
+    assert "non-finite" in body["error"]
+    assert body["non_finite_columns"] == [1, 2]
+
+
+def test_width_mismatch_structured_400(served):
+    _, client = served
+    response = _post_X(client, "mach-a", [[1.0, 2.0]] * 3)
+    assert response.status_code == 400
+    body = response.get_json()
+    assert body["expected_features"] == 3 and body["got_features"] == 2
+
+
+def test_resilience_metrics_exposed(served):
+    app, client = served
+    body = client.get("/metrics").get_json()
+    gate = body["resilience"]["admission"]
+    assert gate["inflight"] == 0 and gate["max_inflight"] >= 1
+    text = client.get("/metrics?format=prometheus").get_data(as_text=True)
+    for series in (
+        "gordo_resilience_deadline_expired_total",
+        "gordo_resilience_admission_total",
+        "gordo_resilience_quarantine_events_total",
+        "gordo_resilience_inflight",
+    ):
+        assert series in text, series
+    from gordo_components_tpu.observability.exposition import (
+        parse_prometheus_text,
+    )
+
+    parse_prometheus_text(text)  # exposition stays well-formed
+
+
+def test_server_state_drain(served):
+    app, _ = served
+    state = app._state
+    state.enter()
+    assert not state.drain(0.05)  # in-flight request holds the generation
+    state.exit()
+    assert state.drain(0.05)
+
+
+def test_reload_drains_old_generation_before_release(tmp_path):
+    """The reload race (satellite): the old generation's in-flight requests
+    are drained before dropped machines release; a wedged request only
+    delays it by drain_timeout, never blocks the swap forever."""
+    from gordo_components_tpu.server.server import ModelServer
+
+    root = str(tmp_path / "fleet")
+    import os
+
+    os.makedirs(root)
+    model_dir = provide_saved_model(
+        "dr-m", PLAIN_MODEL, DATA_CONFIG, os.path.join(root, "dr-m"),
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    app = ModelServer({"dr-m": model_dir}, project="proj", models_root=root,
+                      drain_timeout=0.05)
+    client = Client(app)
+    old_state = app._state
+    old_state.enter()  # a request pinned to the old generation
+    provide_saved_model(
+        "dr-n", PLAIN_MODEL, DATA_CONFIG, os.path.join(root, "dr-n"),
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    started = time.monotonic()
+    response = client.post("/reload")
+    waited = time.monotonic() - started
+    assert response.status_code == 200
+    assert response.get_json()["added"] == ["dr-n"]
+    assert waited >= 0.04  # reload WAITED for the drain window
+    assert app._state is not old_state  # and still swapped generations
+    old_state.exit()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos: watchman probe breakers
+# ---------------------------------------------------------------------------
+
+def test_watchman_breaker_full_cycle(monkeypatch):
+    """Breaker open → half-open → closed on watchman probes, driven by
+    probe faults and an injected clock: a dead target stops costing a
+    timeout per scrape, and ONE successful probe re-closes the circuit."""
+    from gordo_components_tpu.watchman.server import WatchmanServer
+
+    clock = FakeClock()
+    watchman = WatchmanServer(
+        "proj", {"m1": "http://fleet.example"},
+        breaker_recovery=30.0, breaker_clock=clock,
+    )
+    calls = {"n": 0}
+
+    def fake_get(url, timeout=None):
+        calls["n"] += 1
+        return SimpleNamespace(status_code=200)
+
+    import requests
+
+    monkeypatch.setattr(requests, "get", fake_get)
+
+    faults.configure("probe:m1:error:target down")
+    for _ in range(3):  # min_calls failures trip the circuit
+        body = watchman.status()
+        assert body["endpoints"][0]["healthy"] is False
+    # keyed by HOST: a dead host is one circuit however many machines
+    # it serves
+    breaker = watchman._breakers.get("http://fleet.example")
+    assert breaker.state == "open"
+    assert calls["n"] == 0  # fault fires BEFORE the HTTP hop
+
+    # open: probes short-circuit from state, no HTTP attempted
+    body = watchman.status()
+    entry = body["endpoints"][0]
+    assert entry["healthy"] is False and "circuit open" in entry["error"]
+    assert body["open-circuits"] == {"http://fleet.example": "open"}
+    assert "target down" in entry["last_error"]
+    assert calls["n"] == 0
+
+    # recovery window elapses while the target is STILL down: the single
+    # half-open probe fails and the circuit re-opens
+    clock.advance(30.1)
+    watchman.status()
+    assert breaker.state == "open"
+
+    # target comes back: next window's probe succeeds and closes it
+    faults.clear()
+    clock.advance(30.1)
+    body = watchman.status()
+    assert calls["n"] == 1  # exactly the one recovery probe went out
+    assert body["endpoints"][0]["healthy"] is True
+    assert breaker.state == "closed"
+    assert body["open-circuits"] == {}
+
+
+# ---------------------------------------------------------------------------
+# client: Retry-After, retry budget, circuit, deadline header
+# ---------------------------------------------------------------------------
+
+def _fake_response(status, headers=None, payload=None):
+    return SimpleNamespace(
+        status_code=status,
+        headers=headers or {},
+        text="",
+        json=lambda: payload
+        or {"data": {"total-anomaly-score": [1.0],
+                     "tag-anomaly-scores": [[0.5]]}},
+    )
+
+
+@pytest.fixture
+def client_time(monkeypatch):
+    """Record the client's sleeps instead of performing them."""
+    from gordo_components_tpu.client import client as client_mod
+
+    slept = []
+    stub = SimpleNamespace(
+        monotonic=time.monotonic, sleep=lambda s: slept.append(s)
+    )
+    monkeypatch.setattr(client_mod, "time", stub)
+    return slept
+
+
+def _frame():
+    import pandas as pd
+
+    return pd.DataFrame({"tag-a": [0.1], "tag-b": [0.2], "tag-c": [0.3]})
+
+
+def test_client_honors_retry_after(monkeypatch, client_time):
+    from gordo_components_tpu.client import Client as GordoClient
+
+    responses = [
+        _fake_response(503, headers={"Retry-After": "0.07"}),
+        _fake_response(200),
+    ]
+    import requests
+
+    monkeypatch.setattr(requests, "post", lambda *a, **k: responses.pop(0))
+    client = GordoClient("http://srv", retries=3, retry_backoff=0.001)
+    frame = client.predict_frame("m", _frame(), fmt="json")
+    assert len(frame) == 1
+    # the server's hint dominated our (tiny) backoff
+    assert client_time and client_time[0] >= 0.07
+
+
+def test_client_retry_budget_caps_backoff(monkeypatch, client_time):
+    from gordo_components_tpu.client import Client as GordoClient
+    from gordo_components_tpu.client.client import ClientError
+
+    import requests
+
+    monkeypatch.setattr(
+        requests, "post",
+        lambda *a, **k: _fake_response(503, headers={"Retry-After": "60"}),
+    )
+    client = GordoClient("http://srv", retries=5, retry_backoff=0.001,
+                         retry_budget=0.5)
+    with pytest.raises(ClientError, match="budget"):
+        client.predict_frame("m", _frame(), fmt="json")
+    assert client_time == []  # waiting 60s would blow the 0.5s budget
+
+
+def test_client_deadline_bounds_retries(monkeypatch, client_time):
+    from gordo_components_tpu.client import Client as GordoClient
+    from gordo_components_tpu.client.client import ClientError
+
+    import requests
+
+    calls = {"n": 0}
+
+    def failing_post(*a, **k):
+        calls["n"] += 1
+        raise requests.ConnectionError("down")
+
+    monkeypatch.setattr(requests, "post", failing_post)
+    client = GordoClient("http://srv", retries=5, retry_backoff=5.0)
+    with deadline.deadline_scope(0.5):
+        with pytest.raises(ClientError, match="budget"):
+            client.predict_frame("m", _frame(), fmt="json")
+    assert calls["n"] == 1  # a 5s backoff cannot fit the 0.5s deadline
+
+
+def test_client_sends_deadline_header(monkeypatch):
+    from gordo_components_tpu.client import Client as GordoClient
+
+    seen = {}
+
+    def capture_post(url, timeout=None, **kwargs):
+        seen.update(kwargs.get("headers") or {})
+        return _fake_response(200)
+
+    import requests
+
+    monkeypatch.setattr(requests, "post", capture_post)
+    client = GordoClient("http://srv")
+    with deadline.deadline_scope(12.0):
+        client.predict_frame("m", _frame(), fmt="json")
+    assert 10.0 < float(seen[deadline.DEADLINE_HEADER]) <= 12.0
+    assert "X-Gordo-Trace-Id" in seen
+
+
+def test_client_circuit_opens_on_dead_endpoint(monkeypatch, client_time):
+    from gordo_components_tpu.client import Client as GordoClient
+    from gordo_components_tpu.client.client import ClientError
+
+    import requests
+
+    calls = {"n": 0}
+
+    def dead_post(*a, **k):
+        calls["n"] += 1
+        raise requests.ConnectionError("refused")
+
+    monkeypatch.setattr(requests, "post", dead_post)
+    client = GordoClient("http://srv", retries=5, retry_backoff=0.001)
+    with pytest.raises(ClientError, match="circuit open"):
+        client.predict_frame("m", _frame(), fmt="json")
+    # breaker default min_calls=3: three real attempts tripped it, the
+    # remaining retries short-circuited without touching the socket
+    assert calls["n"] == 3
+    # a SECOND call fails instantly: zero attempts, zero sleeps
+    calls["n"] = 0
+    with pytest.raises(ClientError, match="circuit open"):
+        client.predict_frame("m", _frame(), fmt="json")
+    assert calls["n"] == 0
+
+
+def test_client_504_does_not_trip_circuit(monkeypatch, client_time):
+    """A 504 is a fast answer from a LIVE server (our deadline, its
+    honesty) — deadline-tight callers must not open the endpoint's
+    circuit for everyone else."""
+    from gordo_components_tpu.client import Client as GordoClient
+    from gordo_components_tpu.client.client import ClientError
+
+    import requests
+
+    monkeypatch.setattr(
+        requests, "post", lambda *a, **k: _fake_response(504)
+    )
+    client = GordoClient("http://srv", retries=4, retry_backoff=0.001)
+    with pytest.raises(ClientError, match="exhausted"):
+        client.predict_frame("m", _frame(), fmt="json")
+    assert client._breaker().state == "closed"
+
+
+def test_client_4xx_does_not_trip_circuit(monkeypatch):
+    from gordo_components_tpu.client import Client as GordoClient
+    from gordo_components_tpu.client.client import ClientError
+
+    import requests
+
+    monkeypatch.setattr(
+        requests, "post", lambda *a, **k: _fake_response(400)
+    )
+    client = GordoClient("http://srv", retries=2)
+    for _ in range(5):  # an alive-but-rejecting server never opens the circuit
+        with pytest.raises(ClientError, match="HTTP 400"):
+            client.predict_frame("m", _frame(), fmt="json")
+    assert client._breaker().state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# fleet build isolation
+# ---------------------------------------------------------------------------
+
+def test_fleet_build_isolates_failing_machine(tmp_path):
+    """A data-fetch fault on ONE machine must not abort its fleet: the
+    healthy machines' artifacts land, the failed one is recorded in the
+    manifest and left unregistered for the next run to retry."""
+    import os
+
+    from gordo_components_tpu.parallel import (
+        FleetMachineConfig,
+        build_fleet,
+        fleet_mesh,
+    )
+    from gordo_components_tpu.parallel.build_fleet import MANIFEST_FILE
+
+    model_config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "Pipeline": {
+                    "steps": [
+                        "MinMaxScaler",
+                        {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                              "dims": [4], "epochs": 1,
+                                              "batch_size": 16}},
+                    ]
+                }
+            }
+        }
+    }
+    machines = [
+        FleetMachineConfig(
+            name=f"iso-{i}", model_config=model_config,
+            data_config=dict(DATA_CONFIG),
+        )
+        for i in range(3)
+    ]
+    out = str(tmp_path / "fleet")
+    faults.configure("data-fetch:iso-1:error:lake revoked the credential")
+    try:
+        results = build_fleet(
+            machines, out, mesh=fleet_mesh(), n_splits=0,
+            fetch_retries=0,  # terminal on first failure: no backoff sleeps
+        )
+    finally:
+        faults.clear()
+    assert sorted(results) == ["iso-0", "iso-2"]
+    for name in ("iso-0", "iso-2"):
+        assert os.path.isdir(results[name])
+    manifest = json.load(open(os.path.join(out, MANIFEST_FILE)))
+    entry = manifest["machines"]["iso-1"]
+    assert entry["status"] == "failed"
+    assert "lake revoked" in entry["error"]
+
+
+def test_fleet_fetch_retries_transient_failures(tmp_path):
+    """A provider that fails once then recovers costs a retry, not the
+    machine: backed-off re-fetch succeeds and the artifact lands."""
+    import os
+
+    from gordo_components_tpu.parallel.build_fleet import _fetch_machine_data
+
+    attempts = {"n": 0}
+
+    class FlakyDataset:
+        def get_data(self):
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                raise RuntimeError("transient lake hiccup")
+            X = np.zeros((8, 3), np.float32)
+            return X, X.copy()
+
+        def get_metadata(self):
+            return {}
+
+    item = {"machine": SimpleNamespace(name="flaky"),
+            "dataset": FlakyDataset()}
+    error = _fetch_machine_data(item, retries=2, backoff=0.01)
+    assert error is None and attempts["n"] == 2
+    assert item["X"].shape == (8, 3)
+
+    # permanent (config-class) failures do NOT retry: re-reading the lake
+    # cannot grow history
+    class ShortDataset(FlakyDataset):
+        def get_data(self):
+            attempts["n"] += 1
+            raise ValueError("too few rows")
+
+    attempts["n"] = 0
+    error = _fetch_machine_data(
+        {"machine": SimpleNamespace(name="short"), "dataset": ShortDataset()},
+        retries=3, backoff=0.01,
+    )
+    assert "too few rows" in error and attempts["n"] == 1

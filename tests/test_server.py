@@ -81,7 +81,11 @@ def _post(client, path, payload):
 def test_healthz(client):
     response = client.get("/healthz")
     assert response.status_code == 200
-    assert response.get_json() == {"ok": True}
+    body = response.get_json()
+    assert body["ok"] is True
+    assert body["status"] == "ok"
+    assert body["live"] is True and body["ready"] is True
+    assert body["quarantined"] == {} and body["suspect"] == {}
 
 
 def test_models_listing(client):

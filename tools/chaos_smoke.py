@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Chaos smoke: boot a fleet server with injected faults and assert
+degraded-but-alive behavior end to end (``make chaos-smoke``).
+
+The experiment (ISSUE 2 acceptance scenario): three machines, one healthy,
+one with a latency fault on its engine dispatch, one with an error fault
+at model load. A live server must then:
+
+- keep serving the healthy machine 200s throughout,
+- answer the slow machine's deadline-bounded request 504 *within* the
+  deadline's order of magnitude (never the full injected latency),
+- quarantine the dead machine at load and answer it 503 + ``Retry-After``,
+- report ``degraded`` on ``/healthz`` naming BOTH sick machines,
+- expose the transitions as ``gordo_resilience_*`` series in
+  ``/metrics?format=prometheus`` (validated with the repo's own parser).
+
+Exit codes: 0 = all checks passed, 1 = at least one failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# runnable straight from a checkout (python tools/chaos_smoke.py):
+# sys.path[0] is tools/, the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-04T00:00:00+00:00",
+    "tag_list": ["tag-a", "tag-b", "tag-c"],
+}
+MODEL_CONFIG = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                  "dims": [6], "epochs": 1,
+                                  "batch_size": 32}},
+        ]
+    }
+}
+
+# one machine slow at dispatch, one broken at load — the harness is the
+# ONLY thing wrong with this server
+FAULT_SPEC = (
+    "engine-dispatch:mach-slow:latency:0.3;"
+    "model-load:mach-dead:error:injected corrupt artifact"
+)
+
+REQUIRED_SERIES = (
+    "gordo_resilience_deadline_expired_total",
+    "gordo_resilience_quarantine_events_total",
+    "gordo_resilience_quarantined_machines",
+    "gordo_resilience_inflight",
+    "gordo_resilience_faults_injected_total",
+)
+
+_failures: list = []
+
+
+def check(ok: bool, message: str) -> None:
+    marker = "ok  " if ok else "FAIL"
+    print(f"  {marker} {message}")
+    if not ok:
+        _failures.append(message)
+
+
+def main() -> int:
+    import tempfile
+    import threading
+    import time
+
+    import requests
+    from werkzeug.serving import make_server
+
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.observability.exposition import (
+        parse_prometheus_text,
+    )
+    from gordo_components_tpu.resilience import faults
+    from gordo_components_tpu.server import build_app
+
+    with tempfile.TemporaryDirectory() as tmp:
+        print("building 3 throwaway machines ...", file=sys.stderr)
+        dirs = {
+            name: provide_saved_model(
+                name, MODEL_CONFIG, DATA_CONFIG, os.path.join(tmp, name),
+                evaluation_config={"cv_mode": "build_only"},
+            )
+            for name in ("mach-ok", "mach-slow", "mach-dead")
+        }
+        n_rules = faults.configure(FAULT_SPEC)
+        print(f"fault harness armed: {n_rules} rule(s)", file=sys.stderr)
+        app = build_app(dirs, project="chaos", quarantine_cooldown=30.0)
+        server = make_server("127.0.0.1", 0, app, threaded=True)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_port}"
+        payload = json.dumps({"X": [[0.1, 0.2, 0.3]] * 3})
+        headers = {"Content-Type": "application/json"}
+
+        def predict(machine, extra_headers=None):
+            return requests.post(
+                f"{base}/gordo/v0/chaos/{machine}/prediction",
+                data=payload,
+                headers={**headers, **(extra_headers or {})},
+                timeout=30,
+            )
+
+        try:
+            print("server live; driving chaos scenario ...", file=sys.stderr)
+
+            response = predict("mach-ok")
+            check(response.status_code == 200,
+                  "healthy machine serves 200 with faults armed")
+
+            response = predict("mach-slow",
+                               {"X-Gordo-Deadline": "0.1"})
+            check(response.status_code == 504,
+                  f"slow machine's 0.1s-deadline request answers 504 "
+                  f"(got {response.status_code})")
+
+            response = predict("mach-dead")
+            check(response.status_code == 503,
+                  f"load-failed machine answers 503 (got "
+                  f"{response.status_code})")
+            check("Retry-After" in response.headers,
+                  "503 carries Retry-After")
+
+            health = requests.get(f"{base}/healthz", timeout=10).json()
+            check(health["status"] == "degraded",
+                  f"/healthz reports degraded (got {health['status']!r})")
+            check(health["live"] is True and health["ready"] is True,
+                  "degraded fleet is still live and ready")
+            check("mach-dead" in health["quarantined"],
+                  "quarantine names mach-dead")
+            check("mach-slow" in health["suspect"],
+                  "suspect tier names mach-slow")
+
+            response = predict("mach-ok")
+            check(response.status_code == 200,
+                  "healthy machine STILL serves 200 after the chaos")
+
+            text = requests.get(
+                f"{base}/metrics?format=prometheus", timeout=10
+            ).text
+            try:
+                samples = parse_prometheus_text(text)
+            except ValueError as exc:
+                check(False, f"exposition parses ({exc})")
+            else:
+                for series in REQUIRED_SERIES:
+                    check(series in samples, f"series {series} present")
+        finally:
+            faults.clear()
+            server.shutdown()
+            thread.join(timeout=5)
+
+    if _failures:
+        print(f"\nCHAOS SMOKE FAILED: {len(_failures)} check(s)",
+              file=sys.stderr)
+        return 1
+    print("\nchaos smoke passed: degraded but alive, exactly as designed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
