@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke images builder-image server-image watchman-image
+.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck smoke images builder-image server-image watchman-image
 
 test:
 	python -m pytest tests/ -q
@@ -27,6 +27,16 @@ metrics-smoke:
 # degraded naming them, gordo_resilience_* series in the exposition
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
+# end-to-end model-store integrity check: build a throwaway models tree
+# with a torn CURRENT generation, an unrecoverable machine, and crash
+# debris; assert fsck detects everything, repairs via rollback +
+# quarantine, and sweeps the debris (tools/store_fsck.py --selftest)
+store-fsck:
+	JAX_PLATFORMS=cpu python tools/store_fsck.py --selftest
+
+# the full smoke battery: exposition + resilience + store integrity
+smoke: metrics-smoke chaos-smoke store-fsck
 
 images: builder-image server-image watchman-image
 

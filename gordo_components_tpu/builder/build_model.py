@@ -29,7 +29,9 @@ from ..models.anomaly.base import AnomalyDetectorBase
 from ..models.metrics import METRICS
 from ..models.pipeline import clone_pipeline
 from ..observability.registry import REGISTRY
-from ..serializer import dump, pipeline_from_definition, pipeline_into_definition
+from ..serializer import pipeline_from_definition, pipeline_into_definition
+from ..serializer.persistence import write_artifact_files
+from ..store import StoreError, commit_generation, resolve_artifact_dir, verify_artifact
 from ..utils import disk_registry
 from ..utils.profiling import PhaseTimer
 
@@ -199,9 +201,14 @@ def provide_saved_model(
     evaluation_config: Optional[Dict[str, Any]] = None,
 ) -> str:
     """Idempotent build: returns the model dir, reusing a cached build when
-    the config hash is registered and the artifact still exists."""
-    import os
+    the config hash is registered and the artifact still VERIFIES — a
+    registry entry whose artifact is torn (crash, bit rot) triggers a
+    rebuild, never a silent half-load downstream.
 
+    The artifact lands as a new ``gen-NNNN/`` generation under
+    ``output_dir`` with the ``CURRENT`` pointer swapped atomically
+    (``store/``): a crash mid-build leaves any previous generation
+    serving, and ``gordo rollback`` can restore it after a bad build."""
     if (evaluation_config or {}).get("cv_mode") == "cross_val_only":
         raise ValueError(
             "cv_mode='cross_val_only' skips the final fit and produces no "
@@ -211,19 +218,26 @@ def provide_saved_model(
         name, model_config, data_config, evaluation_config=evaluation_config
     )
     if model_register_dir and not replace_cache:
+        # get_value already resolves dangling pointers to None — the
+        # registry layer owns that rule
         cached = disk_registry.get_value(model_register_dir, cache_key)
-        if cached and os.path.isdir(cached):
-            logger.info(
-                "Model %r cache hit (key %s) -> %s", name, cache_key, cached
-            )
-            _M_BUILDS.labels("cached").inc()
-            return cached
         if cached:
-            logger.warning(
-                "Registry entry for %r points at missing dir %r; rebuilding",
-                name,
-                cached,
-            )
+            try:
+                # structural check only (deep=False): a cache hit must
+                # stay O(stats), not re-hash GBs — load() does the full
+                # hash when the artifact is actually deserialized
+                verify_artifact(resolve_artifact_dir(cached), deep=False)
+            except StoreError as exc:
+                logger.warning(
+                    "Cached artifact for %r fails verification (%s); "
+                    "rebuilding", name, exc,
+                )
+            else:
+                logger.info(
+                    "Model %r cache hit (key %s) -> %s", name, cache_key, cached
+                )
+                _M_BUILDS.labels("cached").inc()
+                return cached
     if model_register_dir and replace_cache:
         disk_registry.delete_key(model_register_dir, cache_key)
 
@@ -231,7 +245,13 @@ def provide_saved_model(
         name, model_config, data_config, metadata, evaluation_config
     )
     build_metadata["model"]["cache_key"] = cache_key
-    dump(model, output_dir, metadata=build_metadata)
+    commit_generation(
+        output_dir,
+        lambda staging: write_artifact_files(
+            model, staging, metadata=build_metadata
+        ),
+        name=name,
+    )
     if model_register_dir:
         disk_registry.write_key(model_register_dir, cache_key, output_dir)
     return output_dir

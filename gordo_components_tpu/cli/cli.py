@@ -7,7 +7,8 @@ OUTPUT_DIR, MODEL_REGISTER_DIR — Argo injects these), ``run-server``,
 orchestrator can tell retryable data failures from permanent config errors.
 
 TPU additions: ``fleet-build`` (the whole fleet in one process — what the
-generated TPU Job runs) and ``run-watchman``.
+generated TPU Job runs), ``run-watchman``, and ``rollback`` (swap a model
+dir's ``CURRENT`` pointer back to its previous verified generation).
 
 Exit codes: 0 ok · 64 bad config (permanent) · 66 data unavailable/short
 (retryable) · 1 unexpected.
@@ -334,6 +335,34 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
         )
         sys.exit(EXIT_RETRYABLE)
     click.echo(json.dumps(results, indent=2))
+
+
+@gordo.command("rollback")
+@click.argument("model_dir")
+@click.option("--list", "list_only", is_flag=True, default=False,
+              help="print the model dir's generation status (current "
+                   "generation, all generations, verify result) as JSON "
+                   "without changing anything")
+def rollback_cmd(model_dir, list_only):
+    """Roll a model dir back to its previous verified generation.
+
+    MODEL_DIR is a generation root (``gen-NNNN/`` dirs + ``CURRENT``
+    pointer — what ``build``/``fleet-build`` write). The rollback is a
+    single atomic ``CURRENT`` swap to the newest PREVIOUS generation that
+    passes manifest verification; a serving process adopts it on its next
+    ``POST /reload``. Exits 64 when there is nothing safe to roll back to.
+    """
+    from ..store import StoreError, artifact_status, rollback_generation
+
+    if list_only:
+        click.echo(json.dumps(artifact_status(model_dir), indent=2))
+        return
+    try:
+        restored = rollback_generation(model_dir)
+    except StoreError as exc:
+        logger.error("Rollback failed: %s", exc)
+        sys.exit(EXIT_CONFIG)
+    click.echo(restored)
 
 
 @gordo.command("run-server")

@@ -43,7 +43,15 @@ from ..models.transformers import MinMaxScaler, StandardScaler
 from ..observability.registry import REGISTRY
 from ..ops.scaling import ScalerParams
 from ..resilience import faults
-from ..serializer import dump, pipeline_from_definition
+from ..serializer import pipeline_from_definition
+from ..serializer.persistence import write_artifact_files
+from ..store import (
+    StoreError,
+    commit_generation,
+    resolve_artifact_dir,
+    verify_artifact,
+)
+from ..store import journal as store_journal
 from ..utils import disk_registry
 from .fleet import (
     FLEET_CV_METRICS,
@@ -509,6 +517,22 @@ class _SliceCheckpointer:
             force=True,
         )
 
+    def join(self) -> None:
+        """Wait for any in-flight async save WITHOUT deleting anything —
+        exception-path cleanup, so a failed build neither leaks the saver
+        thread nor lets a still-writing save race an in-process resume (a
+        REAL kill has no thread left to race; this covers the simulated
+        kills tests and chaos runs use). Deferred save errors are logged,
+        not raised: the original build exception must propagate, and the
+        checkpoint is only a resume accelerator."""
+        try:
+            self._ckptr.wait_until_finished()
+        except Exception:
+            logger.warning(
+                "Async slice-checkpoint save failed during build abort",
+                exc_info=True,
+            )
+
     def finalize(self, key: str) -> None:
         """Wait for the async save, then drop the checkpoint — the slice's
         artifacts are durable now, so the registry is the source of truth.
@@ -537,14 +561,22 @@ class _SliceCheckpointer:
 
 
 def _write_manifest(
-    output_dir: str, completed: Dict[str, Dict[str, Any]], pending: List[str]
+    output_dir: str,
+    completed: Dict[str, Dict[str, Any]],
+    pending: List[str],
+    journal_counts: Optional[Dict[str, int]] = None,
 ) -> None:
     """Fleet completion bitmap (SURVEY.md §6.4): one JSON file in the output
     dir recording which machines are done, rewritten atomically after every
     slice — a monitor (or a resuming build) reads fleet progress without
     scanning the registry. Multi-host: each non-zero process writes its own
     ``fleet_manifest.p{i}.json`` (its machine shard) so concurrent writers
-    on shared storage never clobber each other; a monitor unions the files."""
+    on shared storage never clobber each other; a monitor unions the files.
+
+    ``journal_counts``: the resume accounting from the build journal —
+    how many machines were skipped because a previous run committed them
+    (``resumed``), found torn and redone (``torn``), and actually built
+    this run (``rebuilt``)."""
     import os
     import tempfile
 
@@ -560,6 +592,8 @@ def _write_manifest(
         "machines": completed,
         "pending": sorted(pending),
     }
+    if journal_counts is not None:
+        payload["journal"] = dict(journal_counts)
     fd, tmp = tempfile.mkstemp(dir=output_dir, suffix=".manifest")
     try:
         with os.fdopen(fd, "w") as fh:
@@ -905,8 +939,15 @@ def build_fleet(
     bucketing must stay process-identical, so probe failures there still
     abort.)
 
-    Machines whose config hash is already registered are skipped (idempotent
-    resume). Remaining machines are bucketed by (model config, data shape)
+    Machines whose config hash is already registered — or whose build
+    journal record says ``committed`` (``store/journal.py``; the WAL is
+    the resume source when no registry is configured) — are skipped,
+    but only after their artifact passes manifest VERIFICATION; a torn
+    one is redone and counted under ``torn`` in the fleet manifest's
+    ``journal`` block (alongside ``resumed``/``rebuilt``). Artifacts
+    land as atomic ``gen-NNNN`` generations (``store/``), so a kill at
+    any point leaves each machine either whole or absent — never torn.
+    Remaining machines are bucketed by (model config, data shape)
     and each bucket trains as one compiled program, sharded over ``mesh``.
     ``profile_dir`` wraps the device work in a ``jax.profiler`` trace.
 
@@ -965,6 +1006,15 @@ def build_fleet(
     results: Dict[str, str] = {}
     pending: List[Tuple[FleetMachineConfig, str, int, Optional[bool]]] = []
     ignored_eval: Dict[str, List[str]] = {}
+    # resumable-build WAL: one fsync'd record per machine lifecycle event
+    # (started / committed / failed); a re-run replays it (unioned with any
+    # multi-host siblings) so committed machines are skipped even when no
+    # registry is configured, and torn ones are provably redone
+    journal = store_journal.BuildJournal(
+        store_journal.journal_path(output_dir, jax.process_index())
+    )
+    journal_states = store_journal.replay(output_dir)
+    journal_counts = {"resumed": 0, "torn": 0, "rebuilt": 0}
     for machine in machines:
         eff_splits, eff_cv_parallel, ignored = _effective_splits(
             machine, n_splits
@@ -984,11 +1034,43 @@ def build_fleet(
             machine.data_config,
             evaluation_config=evaluation_config,
         )
+        cached: Optional[str] = None
         if model_register_dir:
+            # dangling pointers already read as None inside get_value
             cached = disk_registry.get_value(model_register_dir, cache_key)
-            if cached and os.path.isdir(cached):
-                logger.info("Fleet cache hit for %r -> %s", machine.name, cached)
+        if cached is None:
+            # no registry (or no entry): the journal's committed record is
+            # the fallback resume source — but only for the SAME config
+            # (cache_key match), else a config change would resurrect a
+            # stale artifact
+            record = journal_states.get(machine.name)
+            if (
+                record is not None
+                and record.get("event") == store_journal.EVENT_COMMITTED
+                and record.get("cache_key") == cache_key
+                and os.path.isdir(str(record.get("model_dir", "")))
+            ):
+                cached = str(record["model_dir"])
+        if cached is not None:
+            # trust nothing unverified: a registered-but-torn artifact
+            # (crash between artifact and registry durability) must
+            # rebuild, not serve half a model later. Structural check
+            # only (deep=False): a fully-cached thousand-machine resume
+            # must stay O(stats) — the serving load() pays the hash pass
+            try:
+                verify_artifact(resolve_artifact_dir(cached), deep=False)
+            except StoreError as exc:
+                logger.warning(
+                    "Fleet resume: artifact for %r fails verification "
+                    "(%s); rebuilding", machine.name, exc,
+                )
+                journal_counts["torn"] += 1
+            else:
+                logger.info(
+                    "Fleet cache hit for %r -> %s", machine.name, cached
+                )
                 results[machine.name] = cached
+                journal_counts["resumed"] += 1
                 _M_FLEET_MACHINES.labels("cached").inc()
                 continue
         pending.append((machine, cache_key, eff_splits, eff_cv_parallel))
@@ -1007,7 +1089,8 @@ def build_fleet(
         for name, path in results.items()
     }
     _write_manifest(
-        output_dir, manifest, [m.name for m, *_ in pending]
+        output_dir, manifest, [m.name for m, *_ in pending],
+        journal_counts=journal_counts,
     )
 
     # ---- bucket by (model config, feature/target width) BEFORE fetching:
@@ -1042,6 +1125,9 @@ def build_fleet(
                     machine.name, error,
                 )
                 manifest[machine.name] = {"status": "failed", "error": error}
+                journal.record(
+                    machine.name, store_journal.EVENT_FAILED, error=error
+                )
                 _M_FLEET_MACHINES.labels("failed").inc()
                 continue
             n_features, n_targets = item["X"].shape[1], item["y"].shape[1]
@@ -1078,6 +1164,7 @@ def build_fleet(
         _write_manifest(
             output_dir, manifest,
             [m.name for m, *_ in pending if m.name not in manifest],
+            journal_counts=journal_counts,
         )
 
     master_key = jax.random.PRNGKey(seed)
@@ -1253,6 +1340,11 @@ def build_fleet(
                                 "bucket": b,
                                 "slice": s,
                             }
+                            journal.record(
+                                machine.name,
+                                store_journal.EVENT_FAILED,
+                                error=item["build_error"],
+                            )
                             _M_FLEET_MACHINES.labels("failed").inc()
                             continue
                         model = pipeline_from_definition(machine.model_config)
@@ -1297,11 +1389,36 @@ def build_fleet(
                             "build_duration_s": amortized,
                             "user_defined": dict(machine.metadata),
                         }
-                        dump(model, model_dir, metadata=metadata)
+                        # WAL first, then the atomic generation commit,
+                        # then registry + committed record: a crash at any
+                        # point leaves either no trace (redo) or a whole,
+                        # verifiable artifact (skip) — never a torn dir a
+                        # resume would trust
+                        journal.record(
+                            machine.name,
+                            store_journal.EVENT_STARTED,
+                            cache_key=item["cache_key"],
+                            bucket=b,
+                            slice=s,
+                        )
+                        commit_generation(
+                            model_dir,
+                            lambda staging: write_artifact_files(
+                                model, staging, metadata=metadata
+                            ),
+                            name=machine.name,
+                        )
                         if model_register_dir:
                             disk_registry.write_key(
                                 model_register_dir, item["cache_key"], model_dir
                             )
+                        journal.record(
+                            machine.name,
+                            store_journal.EVENT_COMMITTED,
+                            cache_key=item["cache_key"],
+                            model_dir=model_dir,
+                        )
+                        journal_counts["rebuilt"] += 1
                         results[machine.name] = model_dir
                         _M_FLEET_MACHINES.labels("completed").inc()
                         _M_MACHINE_BUILD_SECONDS.labels(machine.name).set(
@@ -1317,6 +1434,7 @@ def build_fleet(
                         output_dir,
                         manifest,
                         [name for name in (m.name for m, *_ in pending) if name not in manifest],
+                        journal_counts=journal_counts,
                     )
                 with timer.phase("checkpoint_wait"):
                     # artifacts durable → join the async save, drop the ckpt
@@ -1335,6 +1453,7 @@ def build_fleet(
     finally:
         watchdog.stop()
         prefetcher.shutdown(wait=True, cancel_futures=True)
+        checkpointer.join()
     checkpointer.close()
     # phase totals land in the same registry serving scrapes, under the
     # fleet prefix so single-machine and fleet builds stay distinguishable

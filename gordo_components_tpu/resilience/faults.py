@@ -12,11 +12,15 @@ Spec grammar (``GORDO_FAULTS`` env var or ``--faults`` CLI flag)::
     point:target:kind[:param][;point:target:kind[:param]...]
 
 - ``point``   — where: ``model-load``, ``engine-dispatch``, ``probe``,
-  ``data-fetch`` (the wired boundaries; unknown points simply never fire)
+  ``data-fetch``, ``store-commit`` (the wired boundaries; unknown points
+  simply never fire)
 - ``target``  — machine/endpoint name, or ``*`` for any
 - ``kind``    — ``error`` (raise :class:`FaultInjected`; param = message),
-  ``latency`` (sleep; param = seconds, default 0.05), or
-  ``corrupt`` (NaN-poison the payload via :func:`corrupt`)
+  ``latency`` (sleep; param = seconds, default 0.05),
+  ``corrupt`` (NaN-poison the payload via :func:`corrupt`), or — at the
+  ``store-commit`` seam — ``truncate`` / ``bitflip`` (damage one staged
+  artifact file AFTER its manifest hash was recorded; param = filename,
+  default ``state.npz`` — via :func:`damage_artifact`)
 
 Example: one machine slow, another broken at load::
 
@@ -41,8 +45,10 @@ logger = logging.getLogger(__name__)
 
 ENV_VAR = "GORDO_FAULTS"
 
-POINTS = ("model-load", "engine-dispatch", "probe", "data-fetch")
-KINDS = ("error", "latency", "corrupt")
+POINTS = (
+    "model-load", "engine-dispatch", "probe", "data-fetch", "store-commit",
+)
+KINDS = ("error", "latency", "corrupt", "truncate", "bitflip")
 
 _M_INJECTED = REGISTRY.counter(
     "gordo_resilience_faults_injected_total",
@@ -176,6 +182,45 @@ def inject(point: str, target: Optional[str] = None) -> None:
                 rule.param
                 or f"injected fault at {point} (target {target!r})"
             )
+
+
+def damage_artifact(point: str, target: Optional[str], directory: str) -> None:
+    """Apply any matching ``truncate``/``bitflip`` fault to a staged
+    artifact file (param = filename, default ``state.npz``): truncate
+    chops the file to half its size; bitflip XORs one mid-file byte.
+    Called by the store's commit sequence AFTER the manifest hashed the
+    file — the resulting artifact is provably torn, which is what the
+    crash-injection suite needs verified load to catch."""
+    rules = _active_rules()
+    if not rules:
+        return
+    for rule in rules:
+        if rule.kind not in ("truncate", "bitflip") or not rule.matches(
+            point, target
+        ):
+            continue
+        filename = rule.param or "state.npz"
+        path = os.path.join(directory, filename)
+        try:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as fh:
+                if rule.kind == "truncate":
+                    fh.truncate(max(0, size // 2))
+                else:
+                    fh.seek(size // 2)
+                    byte = fh.read(1) or b"\x00"
+                    fh.seek(size // 2)
+                    fh.write(bytes([byte[0] ^ 0xFF]))
+        except OSError as exc:
+            logger.warning(
+                "Fault %s:%s could not damage %s: %s",
+                point, rule.kind, path, exc,
+            )
+            continue
+        _M_INJECTED.labels(point, rule.kind).inc()
+        logger.warning(
+            "FAULT: %s %s at %s (target %r)", rule.kind, path, point, target
+        )
 
 
 def corrupt(point: str, target: Optional[str], payload: Any) -> Any:

@@ -11,10 +11,26 @@ model_dir/
   state.npz             # every fitted array, flattened "step/sub/key" paths
   state_meta.json       # non-array fitted state (history, shapes, …)
   metadata.json         # caller-provided build metadata (optional)
+  MANIFEST.json         # per-file SHA-256 + size + format version (store/)
 ```
 
+Crash-safety contract (``store/``): ``dump`` stages into a hidden sibling
+dir, fsyncs everything, writes the checksummed manifest, and renames into
+place — a crash leaves the destination untouched. ``load`` VERIFIES the
+manifest before deserializing anything and raises the store's typed
+errors (``ManifestMissing`` / ``ArtifactIncomplete`` / ``ArtifactCorrupt``)
+on any disagreement — a torn artifact is an exception, never a silently
+half-loaded pipeline. ``load``/``load_metadata`` also resolve generation
+roots (``CURRENT`` → ``gen-NNNN/``), so callers can hold one path per
+machine whichever layout it uses.
+
 ``dumps``/``loads`` wrap the same format in an in-memory tar for the
-``/download-model`` endpoint and client-side reloads.
+``/download-model`` endpoint and client-side reloads. ``dumps`` is
+byte-deterministic (zeroed tar/gzip/zip timestamps and ownership, sorted
+members), so the same artifact always produces an identical blob and a
+downloaded model's manifest hashes match the server's. ``loads`` bounds
+extraction (member count, total decompressed bytes, duplicate names) so
+a spoofed server cannot decompression-bomb the client.
 """
 
 from __future__ import annotations
@@ -23,10 +39,14 @@ import io
 import json
 import os
 import tarfile
+import zipfile
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..store.atomic import atomic_commit
+from ..store.generations import resolve_artifact_dir
+from ..store.manifest import verify_artifact
 from .from_definition import pipeline_from_definition
 from .into_definition import pipeline_into_definition
 
@@ -35,6 +55,17 @@ DEFINITION_FILE = "definition.json"
 STATE_FILE = "state.npz"
 STATE_META_FILE = "state_meta.json"
 _SEP = "/"
+
+# tar-extraction bounds for loads(): an artifact is ≤ 5 files, so a blob
+# claiming hundreds of members or absurd decompressed sizes is an attack
+# (or corruption), not a model. Total-bytes ceiling is env-tunable for
+# genuinely huge plant fleets.
+MAX_TAR_MEMBERS = 128
+MAX_TAR_TOTAL_BYTES_ENV = "GORDO_MAX_ARTIFACT_BYTES"
+DEFAULT_MAX_TAR_TOTAL_BYTES = 2 << 30  # 2 GiB
+
+# fixed zip timestamp (the ZIP epoch) for deterministic state.npz bytes
+_ZIP_EPOCH = (1980, 1, 1, 0, 0, 0)
 
 
 def _flatten_state(
@@ -70,25 +101,66 @@ def _unflatten_state(
     return state
 
 
-def dump(obj: Any, dest_dir: str, metadata: Optional[Dict[str, Any]] = None) -> str:
-    """Persist a fitted pipeline/estimator to ``dest_dir``; returns the dir."""
-    os.makedirs(dest_dir, exist_ok=True)
+def _write_state_npz(path: str, arrays: Dict[str, np.ndarray]) -> None:
+    """``np.savez`` twin with DETERMINISTIC bytes: numpy stamps each zip
+    member with the wall clock, so two saves of identical arrays differ —
+    which would break manifest-hash comparison between a server's artifact
+    and its ``/download-model`` blob. Same format (``np.load`` reads it),
+    fixed ZIP-epoch timestamps, sorted member order."""
+    from numpy.lib import format as npformat
+
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED, allowZip64=True) as zf:
+        for name in sorted(arrays):
+            buffer = io.BytesIO()
+            npformat.write_array(
+                buffer, np.asarray(arrays[name]), allow_pickle=False
+            )
+            info = zipfile.ZipInfo(name + ".npy", date_time=_ZIP_EPOCH)
+            info.external_attr = 0o644 << 16
+            zf.writestr(info, buffer.getvalue())
+
+
+def write_artifact_files(
+    obj: Any, dest_dir: str, metadata: Optional[Dict[str, Any]] = None
+) -> None:
+    """Write the raw artifact files (NO atomicity, NO manifest) into an
+    existing directory — the writer the store's staged commits wrap. Only
+    :func:`dump` and ``store.commit_generation`` callers should use this
+    directly."""
     definition = pipeline_into_definition(obj)
     with open(os.path.join(dest_dir, DEFINITION_FILE), "w") as fh:
         json.dump(definition, fh, indent=2)
     state = obj.get_state() if hasattr(obj, "get_state") else {}
     arrays, scalars = _flatten_state(state)
-    np.savez(os.path.join(dest_dir, STATE_FILE), **arrays)
+    _write_state_npz(os.path.join(dest_dir, STATE_FILE), arrays)
     with open(os.path.join(dest_dir, STATE_META_FILE), "w") as fh:
-        json.dump(scalars, fh, indent=2)
+        json.dump(scalars, fh, indent=2, sort_keys=True)
     if metadata is not None:
         with open(os.path.join(dest_dir, METADATA_FILE), "w") as fh:
             json.dump(metadata, fh, indent=2, default=str)
+
+
+def dump(obj: Any, dest_dir: str, metadata: Optional[Dict[str, Any]] = None) -> str:
+    """Persist a fitted pipeline/estimator to ``dest_dir``; returns the dir.
+
+    All-or-nothing: files are staged in a hidden sibling dir, fsync'd,
+    manifested (per-file SHA-256 — see ``store/``), and renamed into
+    place. A crash mid-dump leaves any previous ``dest_dir`` content
+    untouched and serving."""
+    with atomic_commit(dest_dir, name=os.path.basename(dest_dir)) as staging:
+        write_artifact_files(obj, staging, metadata=metadata)
     return dest_dir
 
 
 def load(source_dir: str, *, allow_external: bool = False) -> Any:
     """Rebuild the fitted pipeline persisted by :func:`dump`.
+
+    Integrity first: the artifact's manifest is verified (every file
+    present, sizes and SHA-256 matching) BEFORE anything is deserialized;
+    a torn or tampered artifact raises the store's typed errors
+    (``ManifestMissing`` / ``ArtifactIncomplete`` / ``ArtifactCorrupt`` —
+    all ``StoreError``), which the server maps to quarantine rather than
+    a 500. Generation roots resolve through their ``CURRENT`` pointer.
 
     The artifact's definition is treated as *data*, not config: by default
     class/function resolution is restricted to this package, so a tampered
@@ -99,6 +171,8 @@ def load(source_dir: str, *, allow_external: bool = False) -> Any:
     artifact), or after appending the plugin's package prefix to
     ``from_definition._TRUSTED_PREFIXES`` once at startup.
     """
+    source_dir = resolve_artifact_dir(source_dir)
+    verify_artifact(source_dir)
     with open(os.path.join(source_dir, DEFINITION_FILE)) as fh:
         definition = json.load(fh)
     obj = pipeline_from_definition(definition, allow_external=allow_external)
@@ -116,6 +190,10 @@ def load(source_dir: str, *, allow_external: bool = False) -> Any:
 
 
 def load_metadata(source_dir: str) -> Dict[str, Any]:
+    try:
+        source_dir = resolve_artifact_dir(source_dir)
+    except Exception:
+        return {}  # torn generation root: metadata is best-effort context
     path = os.path.join(source_dir, METADATA_FILE)
     if not os.path.exists(path):
         return {}
@@ -125,16 +203,77 @@ def load_metadata(source_dir: str) -> Dict[str, Any]:
 
 def dumps(obj: Any, metadata: Optional[Dict[str, Any]] = None) -> bytes:
     """Single-blob form of :func:`dump` (in-memory tar) — the payload of the
-    server's ``GET /download-model``."""
+    server's ``GET /download-model``.
+
+    Byte-deterministic: tar headers carry zeroed mtime/uid/gid/ownership,
+    members are sorted, the gzip wrapper's mtime is zeroed, and the inner
+    ``state.npz`` uses fixed zip timestamps — so the same fitted object
+    always produces an identical blob, and its per-file manifest hashes
+    match the server's on-disk artifact."""
+    import gzip
     import tempfile
 
     buffer = io.BytesIO()
     with tempfile.TemporaryDirectory() as tmp:
         dump(obj, tmp, metadata=metadata)
-        with tarfile.open(fileobj=buffer, mode="w:gz") as tar:
-            for name in sorted(os.listdir(tmp)):
-                tar.add(os.path.join(tmp, name), arcname=name)
+        with gzip.GzipFile(fileobj=buffer, mode="wb", mtime=0) as gz:
+            with tarfile.open(fileobj=gz, mode="w") as tar:
+                for name in sorted(os.listdir(tmp)):
+                    path = os.path.join(tmp, name)
+                    info = tar.gettarinfo(path, arcname=name)
+                    info.mtime = 0
+                    info.uid = info.gid = 0
+                    info.uname = info.gname = ""
+                    info.mode = 0o644
+                    with open(path, "rb") as fh:
+                        tar.addfile(info, fh)
     return buffer.getvalue()
+
+
+def _max_tar_total_bytes() -> int:
+    raw = os.environ.get(MAX_TAR_TOTAL_BYTES_ENV, "")
+    return int(raw) if raw else DEFAULT_MAX_TAR_TOTAL_BYTES
+
+
+def _check_tar_bounds(tar: tarfile.TarFile) -> None:
+    """Pre-extraction guard rails: a spoofed ``/download-model`` response
+    must not be able to decompression-bomb the client. Header-declared
+    sizes are authoritative for extraction (tarfile reads exactly
+    ``member.size`` bytes per member), so checking headers bounds the
+    bytes written. Duplicate member names are rejected outright — the
+    last-wins overwrite they imply is only ever an attack.
+
+    Streams member headers one at a time and bails at the FIRST violation
+    — ``getmembers()`` up front would itself be bombable (a few-MB gzip
+    blob can declare millions of zero-size members, and materializing a
+    ``TarInfo`` per header OOMs the guard before any limit is checked)."""
+    limit = _max_tar_total_bytes()
+    count = 0
+    total = 0
+    seen = set()
+    while True:
+        member = tar.next()
+        if member is None:
+            break
+        count += 1
+        if count > MAX_TAR_MEMBERS:
+            raise ValueError(
+                f"Artifact tar has over {MAX_TAR_MEMBERS} members; a model "
+                "artifact has at most a handful — refusing to extract"
+            )
+        total += max(0, member.size)
+        if total > limit:
+            raise ValueError(
+                f"Artifact tar declares over {limit} decompressed bytes "
+                f"({MAX_TAR_TOTAL_BYTES_ENV} to raise) — refusing to extract"
+            )
+        name = os.path.normpath(member.name)
+        if name in seen:
+            raise ValueError(
+                f"Artifact tar repeats member {member.name!r} — refusing "
+                "to extract (duplicate names imply overwrite games)"
+            )
+        seen.add(name)
 
 
 def loads(blob: bytes, *, allow_external: bool = False) -> Any:
@@ -143,6 +282,7 @@ def loads(blob: bytes, *, allow_external: bool = False) -> Any:
 
     with tempfile.TemporaryDirectory() as tmp:
         with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+            _check_tar_bounds(tar)
             try:
                 tar.extractall(tmp, filter="data")
             except TypeError:

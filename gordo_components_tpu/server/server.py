@@ -64,6 +64,7 @@ from ..resilience.deadline import DeadlineExceeded
 from ..resilience.quarantine import Quarantine
 from ..serializer import dumps as serializer_dumps
 from ..serializer import load, load_metadata
+from ..store import generations as store_generations
 from .engine import ScoreResult, ServingEngine
 
 logger = logging.getLogger(__name__)
@@ -131,6 +132,13 @@ class _Machine:
         # the stored mtime is older than the new artifacts and the next
         # reload refreshes — stat-after-load would pin the stale model
         self.mtime = _artifact_mtime(model_dir)
+        # generation facet for /healthz and watchman: which gen-NNNN this
+        # machine serves (None = flat pre-generation artifact). load()
+        # below VERIFIES the manifest before deserializing, so a machine
+        # that constructs at all is integrity-verified by definition —
+        # torn/corrupt artifacts raise the store's typed errors and land
+        # in quarantine instead
+        self.generation = store_generations.current_generation(model_dir)
         self.model = load(model_dir)
         self.metadata = load_metadata(model_dir)
 
@@ -160,14 +168,19 @@ class _Machine:
 
 def scan_models_root(models_root: str) -> Dict[str, str]:
     """``{subdir_name: path}`` for every immediate subdir that looks like a
-    model artifact (has ``definition.json``). The ONE scan rule, shared by
-    CLI startup and ``/reload`` so the two can never drift."""
+    model artifact: a generation root (has a ``CURRENT`` pointer — the
+    store's gen-NNNN layout) or a flat legacy dir (has ``definition.json``).
+    The ONE scan rule, shared by CLI startup and ``/reload`` so the two
+    can never drift. Hidden dirs (``.staging-*`` crash debris, checkpoint
+    dirs) never qualify."""
     import os
 
     seen: Dict[str, str] = {}
     for entry in sorted(os.listdir(models_root)):
         path = os.path.join(models_root, entry)
-        if os.path.isdir(path) and os.path.exists(
+        if entry.startswith(".") or not os.path.isdir(path):
+            continue
+        if store_generations.is_generation_root(path) or os.path.exists(
             os.path.join(path, "definition.json")
         ):
             seen[entry] = path
@@ -360,7 +373,14 @@ class ModelServer:
         explicitly-registered (pinned) machines always kept. A directory
         that fails to load is SKIPPED and reported — one half-written
         artifact (a fleet build mid-write) must not abort the whole reload
-        or unserve the healthy machines."""
+        or unserve the healthy machines.
+
+        Integrity gate: ``load()`` verifies the artifact's checksummed
+        manifest before deserializing, so a reload REFUSES to adopt an
+        unverified generation — the machine keeps serving its previous
+        (verified) generation if it has one, else is quarantined with the
+        typed store error (``ManifestMissing`` / ``ArtifactIncomplete`` /
+        ``ArtifactCorrupt``) recorded for operators."""
         import os
 
         if not self.models_root:
@@ -377,8 +397,24 @@ class ModelServer:
             added, refreshed = [], []
             errors: Dict[str, str] = {}
             machines: Dict[str, _Machine] = {}
-            for name, machine in self._pinned.items():
-                machines[name] = state.machines.get(name, machine)
+            for name, pinned in self._pinned.items():
+                # pinned machines keep their NAME and DIR across rescans,
+                # but not their bytes: a new generation (or rebuilt flat
+                # artifact) in the same dir re-loads under the pinned name
+                # — run-server --models-dir pins every startup machine, so
+                # without this no CLI-started server would ever adopt a
+                # fleet rebuild's generations. Same refusal rule as the
+                # scan path: a torn rebuild keeps the old verified model.
+                current = state.machines.get(name, pinned)
+                try:
+                    if _artifact_mtime(current.model_dir) != current.mtime:
+                        machines[name] = _Machine(name, current.model_dir)
+                        refreshed.append(name)
+                    else:
+                        machines[name] = current
+                except Exception as exc:
+                    errors[name] = f"{type(exc).__name__}: {exc}"
+                    machines[name] = current
             for name, path in seen.items():
                 if os.path.realpath(path) in pinned_paths:
                     continue  # already served under its pinned name
@@ -594,8 +630,18 @@ class ModelServer:
                             )
                         },
                     )
-                self._machine_for(args, state)
-                return _json({"ok": True, "status": "ok"})
+                served = self._machine_for(args, state)
+                # integrity facet: which generation serves, and that it
+                # passed manifest verification at load (load() refuses
+                # anything that doesn't — a served machine IS verified)
+                return _json(
+                    {
+                        "ok": True,
+                        "status": "ok",
+                        "generation": served.generation,
+                        "verified": True,
+                    }
+                )
             # fleet health is TRI-STATE: live (process answers), ready (at
             # least one machine servable), degraded (quarantined or
             # suspect machines named below) — k8s probes read live/ready,
@@ -612,6 +658,18 @@ class ModelServer:
                     "ready": ready,
                     "quarantined": quarantined,
                     "suspect": suspects,
+                    # artifact-integrity facet: every served machine passed
+                    # manifest verification at load; dirs that DIDN'T are
+                    # exactly the load-quarantined set above. generations
+                    # name what would be rolled back by `gordo rollback`
+                    "store": {
+                        "verified": len(state.machines),
+                        "unverified": sorted(self._quarantined_dirs),
+                        "generations": {
+                            name: machine.generation
+                            for name, machine in sorted(state.machines.items())
+                        },
+                    },
                 },
                 status=200 if ready else 503,
             )

@@ -1,0 +1,73 @@
+"""Crash-safe model store: atomic commits, checksummed manifests,
+generations with rollback, and the resumable-build journal.
+
+The contract every layer above relies on:
+
+- an artifact directory either verifies whole (``MANIFEST.json`` per-file
+  SHA-256 + size) or loading it raises a TYPED error (:mod:`.errors`) —
+  never a silent half-load;
+- builds land as ``gen-NNNN/`` generations under the machine's model dir
+  with an atomically-swapped ``CURRENT`` pointer (:mod:`.generations`),
+  so adopting a new model and rolling it back are both O(rename);
+- fleet builds journal per-machine ``started``/``committed``/``failed``
+  records to a fsync'd WAL (:mod:`.journal`), so a killed run resumes by
+  skipping committed machines and redoing torn ones.
+
+See ``docs/ARCHITECTURE.md`` §11 for the on-disk formats.
+"""
+
+from .atomic import atomic_commit, commit_dir, fsync_dir, sweep_leftovers
+from .errors import (
+    ArtifactCorrupt,
+    ArtifactIncomplete,
+    ManifestMissing,
+    StoreError,
+)
+from .generations import (
+    CURRENT_FILE,
+    artifact_status,
+    commit_generation,
+    current_generation,
+    is_generation_root,
+    list_generations,
+    resolve_artifact_dir,
+    rollback_generation,
+)
+from .journal import BuildJournal, journal_path, replay, summarize
+from .manifest import (
+    FORMAT_VERSION,
+    MANIFEST_FILE,
+    file_sha256,
+    read_manifest,
+    verify_artifact,
+    write_manifest,
+)
+
+__all__ = [
+    "ArtifactCorrupt",
+    "ArtifactIncomplete",
+    "BuildJournal",
+    "CURRENT_FILE",
+    "FORMAT_VERSION",
+    "MANIFEST_FILE",
+    "ManifestMissing",
+    "StoreError",
+    "artifact_status",
+    "atomic_commit",
+    "commit_dir",
+    "commit_generation",
+    "current_generation",
+    "file_sha256",
+    "fsync_dir",
+    "is_generation_root",
+    "journal_path",
+    "list_generations",
+    "read_manifest",
+    "replay",
+    "resolve_artifact_dir",
+    "rollback_generation",
+    "summarize",
+    "sweep_leftovers",
+    "verify_artifact",
+    "write_manifest",
+]

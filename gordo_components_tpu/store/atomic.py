@@ -1,0 +1,220 @@
+"""Atomic directory commits: an artifact either exists whole or not at all.
+
+The commit sequence (the checkpoint-handling discipline of large-scale
+TPU serving stacks, where torn artifacts are a dominant fleet-scale
+failure mode):
+
+1. writer fills a hidden ``.staging-*`` sibling of the destination,
+2. every staged file is fsync'd,
+3. ``MANIFEST.json`` (per-file SHA-256 + size) is written and fsync'd,
+4. the staging dir itself is fsync'd,
+5. ``os.replace``/``rename`` swaps it into place and the PARENT dir is
+   fsync'd (the rename itself must be durable, or a power cut undoes a
+   "finished" build).
+
+A crash anywhere before step 5 leaves the destination untouched (a
+previous artifact keeps serving; a leftover ``.staging-*`` dir is inert
+garbage for ``store_fsck`` to sweep). A crash during step 5 is resolved
+by the filesystem: rename is atomic on POSIX.
+
+Fault seams for the crash-injection suite ride inside ``atomic_commit``:
+``store-commit:<name>:error`` stands in for a kill mid-staging (the
+staging dir is deliberately LEFT BEHIND, as a real SIGKILL would leave
+it), and ``store-commit:<name>:truncate|bitflip[:file]`` damages a staged
+file AFTER the manifest is written — producing exactly the torn-write
+artifacts ``verify_artifact`` exists to catch.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import uuid
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+from ..observability.registry import REGISTRY
+from ..resilience import faults
+from .manifest import MANIFEST_FILE, write_manifest
+
+logger = logging.getLogger(__name__)
+
+STAGING_PREFIX = ".staging-"
+_TRASH_PREFIX = ".trash-"
+
+_M_COMMITS = REGISTRY.counter(
+    "gordo_store_commits_total",
+    "Atomic artifact commits, by outcome (committed / aborted)",
+    labels=("outcome",),
+)
+
+
+def fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """Durable directory entry: fsync the dir so renames/creates inside it
+    survive a power cut. Best-effort on filesystems that refuse O_RDONLY
+    dir fds (never worth failing a commit over)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_file(path: str, data: str) -> None:
+    """Durable atomic single-file write: unique sidecar + fsync +
+    ``os.replace`` + dir fsync. The sidecar name is per-writer unique so
+    concurrent writers to one path (rollback vs commit swapping CURRENT,
+    multi-host builders registering on shared storage) never clobber each
+    other's tmp — last ``os.replace`` wins cleanly. The ONE implementation
+    of this dance; registry keys and CURRENT pointers both ride it."""
+    tmp = f"{path}.{uuid.uuid4().hex[:8]}.tmp"
+    with open(tmp, "w") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def _fsync_tree_files(directory: str) -> None:
+    for entry in os.scandir(directory):
+        if entry.is_file():
+            fsync_file(entry.path)
+
+
+@contextmanager
+def atomic_commit(dest_dir: str, name: Optional[str] = None) -> Iterator[str]:
+    """Yield a hidden staging dir; on clean exit, manifest + fsync + rename
+    it into ``dest_dir`` (replacing any existing dir). On exception the
+    destination is untouched and the staging dir is removed — EXCEPT for
+    an injected :class:`~..resilience.faults.FaultInjected`, which models
+    a SIGKILL and therefore leaves the staging dir behind exactly as a
+    real crash would.
+
+    ``name`` targets the ``store-commit`` fault seam (defaults to the
+    destination's basename, which for generation commits is ``gen-NNNN``
+    — pass the machine name for per-machine chaos targeting)."""
+    dest_dir = os.path.abspath(dest_dir)
+    parent = os.path.dirname(dest_dir)
+    os.makedirs(parent, exist_ok=True)
+    target = name if name is not None else os.path.basename(dest_dir)
+    staging = os.path.join(
+        parent,
+        f"{STAGING_PREFIX}{os.path.basename(dest_dir)}.{uuid.uuid4().hex[:8]}",
+    )
+    os.makedirs(staging)
+    try:
+        yield staging
+        # chaos seam #1: a kill between "files written" and "commit" —
+        # the manifest does not exist yet, so nothing can mistake the
+        # staging content for a whole artifact
+        faults.inject("store-commit", target)
+        _fsync_tree_files(staging)
+        write_manifest(staging, fsync=True)
+        # chaos seam #2: damage a staged file AFTER its hash was recorded
+        # (truncate/bitflip kinds) — the manifest now provably disagrees
+        # with the bytes, which is what verified load must catch
+        faults.damage_artifact("store-commit", target, staging)
+        fsync_dir(staging)
+        commit_dir(staging, dest_dir)
+        _M_COMMITS.labels("committed").inc()
+    except faults.FaultInjected:
+        _M_COMMITS.labels("aborted").inc()
+        raise  # simulated SIGKILL: leave the staging dir as a crash would
+    except BaseException:
+        _M_COMMITS.labels("aborted").inc()
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+
+
+def commit_dir(staged_dir: str, dest_dir: str) -> None:
+    """Atomically publish ``staged_dir`` as ``dest_dir``. An existing
+    destination is renamed aside first (``rename`` onto a non-empty dir
+    fails on POSIX) and deleted only after the swap is durable."""
+    parent = os.path.dirname(os.path.abspath(dest_dir))
+    trash: Optional[str] = None
+    if os.path.isdir(dest_dir):
+        trash = os.path.join(
+            parent, f"{_TRASH_PREFIX}{os.path.basename(dest_dir)}."
+            f"{uuid.uuid4().hex[:8]}"
+        )
+        os.rename(dest_dir, trash)
+    try:
+        os.rename(staged_dir, dest_dir)
+    except BaseException:
+        if trash is not None:  # roll the old artifact back into place
+            os.rename(trash, dest_dir)
+        raise
+    fsync_dir(parent)
+    if trash is not None:
+        shutil.rmtree(trash, ignore_errors=True)
+
+
+def sweep_leftovers(directory: str) -> list:
+    """Remove orphaned ``.staging-*`` / ``.trash-*`` dirs (crash debris)
+    from ``directory``; returns the swept names. Callers decide WHEN —
+    fsck sweeps on request, commits never sweep implicitly (a concurrent
+    builder's live staging dir must not be yanked from under it).
+
+    ``.trash-<name>.<id>`` dirs are NOT blindly deleted: a crash inside
+    :func:`commit_dir`'s rename-aside window (old dir moved to trash, new
+    one not yet renamed in) leaves the trash dir holding the ONLY copy of
+    the artifact — when its ``<name>`` sibling is missing, the sweep
+    RESTORES it instead, honoring the "previous artifact untouched"
+    guarantee; only trash whose replacement landed is deleted."""
+    swept = []
+    try:
+        entries = list(os.scandir(directory))
+    except OSError:
+        return swept
+    for entry in entries:
+        if not entry.is_dir():
+            continue
+        if entry.name.startswith(STAGING_PREFIX):
+            shutil.rmtree(entry.path, ignore_errors=True)
+            swept.append(entry.name)
+            logger.info("Swept leftover store dir %s", entry.path)
+        elif entry.name.startswith(_TRASH_PREFIX):
+            original = entry.name[len(_TRASH_PREFIX):].rsplit(".", 1)[0]
+            dest = os.path.join(directory, original)
+            if original and not os.path.exists(dest):
+                try:
+                    os.rename(entry.path, dest)
+                    fsync_dir(directory)
+                    swept.append(f"{entry.name} (restored as {original})")
+                    logger.warning(
+                        "Restored %s from crash-window trash %s — a commit "
+                        "died between rename-aside and rename-in",
+                        dest, entry.name,
+                    )
+                except OSError:
+                    if os.path.exists(dest):  # lost a race to the dest:
+                        # the replacement landed, trash is true garbage
+                        shutil.rmtree(entry.path, ignore_errors=True)
+                        swept.append(entry.name)
+                    else:  # restore failed with no replacement — this may
+                        # be the only copy: keep it and say so
+                        logger.error(
+                            "Could not restore %s and %s is absent; "
+                            "keeping the trash dir (it may hold the only "
+                            "copy of the artifact)", entry.path, dest,
+                        )
+                continue
+            shutil.rmtree(entry.path, ignore_errors=True)
+            swept.append(entry.name)
+            logger.info("Swept leftover store dir %s", entry.path)
+    return swept

@@ -1,0 +1,35 @@
+"""Typed artifact-integrity errors — the store's failure vocabulary.
+
+Every integrity violation a model artifact can exhibit maps to exactly
+one of these, so callers (server load path, ``/reload``, fsck, fleet
+resume) can route on TYPE instead of parsing prose: a missing manifest is
+a different operational fact (pre-store artifact, or a build that never
+finished committing) than a checksum mismatch (bit rot, torn write,
+tampering). All inherit :class:`StoreError`, so "any integrity problem"
+is one ``except`` clause — and StoreError inherits ``RuntimeError``, NOT
+``ValueError``: the server's scoring guard maps ``ValueError`` to a
+client 400, and a corrupt artifact is never the client's fault.
+"""
+
+from __future__ import annotations
+
+
+class StoreError(RuntimeError):
+    """Base for every artifact-store integrity failure."""
+
+
+class ManifestMissing(StoreError):
+    """The artifact directory has no ``MANIFEST.json`` — either it predates
+    the store (never atomically committed) or the commit never finished."""
+
+
+class ArtifactIncomplete(StoreError):
+    """A file the manifest promises is absent, or a generation root's
+    ``CURRENT`` pointer names a generation that does not exist — the
+    artifact is structurally torn."""
+
+
+class ArtifactCorrupt(StoreError):
+    """Bytes on disk disagree with the manifest (size or SHA-256 mismatch,
+    unparseable manifest, unsupported format version) — the artifact must
+    not be deserialized."""

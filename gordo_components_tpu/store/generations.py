@@ -1,0 +1,222 @@
+"""Generational artifact layout: every build lands beside its predecessors.
+
+A *generation root* is a machine's model directory once it holds::
+
+    <machine>/
+      gen-0001/            # a whole, manifested artifact (atomic_commit)
+      gen-0002/
+      CURRENT              # one line: the generation name to serve
+
+``CURRENT`` is the single source of truth for "which bytes serve" and is
+swapped atomically (write sidecar, fsync, ``os.replace``, fsync dir), so
+a reader never observes a half-updated pointer. Rolling back is just
+pointing ``CURRENT`` at the newest PREVIOUS generation that verifies —
+the artifact bytes were never mutated, so rollback is O(pointer-swap).
+
+Flat pre-generation artifacts (``definition.json`` directly in the model
+dir) resolve through unchanged (:func:`resolve_artifact_dir` is a
+pass-through), so generation roots and legacy dirs coexist in one models
+tree — but verified load still requires a manifest, so pre-store
+artifacts need a one-time ``tools/store_fsck.py --adopt`` (which hashes
+the existing files and writes their ``MANIFEST.json``) before they load.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+from ..observability.registry import REGISTRY
+from .atomic import atomic_commit, atomic_write_file
+from .errors import ArtifactIncomplete, StoreError
+from .manifest import verify_artifact
+
+logger = logging.getLogger(__name__)
+
+GEN_PREFIX = "gen-"
+CURRENT_FILE = "CURRENT"
+KEEP_GENERATIONS_ENV = "GORDO_STORE_KEEP_GENERATIONS"
+_GEN_RE = re.compile(r"^gen-(\d{4,})$")
+
+_M_ROLLBACKS = REGISTRY.counter(
+    "gordo_store_rollbacks_total",
+    "Generation rollbacks performed, by outcome (ok / failed)",
+    labels=("outcome",),
+)
+
+
+def is_generation_root(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, CURRENT_FILE))
+
+
+def _gen_num(name: str) -> int:
+    return int(_GEN_RE.match(name).group(1))
+
+
+def list_generations(root: str) -> List[str]:
+    """Generation dir names under ``root``, oldest first (NUMERIC order —
+    names grow past 4 digits, where lexicographic sorting would put
+    gen-10000 before gen-9999)."""
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return []
+    return sorted(
+        (
+            name for name in entries
+            if _GEN_RE.match(name) and os.path.isdir(os.path.join(root, name))
+        ),
+        key=_gen_num,
+    )
+
+
+def current_generation(root: str) -> Optional[str]:
+    """The generation name ``CURRENT`` points at, or ``None`` for flat /
+    absent roots. A malformed pointer raises :class:`ArtifactIncomplete`
+    — a generation root whose pointer is garbage is torn, not legacy."""
+    path = os.path.join(root, CURRENT_FILE)
+    if not os.path.isfile(path):
+        return None
+    with open(path) as fh:
+        name = fh.read().strip()
+    if not _GEN_RE.match(name):
+        raise ArtifactIncomplete(
+            f"{root}: {CURRENT_FILE} contains {name!r}, not a generation name"
+        )
+    return name
+
+
+def resolve_artifact_dir(path: str) -> str:
+    """The directory actually holding artifact files: follow ``CURRENT``
+    for generation roots, pass flat dirs through. Raises
+    :class:`ArtifactIncomplete` when the pointer names a missing dir."""
+    gen = current_generation(path)
+    if gen is None:
+        return path
+    target = os.path.join(path, gen)
+    if not os.path.isdir(target):
+        raise ArtifactIncomplete(
+            f"{path}: {CURRENT_FILE} points at {gen!r} which does not exist"
+        )
+    return target
+
+
+def _swap_current(root: str, gen_name: str) -> None:
+    """Atomically repoint ``CURRENT``: readers see the old pointer or the
+    new one, never a torn write; concurrent swappers (rollback racing a
+    commit) each use their own sidecar, last replace wins cleanly."""
+    atomic_write_file(os.path.join(root, CURRENT_FILE), gen_name + "\n")
+
+
+def next_generation_name(root: str) -> str:
+    gens = list_generations(root)
+    if not gens:
+        return f"{GEN_PREFIX}0001"
+    return f"{GEN_PREFIX}{_gen_num(gens[-1]) + 1:04d}"
+
+
+def commit_generation(
+    root: str,
+    write_fn: Callable[[str], Any],
+    name: Optional[str] = None,
+    keep: Optional[int] = None,
+) -> str:
+    """Write a new generation under ``root`` and adopt it: ``write_fn``
+    fills a staging dir, the atomic-commit machinery manifests and
+    publishes it as ``gen-NNNN``, then ``CURRENT`` swaps to it. Returns
+    the new generation's path.
+
+    ``keep`` bounds retained generations (newest kept; default from
+    ``GORDO_STORE_KEEP_GENERATIONS``, else 3 — always ≥ 2 so one
+    rollback target survives). ``name`` targets the ``store-commit``
+    fault seam (pass the machine name)."""
+    if keep is None:
+        keep = int(os.environ.get(KEEP_GENERATIONS_ENV, "3"))
+    keep = max(2, keep)
+    os.makedirs(root, exist_ok=True)
+    gen_name = next_generation_name(root)
+    gen_dir = os.path.join(root, gen_name)
+    with atomic_commit(gen_dir, name=name) as staging:
+        write_fn(staging)
+    _swap_current(root, gen_name)
+    _prune(root, keep)
+    return gen_dir
+
+
+def _prune(root: str, keep: int) -> None:
+    import shutil
+
+    gens = list_generations(root)
+    current = current_generation(root)
+    doomed = [g for g in gens[:-keep] if g != current] if len(gens) > keep else []
+    for gen in doomed:
+        shutil.rmtree(os.path.join(root, gen), ignore_errors=True)
+        logger.info("Pruned old generation %s/%s", root, gen)
+
+
+def rollback_generation(root: str) -> str:
+    """Repoint ``CURRENT`` at the newest PREVIOUS generation that passes
+    verification; returns its path. Raises :class:`StoreError` when there
+    is no verified predecessor (nothing safe to roll back to).
+
+    A MALFORMED ``CURRENT`` (bit rot, hand edit) does not block recovery:
+    the corrupt pointer names nothing, so every on-disk generation is a
+    candidate and the newest one that verifies wins — this is exactly the
+    corrupt-pointer case rollback exists to repair."""
+    if not os.path.isfile(os.path.join(root, CURRENT_FILE)):
+        _M_ROLLBACKS.labels("failed").inc()
+        raise StoreError(
+            f"{root} is not a generation root (no {CURRENT_FILE}); "
+            "flat artifacts have nothing to roll back to"
+        )
+    try:
+        current = current_generation(root)
+    except ArtifactIncomplete:
+        current = None  # garbage pointer: any verified generation beats it
+    if current is None:
+        previous = list_generations(root)
+    else:
+        previous = [
+            g for g in list_generations(root)
+            if _gen_num(g) < _gen_num(current)
+        ]
+    for gen in reversed(previous):
+        candidate = os.path.join(root, gen)
+        try:
+            verify_artifact(candidate)
+        except StoreError as exc:
+            logger.warning(
+                "Rollback skipping unverifiable generation %s: %s",
+                candidate, exc,
+            )
+            continue
+        _swap_current(root, gen)
+        _M_ROLLBACKS.labels("ok").inc()
+        logger.info("Rolled back %s: %s -> %s", root, current, gen)
+        return candidate
+    _M_ROLLBACKS.labels("failed").inc()
+    raise StoreError(
+        f"{root}: no previous generation verifies (current {current}, "
+        f"candidates {previous or 'none'})"
+    )
+
+
+def artifact_status(path: str) -> Dict[str, Any]:
+    """Integrity snapshot for one model dir (flat or generational):
+    ``{"generation", "generations", "verified", "error"}`` — the facet
+    ``/healthz``, watchman, and fsck all read."""
+    status: Dict[str, Any] = {
+        "generation": None,
+        "generations": list_generations(path),
+        "verified": False,
+        "error": None,
+    }
+    try:
+        status["generation"] = current_generation(path)
+        verify_artifact(resolve_artifact_dir(path))
+        status["verified"] = True
+    except StoreError as exc:
+        status["error"] = f"{type(exc).__name__}: {exc}"
+    return status

@@ -1,0 +1,115 @@
+"""Build journal: a write-ahead log that makes fleet builds resumable.
+
+One JSON object per line, fsync'd per append, recording each machine's
+build lifecycle::
+
+    {"machine": "m-1", "event": "started",   "cache_key": "…", "t": "…"}
+    {"machine": "m-1", "event": "committed", "model_dir": "…", "t": "…"}
+    {"machine": "m-2", "event": "failed",    "error": "…",     "t": "…"}
+
+``replay`` folds the log to each machine's LAST event, which is all a
+resuming ``build_fleet`` needs: ``committed`` machines whose artifact
+still verifies are skipped, ``started``-without-``committed`` machines
+were torn mid-commit and rebuild, everything else is fresh work. A torn
+FINAL line (the append the crash interrupted) is expected and ignored —
+everything before it is intact because appends are fsync'd in order.
+
+Multi-host builds write one journal per process (``build_journal.jsonl``
++ ``.p<i>`` siblings on shared storage, the fleet-manifest pattern);
+``replay`` unions the siblings so every process agrees on who is done.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import time
+from typing import Any, Dict
+
+logger = logging.getLogger(__name__)
+
+JOURNAL_FILE = "build_journal.jsonl"
+
+EVENT_STARTED = "started"
+EVENT_COMMITTED = "committed"
+EVENT_FAILED = "failed"
+
+
+def journal_path(output_dir: str, process_index: int = 0) -> str:
+    """This process's journal file (non-zero processes get a suffix so
+    concurrent writers on shared storage never interleave appends)."""
+    path = os.path.join(output_dir, JOURNAL_FILE)
+    return path if process_index == 0 else f"{path}.p{process_index}"
+
+
+class BuildJournal:
+    """Append-only, fsync-per-record writer for one process's journal."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def record(self, machine: str, event: str, **fields: Any) -> None:
+        payload = {
+            "machine": machine,
+            "event": event,
+            "t": time.strftime("%Y-%m-%d %H:%M:%S%z"),
+            **fields,
+        }
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        with open(self.path, "a") as fh:
+            fh.write(json.dumps(payload, sort_keys=True) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+
+
+def replay(output_dir_or_path: str) -> Dict[str, Dict[str, Any]]:
+    """Fold the journal (and any multi-host siblings) to
+    ``{machine: last_record}``. Unreadable files and a torn trailing line
+    degrade to "less resume", never to an error — the WAL accelerates a
+    re-run, it must not be able to block one."""
+    path = output_dir_or_path
+    if os.path.isdir(path):
+        path = os.path.join(path, JOURNAL_FILE)
+    states: Dict[str, Dict[str, Any]] = {}
+    for journal_file in [path] + sorted(glob.glob(path + ".p*")):
+        if not os.path.isfile(journal_file):
+            continue
+        try:
+            with open(journal_file) as fh:
+                lines = fh.readlines()
+        except OSError as exc:
+            logger.warning("Build journal %s unreadable: %s", journal_file, exc)
+            continue
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                if i == len(lines) - 1:
+                    logger.info(
+                        "Build journal %s: torn final line (crash mid-"
+                        "append); ignoring it", journal_file,
+                    )
+                else:
+                    logger.warning(
+                        "Build journal %s: unparseable line %d ignored",
+                        journal_file, i + 1,
+                    )
+                continue
+            machine = record.get("machine")
+            if isinstance(machine, str) and isinstance(record.get("event"), str):
+                states[machine] = record
+    return states
+
+
+def summarize(states: Dict[str, Dict[str, Any]]) -> Dict[str, int]:
+    counts = {EVENT_STARTED: 0, EVENT_COMMITTED: 0, EVENT_FAILED: 0}
+    for record in states.values():
+        event = record.get("event")
+        if event in counts:
+            counts[event] += 1
+    return counts

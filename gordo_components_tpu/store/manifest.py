@@ -1,0 +1,160 @@
+"""Checksummed artifact manifests: what "this model dir is whole" means.
+
+``MANIFEST.json`` sits beside the artifact files and records, per file,
+its SHA-256 and byte size plus a format version::
+
+    {
+      "format_version": 1,
+      "files": {
+        "definition.json": {"sha256": "…", "size": 1234},
+        "state.npz":       {"sha256": "…", "size": 56789},
+        ...
+      }
+    }
+
+The manifest is deliberately timestamp-free and serialized with sorted
+keys: the SAME file set always produces byte-identical manifest bytes,
+which is what lets a client compare the manifest SHA of a downloaded
+model against the server's (serializer ``dumps`` determinism rides on
+this). Verification is content-only — extra files in the directory
+(``CURRENT`` pointers, leftover tooling droppings) are ignored; every
+file the manifest names must exist with matching size AND hash.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict
+
+from ..observability.registry import REGISTRY
+from .errors import ArtifactCorrupt, ArtifactIncomplete, ManifestMissing
+
+MANIFEST_FILE = "MANIFEST.json"
+FORMAT_VERSION = 1
+
+_M_VERIFY_FAILURES = REGISTRY.counter(
+    "gordo_store_verify_failures_total",
+    "Artifact manifest verifications that failed, by typed error",
+    labels=("error",),
+)
+
+_HASH_CHUNK = 1 << 20  # 1 MiB reads: state.npz can be GBs on plant fleets
+
+
+def file_sha256(path: str) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            chunk = fh.read(_HASH_CHUNK)
+            if not chunk:
+                break
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def manifest_for_dir(artifact_dir: str) -> Dict[str, Any]:
+    """Compute (not write) the manifest payload for every regular file in
+    ``artifact_dir`` except the manifest itself. Subdirectories are not
+    walked: the artifact format is flat by contract."""
+    files: Dict[str, Any] = {}
+    for entry in sorted(os.scandir(artifact_dir), key=lambda e: e.name):
+        if not entry.is_file() or entry.name == MANIFEST_FILE:
+            continue
+        files[entry.name] = {
+            "sha256": file_sha256(entry.path),
+            "size": entry.stat().st_size,
+        }
+    return {"format_version": FORMAT_VERSION, "files": files}
+
+
+def render_manifest(payload: Dict[str, Any]) -> bytes:
+    """Canonical bytes: sorted keys, 2-space indent, trailing newline —
+    the one rendering, so identical file sets hash identically."""
+    return (json.dumps(payload, indent=2, sort_keys=True) + "\n").encode()
+
+
+def write_manifest(artifact_dir: str, fsync: bool = True) -> Dict[str, Any]:
+    """Hash the directory's files and write ``MANIFEST.json`` beside them
+    (fsync'd by default — the manifest is the commit record)."""
+    payload = manifest_for_dir(artifact_dir)
+    path = os.path.join(artifact_dir, MANIFEST_FILE)
+    with open(path, "wb") as fh:
+        fh.write(render_manifest(payload))
+        if fsync:
+            fh.flush()
+            os.fsync(fh.fileno())
+    return payload
+
+
+def read_manifest(artifact_dir: str) -> Dict[str, Any]:
+    """Load and structurally validate the manifest; raises typed errors."""
+    path = os.path.join(artifact_dir, MANIFEST_FILE)
+    if not os.path.isfile(path):
+        _M_VERIFY_FAILURES.labels("ManifestMissing").inc()
+        raise ManifestMissing(f"{artifact_dir}: no {MANIFEST_FILE}")
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError) as exc:
+        _M_VERIFY_FAILURES.labels("ArtifactCorrupt").inc()
+        raise ArtifactCorrupt(
+            f"{artifact_dir}: unreadable {MANIFEST_FILE}: {exc}"
+        ) from exc
+    files = payload.get("files") if isinstance(payload, dict) else None
+    if not isinstance(files, dict):
+        _M_VERIFY_FAILURES.labels("ArtifactCorrupt").inc()
+        raise ArtifactCorrupt(
+            f"{artifact_dir}: {MANIFEST_FILE} has no 'files' mapping"
+        )
+    version = payload.get("format_version")
+    if version != FORMAT_VERSION:
+        _M_VERIFY_FAILURES.labels("ArtifactCorrupt").inc()
+        raise ArtifactCorrupt(
+            f"{artifact_dir}: unsupported manifest format_version "
+            f"{version!r} (this build reads {FORMAT_VERSION})"
+        )
+    return payload
+
+
+def verify_artifact(artifact_dir: str, deep: bool = True) -> Dict[str, Any]:
+    """Integrity check: manifest present and well-formed, every listed
+    file present with matching size and (with ``deep``) SHA-256. Returns
+    the manifest on success; raises :class:`ManifestMissing` /
+    :class:`ArtifactIncomplete` / :class:`ArtifactCorrupt` otherwise.
+    Size is checked before hashing so a truncated multi-GB state file
+    fails in a stat, not a full read.
+
+    ``deep=False`` skips the hash pass — a structural check (manifest +
+    existence + sizes) that catches torn writes (the dominant crash
+    failure mode) in O(stats) instead of O(artifact bytes). Resume scans
+    over thousand-machine fleets use it so an idempotent re-run stays
+    near-instant; anything that will actually DESERIALIZE the artifact
+    (``load``, fsck) must keep the full hash pass."""
+    payload = read_manifest(artifact_dir)
+    for name, entry in sorted(payload["files"].items()):
+        path = os.path.join(artifact_dir, name)
+        if not os.path.isfile(path):
+            _M_VERIFY_FAILURES.labels("ArtifactIncomplete").inc()
+            raise ArtifactIncomplete(
+                f"{artifact_dir}: manifest names {name!r} but the file "
+                "is missing"
+            )
+        size = os.path.getsize(path)
+        if size != entry.get("size"):
+            _M_VERIFY_FAILURES.labels("ArtifactCorrupt").inc()
+            raise ArtifactCorrupt(
+                f"{artifact_dir}: {name!r} is {size} bytes, manifest "
+                f"says {entry.get('size')}"
+            )
+        if not deep:
+            continue
+        digest = file_sha256(path)
+        if digest != entry.get("sha256"):
+            _M_VERIFY_FAILURES.labels("ArtifactCorrupt").inc()
+            raise ArtifactCorrupt(
+                f"{artifact_dir}: {name!r} SHA-256 mismatch "
+                f"({digest[:12]}… != manifest {str(entry.get('sha256'))[:12]}…)"
+            )
+    return payload

@@ -13,6 +13,8 @@ import os
 import re
 from typing import Optional
 
+from ..store.atomic import atomic_write_file
+
 logger = logging.getLogger(__name__)
 
 _KEY_RE = re.compile(r"^[A-Za-z0-9._-]+$")
@@ -28,22 +30,31 @@ def _key_path(registry_dir: str, key: str) -> str:
 
 
 def write_key(registry_dir: str, key: str, value: str) -> None:
+    # atomic AND durable (store.atomic.atomic_write_file): a registry
+    # entry that evaporates in a power cut would resurrect a completed
+    # build as pending on the next orchestrator retry
     os.makedirs(registry_dir, exist_ok=True)
-    path = _key_path(registry_dir, key)
-    # atomic-ish: write sidecar then rename, so readers never see partials
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        fh.write(value)
-    os.replace(tmp, path)
+    atomic_write_file(_key_path(registry_dir, key), value)
     logger.debug("Registry write %s -> %s", key, value)
 
 
 def get_value(registry_dir: str, key: str) -> Optional[str]:
+    """The registered model dir for ``key``, or ``None`` — including when
+    the entry exists but points at a directory that no longer does (lost
+    in a crash, or on storage that came back without it): an orchestrator
+    retry must rebuild rather than trust a dangling pointer."""
     path = _key_path(registry_dir, key)
     if not os.path.exists(path):
         return None
     with open(path) as fh:
-        return fh.read()
+        value = fh.read()
+    if not os.path.isdir(value):
+        logger.warning(
+            "Registry key %s points at missing model dir %r; treating as "
+            "unregistered (the next build will re-register it)", key, value,
+        )
+        return None
+    return value
 
 
 def delete_key(registry_dir: str, key: str) -> bool:

@@ -139,10 +139,14 @@ class WatchmanServer:
                 ),
                 "last_error": last_error or "",
                 "circuit": breaker.state,
+                "generation": None,
+                "verified": None,
             }
         started = time.perf_counter()
         error: Optional[str] = None
         reachable = True
+        generation: Optional[str] = None
+        verified: Optional[bool] = None
         try:
             # chaos seam: a `probe:<machine>:error` fault stands in for a
             # dead endpoint without anything actually dying
@@ -151,6 +155,21 @@ class WatchmanServer:
             healthy = response.status_code == 200
             if not healthy:
                 error = f"HTTP {response.status_code}"
+            # artifact-integrity facet (store/): the machine healthz body
+            # names the serving generation and its manifest-verify status —
+            # surface them per target so a fleet operator sees WHICH gen
+            # each machine runs (and a rollback taking effect) from one
+            # watchman GET. Absent/non-JSON bodies (old servers) skip it.
+            body = None
+            json_fn = getattr(response, "json", None)
+            if callable(json_fn):
+                try:
+                    body = json_fn()
+                except ValueError:
+                    body = None
+            if isinstance(body, dict):
+                generation = body.get("generation")
+                verified = body.get("verified")
             _M_PROBES.labels("healthy" if healthy else "unhealthy").inc()
         except (requests.RequestException, faults.FaultInjected) as exc:
             logger.warning("Watchman: %s unreachable: %r", machine, exc)
@@ -176,6 +195,10 @@ class WatchmanServer:
             "error": error or "",
             "last_error": last_error or "",
             "circuit": breaker.state,
+            # serving generation + manifest-verify status from the machine
+            # healthz body (None when the target predates the store)
+            "generation": generation,
+            "verified": verified,
         }
 
     def _build_progress(self) -> Optional[Dict]:

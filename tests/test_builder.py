@@ -145,11 +145,29 @@ def test_provide_saved_model_stale_registry(tmp_path):
 
 
 def test_disk_registry_basics(tmp_path):
-    d = str(tmp_path)
+    d = str(tmp_path / "reg")
+    model_dir = tmp_path / "model"
+    model_dir.mkdir()
     assert disk_registry.get_value(d, "abc123") is None
-    disk_registry.write_key(d, "abc123", "/some/dir")
-    assert disk_registry.get_value(d, "abc123") == "/some/dir"
+    disk_registry.write_key(d, "abc123", str(model_dir))
+    assert disk_registry.get_value(d, "abc123") == str(model_dir)
     assert disk_registry.delete_key(d, "abc123")
     assert not disk_registry.delete_key(d, "abc123")
     with pytest.raises(ValueError, match="filename"):
         disk_registry.write_key(d, "../escape", "x")
+
+
+def test_disk_registry_dangling_pointer_returns_none(tmp_path):
+    """A registry entry whose model dir vanished (crash, lost volume) must
+    read as unregistered — an orchestrator retry rebuilds instead of
+    trusting a pointer to nothing."""
+    d = str(tmp_path / "reg")
+    gone = tmp_path / "was-here"
+    gone.mkdir()
+    disk_registry.write_key(d, "k1", str(gone))
+    assert disk_registry.get_value(d, "k1") == str(gone)
+    gone.rmdir()
+    assert disk_registry.get_value(d, "k1") is None
+    # the entry file itself survives: re-creating the dir revives the key
+    gone.mkdir()
+    assert disk_registry.get_value(d, "k1") == str(gone)

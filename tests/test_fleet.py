@@ -717,12 +717,14 @@ def test_slice_checkpoint_restores_instead_of_retraining(tmp_path, monkeypatch):
     out = str(tmp_path / "fleet")
     registry = str(tmp_path / "reg")
 
-    real_dump = bf.dump
+    # the artifact-commit boundary is store.commit_generation now (atomic
+    # generation commits) — kill there, after training succeeded
+    real_commit = bf.commit_generation
 
-    def dying_dump(*args, **kwargs):
+    def dying_commit(*args, **kwargs):
         raise RuntimeError("killed before artifacts")
 
-    monkeypatch.setattr(bf, "dump", dying_dump)
+    monkeypatch.setattr(bf, "commit_generation", dying_commit)
     with pytest.raises(RuntimeError, match="killed before artifacts"):
         build_fleet(machines, out, model_register_dir=registry, mesh=mesh,
                     n_splits=2, slice_size=2)
@@ -743,7 +745,7 @@ def test_slice_checkpoint_restores_instead_of_retraining(tmp_path, monkeypatch):
         _time.sleep(0.2)
     assert finalized(), "slice checkpoint never finalized"
 
-    monkeypatch.setattr(bf, "dump", real_dump)
+    monkeypatch.setattr(bf, "commit_generation", real_commit)
     real_train = bf.train_fleet_arrays
     trains = {"n": 0}
 

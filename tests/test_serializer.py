@@ -359,6 +359,12 @@ def test_load_path_refuses_external_dotted_class(tmp_path):
     definition = {"subprocess.Popen": {"args": ["true"]}}
     with open(definition_path, "w") as fh:
         _json.dump(definition, fh)
+    # an attacker who can rewrite files can recompute the (unsigned)
+    # manifest too — re-sign so the test reaches the TRUST gate, which
+    # must hold even for integrity-clean artifacts
+    from gordo_components_tpu.store import write_manifest
+
+    write_manifest(model_dir)
     with pytest.raises(ValueError, match="external dotted path"):
         load(model_dir)
 
@@ -384,6 +390,11 @@ def test_load_path_refuses_external_function_transformer_func(tmp_path):
     )
     with open(definition_path, "w") as fh:
         fh.write(text)
+    # re-sign the manifest (see test above): the lazy-resolution trust
+    # gate is the defense under test, not the integrity check
+    from gordo_components_tpu.store import write_manifest
+
+    write_manifest(model_dir)
     loaded = load(model_dir)  # builds fine: func is lazy
     with pytest.raises(ValueError, match="external dotted path"):
         loaded.transform(X)

@@ -1,0 +1,631 @@
+"""Crash-safe model store tests (ISSUE 3): atomic commits, checksummed
+manifests, the torn-write matrix (every kill/corrupt point in the commit
+sequence must surface as a typed error or an intact previous generation —
+NEVER a silently half-loaded pipeline), generations + rollback, the
+resumable-build journal, and the fleet build's resume accounting."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu import store
+from gordo_components_tpu.models.pipeline import Pipeline
+from gordo_components_tpu.models.transformers import MinMaxScaler
+from gordo_components_tpu.resilience import faults
+from gordo_components_tpu.serializer import dump, dumps, load, loads
+from gordo_components_tpu.serializer.persistence import (
+    DEFINITION_FILE,
+    STATE_FILE,
+    STATE_META_FILE,
+    write_artifact_files,
+)
+from gordo_components_tpu.store import (
+    ArtifactCorrupt,
+    ArtifactIncomplete,
+    BuildJournal,
+    ManifestMissing,
+    StoreError,
+)
+from gordo_components_tpu.store import journal as store_journal
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _fitted_pipeline(seed=0, scale=1.0):
+    X = np.random.default_rng(seed).normal(size=(32, 3)).astype(np.float32)
+    pipe = Pipeline([MinMaxScaler()])
+    pipe.fit(X * scale)
+    return pipe, X
+
+
+# ------------------------------------------------------------ atomic dump
+def test_dump_writes_manifest_and_verifies(tmp_path):
+    pipe, X = _fitted_pipeline()
+    out = str(tmp_path / "model")
+    dump(pipe, out, metadata={"name": "m"})
+    manifest = store.verify_artifact(out)
+    assert set(manifest["files"]) == {
+        DEFINITION_FILE, STATE_FILE, STATE_META_FILE, "metadata.json",
+    }
+    np.testing.assert_allclose(load(out).transform(X), pipe.transform(X))
+
+
+def test_crash_mid_staging_leaves_destination_untouched(tmp_path):
+    """A kill between 'files written' and 'commit' (store-commit error
+    fault = simulated SIGKILL) must leave the previous artifact serving
+    and only inert .staging-* debris behind."""
+    pipe, X = _fitted_pipeline(0)
+    pipe2, _ = _fitted_pipeline(1, scale=5.0)
+    out = str(tmp_path / "model")
+    dump(pipe, out)
+    expected = pipe.transform(X)
+    faults.configure("store-commit:model:error")
+    with pytest.raises(faults.FaultInjected):
+        dump(pipe2, out)
+    faults.clear()
+    # previous artifact intact and verified; debris is hidden + sweepable
+    np.testing.assert_allclose(load(out).transform(X), expected)
+    debris = [n for n in os.listdir(tmp_path) if n.startswith(".staging-")]
+    assert debris
+    assert store.sweep_leftovers(str(tmp_path)) == debris
+
+
+# ----------------------------------------------------- torn-write matrix
+@pytest.mark.parametrize(
+    "victim",
+    [DEFINITION_FILE, STATE_FILE, STATE_META_FILE, "metadata.json"],
+)
+@pytest.mark.parametrize("damage", ["delete", "truncate", "bitflip"])
+def test_torn_write_matrix_raises_typed_error(tmp_path, victim, damage):
+    """Every (file, damage) combination must raise a typed StoreError from
+    load() — the artifact is never silently half-loaded."""
+    pipe, _ = _fitted_pipeline()
+    out = str(tmp_path / "model")
+    dump(pipe, out, metadata={"name": "m"})
+    path = os.path.join(out, victim)
+    if damage == "delete":
+        os.unlink(path)
+        expected = ArtifactIncomplete
+    elif damage == "truncate":
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(size // 2)
+        expected = ArtifactCorrupt
+    else:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.seek(size // 2)
+            byte = fh.read(1)
+            fh.seek(size // 2)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+        expected = ArtifactCorrupt
+    with pytest.raises(expected):
+        load(out)
+
+
+def test_manifest_missing_and_tampered(tmp_path):
+    pipe, _ = _fitted_pipeline()
+    out = str(tmp_path / "model")
+    dump(pipe, out)
+    manifest_path = os.path.join(out, store.MANIFEST_FILE)
+    # bit-flip one manifest hash entry: bytes no longer agree
+    with open(manifest_path) as fh:
+        payload = json.load(fh)
+    entry = payload["files"][STATE_FILE]["sha256"]
+    payload["files"][STATE_FILE]["sha256"] = (
+        ("0" if entry[0] != "0" else "1") + entry[1:]
+    )
+    with open(manifest_path, "w") as fh:
+        json.dump(payload, fh)
+    with pytest.raises(ArtifactCorrupt):
+        load(out)
+    # missing manifest is its own typed fact (pre-store or never committed)
+    os.unlink(manifest_path)
+    with pytest.raises(ManifestMissing):
+        load(out)
+    # and unparseable manifest is corruption, not a crash
+    with open(manifest_path, "w") as fh:
+        fh.write("{not json")
+    with pytest.raises(ArtifactCorrupt):
+        load(out)
+
+
+def test_shallow_verify_catches_structure_not_content(tmp_path):
+    """deep=False is the O(stats) resume check: it must catch missing and
+    truncated files (the crash-tear modes) but deliberately skips the
+    hash pass — content rot is caught by load()'s full verification."""
+    pipe, _ = _fitted_pipeline()
+    out = str(tmp_path / "model")
+    dump(pipe, out)
+    path = os.path.join(out, STATE_FILE)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:  # bitflip: size unchanged
+        fh.seek(size // 2)
+        byte = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    store.verify_artifact(out, deep=False)  # structural: passes
+    with pytest.raises(ArtifactCorrupt):
+        store.verify_artifact(out)  # full hash: catches it
+    with open(path, "r+b") as fh:
+        fh.truncate(size // 2)
+    with pytest.raises(ArtifactCorrupt):  # truncation: even shallow sees it
+        store.verify_artifact(out, deep=False)
+
+
+def test_store_errors_are_not_value_errors():
+    """The server maps ValueError to a client 400; a corrupt artifact is
+    never the client's fault, so the store types must not be ValueError."""
+    for exc_type in (StoreError, ManifestMissing, ArtifactIncomplete,
+                     ArtifactCorrupt):
+        assert not issubclass(exc_type, ValueError)
+        assert issubclass(exc_type, StoreError)
+
+
+# ----------------------------------------------------------- generations
+def test_generations_commit_resolve_rollback(tmp_path):
+    root = str(tmp_path / "mach")
+    pipe1, X = _fitted_pipeline(0)
+    pipe2, _ = _fitted_pipeline(1, scale=4.0)
+    store.commit_generation(root, lambda s: write_artifact_files(pipe1, s))
+    store.commit_generation(root, lambda s: write_artifact_files(pipe2, s))
+    assert store.list_generations(root) == ["gen-0001", "gen-0002"]
+    assert store.current_generation(root) == "gen-0002"
+    np.testing.assert_allclose(load(root).transform(X), pipe2.transform(X))
+
+    restored = store.rollback_generation(root)
+    assert restored.endswith("gen-0001")
+    assert store.current_generation(root) == "gen-0001"
+    np.testing.assert_allclose(load(root).transform(X), pipe1.transform(X))
+    # nothing older to fall back to
+    with pytest.raises(StoreError):
+        store.rollback_generation(root)
+    # flat dirs have no generations at all
+    flat = str(tmp_path / "flat")
+    dump(pipe1, flat)
+    with pytest.raises(StoreError):
+        store.rollback_generation(flat)
+
+
+def test_corrupt_current_generation_raises_then_rolls_back(tmp_path):
+    """A store-commit truncate fault yields a committed-but-torn CURRENT
+    generation: load raises typed, rollback restores the previous verified
+    generation, and the corrupt one is skipped as a rollback target."""
+    root = str(tmp_path / "mach")
+    pipe1, X = _fitted_pipeline(0)
+    pipe2, _ = _fitted_pipeline(1, scale=3.0)
+    store.commit_generation(
+        root, lambda s: write_artifact_files(pipe1, s), name="mach"
+    )
+    faults.configure(f"store-commit:mach:truncate:{STATE_FILE}")
+    store.commit_generation(
+        root, lambda s: write_artifact_files(pipe2, s), name="mach"
+    )
+    faults.clear()
+    assert store.current_generation(root) == "gen-0002"
+    with pytest.raises(ArtifactCorrupt):
+        load(root)
+    status = store.artifact_status(root)
+    assert status["verified"] is False
+    assert "ArtifactCorrupt" in status["error"]
+    store.rollback_generation(root)
+    np.testing.assert_allclose(load(root).transform(X), pipe1.transform(X))
+    assert store.artifact_status(root)["verified"] is True
+
+
+def test_rollback_recovers_from_corrupt_current_pointer(tmp_path):
+    """A malformed CURRENT pointer (bit rot, hand edit) must not block
+    rollback — that is exactly the corrupt-pointer case rollback repairs:
+    every on-disk generation is a candidate, newest verified wins."""
+    root = str(tmp_path / "mach")
+    pipe1, X = _fitted_pipeline(0)
+    pipe2, _ = _fitted_pipeline(1, scale=2.0)
+    store.commit_generation(root, lambda s: write_artifact_files(pipe1, s))
+    store.commit_generation(root, lambda s: write_artifact_files(pipe2, s))
+    with open(os.path.join(root, store.CURRENT_FILE), "w") as fh:
+        fh.write("!!garbage!!\n")
+    with pytest.raises(ArtifactIncomplete):
+        load(root)
+    restored = store.rollback_generation(root)
+    assert restored.endswith("gen-0002")  # newest verified generation
+    np.testing.assert_allclose(load(root).transform(X), pipe2.transform(X))
+
+
+def test_torn_current_pointer_is_typed(tmp_path):
+    root = str(tmp_path / "mach")
+    pipe1, _ = _fitted_pipeline()
+    store.commit_generation(root, lambda s: write_artifact_files(pipe1, s))
+    with open(os.path.join(root, store.CURRENT_FILE), "w") as fh:
+        fh.write("gen-9999\n")  # points at nothing
+    with pytest.raises(ArtifactIncomplete):
+        load(root)
+    with open(os.path.join(root, store.CURRENT_FILE), "w") as fh:
+        fh.write("../escape\n")  # not a generation name at all
+    with pytest.raises(ArtifactIncomplete):
+        load(root)
+
+
+def test_generation_pruning_keeps_rollback_target(tmp_path):
+    root = str(tmp_path / "mach")
+    pipe, _ = _fitted_pipeline()
+    for _ in range(5):
+        store.commit_generation(
+            root, lambda s: write_artifact_files(pipe, s), keep=2
+        )
+    gens = store.list_generations(root)
+    assert gens == ["gen-0004", "gen-0005"]  # newest kept, numbering monotonic
+    assert store.current_generation(root) == "gen-0005"
+    store.rollback_generation(root)  # a rollback target always survives
+
+
+# ------------------------------------------------- deterministic blobs
+def test_dumps_is_byte_deterministic():
+    pipe, X = _fitted_pipeline()
+    blob1, blob2 = dumps(pipe), dumps(pipe)
+    assert blob1 == blob2
+    np.testing.assert_allclose(loads(blob1).transform(X), pipe.transform(X))
+
+
+def test_dumps_tar_headers_are_normalized():
+    import io
+    import tarfile
+
+    pipe, _ = _fitted_pipeline()
+    with tarfile.open(fileobj=io.BytesIO(dumps(pipe)), mode="r:gz") as tar:
+        members = tar.getmembers()
+        assert [m.name for m in members] == sorted(m.name for m in members)
+        for member in members:
+            assert member.mtime == 0
+            assert member.uid == 0 and member.gid == 0
+            assert member.uname == "" and member.gname == ""
+
+
+def test_downloaded_blob_manifest_matches_disk_artifact(tmp_path):
+    """The per-file hashes of a dumps() blob must equal the on-disk
+    artifact's manifest entries — what lets a client prove a downloaded
+    model is the very bytes the server serves."""
+    pipe, _ = _fitted_pipeline()
+    out = str(tmp_path / "model")
+    dump(pipe, out)
+    disk_manifest = store.read_manifest(out)
+
+    import io
+    import tarfile
+
+    with tarfile.open(fileobj=io.BytesIO(dumps(pipe)), mode="r:gz") as tar:
+        tar.extractall(str(tmp_path / "blob"), filter="data")
+    blob_manifest = store.read_manifest(str(tmp_path / "blob"))
+    assert blob_manifest["files"] == disk_manifest["files"]
+
+
+# ----------------------------------------------------- bounded extraction
+def _tar_blob(members):
+    """gzip'd tar of (name, bytes) pairs, for hostile-blob tests."""
+    import gzip
+    import io
+    import tarfile
+
+    buffer = io.BytesIO()
+    with gzip.GzipFile(fileobj=buffer, mode="wb", mtime=0) as gz:
+        with tarfile.open(fileobj=gz, mode="w") as tar:
+            for name, data in members:
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+    return buffer.getvalue()
+
+
+def test_loads_rejects_too_many_members():
+    blob = _tar_blob([(f"f{i}", b"x") for i in range(200)])
+    with pytest.raises(ValueError, match="members"):
+        loads(blob)
+
+
+def test_loads_rejects_decompression_bomb(monkeypatch):
+    monkeypatch.setenv("GORDO_MAX_ARTIFACT_BYTES", "1024")
+    blob = _tar_blob([("state.npz", b"\x00" * 4096)])
+    with pytest.raises(ValueError, match="decompressed bytes"):
+        loads(blob)
+
+
+def test_loads_rejects_duplicate_members():
+    blob = _tar_blob([("definition.json", b"{}"), ("definition.json", b"{}")])
+    with pytest.raises(ValueError, match="repeats member"):
+        loads(blob)
+
+
+def test_loads_member_bomb_bails_without_enumerating(monkeypatch):
+    """The guard must stream headers and bail at the first violation —
+    enumerating a million-member tar up front would OOM the guard itself.
+    Proxy: a 100k-member blob must be rejected near-instantly."""
+    import time
+
+    blob = _tar_blob([(f"f{i}", b"") for i in range(100_000)])
+    started = time.perf_counter()
+    with pytest.raises(ValueError, match="members"):
+        loads(blob)
+    assert time.perf_counter() - started < 2.0
+
+
+def test_sweep_restores_trash_when_commit_window_crashed(tmp_path):
+    """A crash between commit_dir's rename-aside and rename-in leaves the
+    ONLY copy of the artifact in .trash-*: sweep must restore it, not
+    delete it — and must still delete trash whose replacement landed."""
+    pipe, X = _fitted_pipeline()
+    out = str(tmp_path / "model")
+    dump(pipe, out)
+    # simulate the window: dest renamed aside, new dir never renamed in
+    os.rename(out, str(tmp_path / ".trash-model.deadbeef"))
+    swept = store.sweep_leftovers(str(tmp_path))
+    assert any("restored as model" in s for s in swept)
+    np.testing.assert_allclose(load(out).transform(X), pipe.transform(X))
+    # a trash dir whose replacement DID land is true garbage
+    os.makedirs(str(tmp_path / ".trash-model.cafecafe"))
+    swept = store.sweep_leftovers(str(tmp_path))
+    assert ".trash-model.cafecafe" in swept
+    assert os.path.isdir(out)
+
+
+# --------------------------------------------------------------- journal
+def test_journal_record_replay_and_torn_tail(tmp_path):
+    path = str(tmp_path / "out" / store_journal.JOURNAL_FILE)
+    journal = BuildJournal(path)
+    journal.record("m-1", "started", cache_key="k1")
+    journal.record("m-1", "committed", cache_key="k1", model_dir="/d/m-1")
+    journal.record("m-2", "started", cache_key="k2")
+    journal.record("m-3", "failed", error="boom")
+    # simulate a crash mid-append: torn trailing line
+    with open(path, "a") as fh:
+        fh.write('{"machine": "m-4", "ev')
+    states = store_journal.replay(str(tmp_path / "out"))
+    assert states["m-1"]["event"] == "committed"
+    assert states["m-2"]["event"] == "started"
+    assert states["m-3"]["event"] == "failed"
+    assert "m-4" not in states
+    assert store_journal.summarize(states) == {
+        "started": 1, "committed": 1, "failed": 1,
+    }
+
+
+def test_journal_multihost_union(tmp_path):
+    out = str(tmp_path)
+    BuildJournal(store_journal.journal_path(out, 0)).record(
+        "m-a", "committed", model_dir="/d/a"
+    )
+    BuildJournal(store_journal.journal_path(out, 1)).record(
+        "m-b", "committed", model_dir="/d/b"
+    )
+    states = store_journal.replay(out)
+    assert set(states) == {"m-a", "m-b"}
+
+
+def test_journal_replay_missing_is_empty(tmp_path):
+    assert store_journal.replay(str(tmp_path)) == {}
+
+
+# ----------------------------------------- fleet build: resumable via WAL
+FLEET_MODEL = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                  "dims": [4], "epochs": 1,
+                                  "batch_size": 32}},
+        ]
+    }
+}
+
+
+def _fleet_machines(n):
+    from gordo_components_tpu.parallel import FleetMachineConfig
+
+    return [
+        FleetMachineConfig(
+            name=f"jm-{i}",
+            model_config=FLEET_MODEL,
+            data_config={
+                "type": "RandomDataset",
+                "train_start_date": "2023-01-01T00:00:00+00:00",
+                "train_end_date": "2023-01-02T00:00:00+00:00",
+                "tag_list": [f"j{i}-a", f"j{i}-b"],
+            },
+        )
+        for i in range(n)
+    ]
+
+
+def test_build_fleet_journal_resume_after_mid_fleet_kill(tmp_path):
+    """Acceptance: a build-fleet re-run after a mid-fleet kill rebuilds
+    ONLY the non-committed machines, asserted via the journal counts the
+    fleet manifest reports."""
+    from gordo_components_tpu.parallel import build_fleet
+    from gordo_components_tpu.parallel.build_fleet import MANIFEST_FILE
+
+    machines = _fleet_machines(3)
+    out = str(tmp_path / "fleet")
+    registry = str(tmp_path / "registry")
+
+    # run 1: the commit of jm-1 is killed mid-staging (store-commit error
+    # fault = simulated SIGKILL inside the artifact loop)
+    faults.configure("store-commit:jm-1:error")
+    with pytest.raises(faults.FaultInjected):
+        build_fleet(machines, out, model_register_dir=registry,
+                    n_splits=0, slice_size=1)
+    faults.clear()
+
+    states = store_journal.replay(out)
+    assert states["jm-0"]["event"] == "committed"
+    assert states["jm-1"]["event"] == "started"  # torn: started, never done
+    assert "jm-2" not in states
+
+    # run 2: resumes — jm-0 skipped (verified), jm-1 + jm-2 rebuilt
+    dirs = build_fleet(machines, out, model_register_dir=registry,
+                       n_splits=0, slice_size=1)
+    assert set(dirs) == {"jm-0", "jm-1", "jm-2"}
+    manifest = json.load(open(os.path.join(out, MANIFEST_FILE)))
+    assert manifest["journal"] == {"resumed": 1, "torn": 0, "rebuilt": 2}
+    for model_dir in dirs.values():
+        store.verify_artifact(store.resolve_artifact_dir(model_dir))
+        load(model_dir)
+
+    # run 3: everything cached
+    dirs3 = build_fleet(machines, out, model_register_dir=registry,
+                        n_splits=0, slice_size=1)
+    assert dirs3 == dirs
+    manifest = json.load(open(os.path.join(out, MANIFEST_FILE)))
+    assert manifest["journal"] == {"resumed": 3, "torn": 0, "rebuilt": 0}
+
+
+def test_build_fleet_redoes_torn_registered_artifact(tmp_path):
+    """A registry hit whose artifact no longer verifies (bit rot, torn
+    write) counts as 'torn' and is rebuilt — the resume path trusts
+    nothing unverified."""
+    from gordo_components_tpu.parallel import build_fleet
+    from gordo_components_tpu.parallel.build_fleet import MANIFEST_FILE
+
+    machines = _fleet_machines(1)
+    out = str(tmp_path / "fleet")
+    registry = str(tmp_path / "registry")
+    dirs = build_fleet(machines, out, model_register_dir=registry,
+                       n_splits=0)
+    gen_dir = store.resolve_artifact_dir(dirs["jm-0"])
+    state_path = os.path.join(gen_dir, STATE_FILE)
+    with open(state_path, "r+b") as fh:
+        fh.truncate(os.path.getsize(state_path) // 2)
+    with pytest.raises(ArtifactCorrupt):
+        load(dirs["jm-0"])
+
+    dirs2 = build_fleet(machines, out, model_register_dir=registry,
+                        n_splits=0)
+    manifest = json.load(open(os.path.join(out, MANIFEST_FILE)))
+    assert manifest["journal"]["torn"] == 1
+    assert manifest["journal"]["rebuilt"] == 1
+    load(dirs2["jm-0"])  # whole again (a fresh generation)
+
+
+# --------------------------------------------- server integration facets
+def test_server_quarantines_corrupt_generation_and_reload_recovers(tmp_path):
+    """A corrupt CURRENT generation must 503-quarantine (typed store error
+    recorded), keep the fleet serving, and recover via /reload + rollback
+    — never 500 or silently serve half a model."""
+    from werkzeug.test import Client
+
+    from gordo_components_tpu.server import build_app
+
+    root = tmp_path / "models"
+    root.mkdir()
+    good, X = _fitted_pipeline(0)
+    bad_pipe, _ = _fitted_pipeline(1, scale=2.0)
+    for name, pipe in (("m-ok", good), ("m-bad", bad_pipe)):
+        store.commit_generation(
+            str(root / name),
+            lambda s, p=pipe: write_artifact_files(
+                p, s, metadata={"name": name}
+            ),
+        )
+    # second (corrupt) generation for m-bad
+    faults.configure(f"store-commit:m-bad:truncate:{STATE_FILE}")
+    store.commit_generation(
+        str(root / "m-bad"),
+        lambda s: write_artifact_files(bad_pipe, s, metadata={"name": "m-bad"}),
+        name="m-bad",
+    )
+    faults.clear()
+
+    app = build_app(
+        {"m-ok": str(root / "m-ok"), "m-bad": str(root / "m-bad")},
+        project="proj", models_root=str(root),
+    )
+    client = Client(app)
+    body = client.get("/healthz").get_json()
+    assert body["status"] == "degraded"
+    assert "m-bad" in body["quarantined"]
+    assert "ArtifactCorrupt" in body["quarantined"]["m-bad"]["error"]
+    assert body["store"]["generations"]["m-ok"] == "gen-0001"
+    assert "m-bad" in body["store"]["unverified"]
+    # machine-scoped: the healthy one reports its generation + verified
+    ok_body = client.get("/gordo/v0/proj/m-ok/healthz").get_json()
+    assert ok_body == {
+        "ok": True, "status": "ok", "generation": "gen-0001",
+        "verified": True,
+    }
+    assert client.get("/gordo/v0/proj/m-bad/healthz").status_code == 503
+
+    # operator rolls back the torn generation; /reload adopts it
+    store.rollback_generation(str(root / "m-bad"))
+    body = client.post("/reload").get_json()
+    assert "m-bad" in body["added"]
+    assert client.get("/gordo/v0/proj/m-bad/healthz").status_code == 200
+    assert client.get("/healthz").get_json()["status"] == "ok"
+
+
+def test_reload_refuses_unverified_generation_keeps_previous(tmp_path):
+    """A rebuild that lands torn must NOT displace the served (verified)
+    generation on /reload: the old model keeps answering."""
+    from werkzeug.test import Client
+
+    from gordo_components_tpu.server import build_app
+
+    root = tmp_path / "models"
+    root.mkdir()
+    pipe, X = _fitted_pipeline(0)
+    anchor, _ = _fitted_pipeline(1)
+    # m-anchor is the explicitly-registered machine; m-1 arrives via scan
+    # (pinned machines deliberately never refresh, so the
+    # refuse-unverified path under test is the SCANNED-machine one)
+    store.commit_generation(
+        str(root / "m-anchor"),
+        lambda s: write_artifact_files(anchor, s, metadata={"name": "m-anchor"}),
+    )
+    app = build_app({"m-anchor": str(root / "m-anchor")}, project="proj",
+                    models_root=str(root))
+    client = Client(app)
+    store.commit_generation(
+        str(root / "m-1"),
+        lambda s: write_artifact_files(pipe, s, metadata={"name": "m-1"}),
+    )
+    assert client.post("/reload").get_json()["added"] == ["m-1"]
+    assert client.get("/gordo/v0/proj/m-1/healthz").status_code == 200
+
+    faults.configure(f"store-commit:m-1:bitflip:{STATE_FILE}")
+    store.commit_generation(
+        str(root / "m-1"),
+        lambda s: write_artifact_files(pipe, s, metadata={"name": "m-1"}),
+        name="m-1",
+    )
+    faults.clear()
+    body = client.post("/reload").get_json()
+    assert "m-1" in body["errors"]
+    assert "ArtifactCorrupt" in body["errors"]["m-1"]
+    # still serving the previous generation's model object
+    assert client.get("/gordo/v0/proj/m-1/healthz").status_code == 200
+
+
+def test_cli_rollback_verb(tmp_path):
+    from click.testing import CliRunner
+
+    from gordo_components_tpu.cli import gordo
+
+    root = str(tmp_path / "mach")
+    pipe, _ = _fitted_pipeline()
+    store.commit_generation(root, lambda s: write_artifact_files(pipe, s))
+    store.commit_generation(root, lambda s: write_artifact_files(pipe, s))
+    runner = CliRunner()
+    result = runner.invoke(gordo, ["rollback", "--list", root])
+    assert result.exit_code == 0, result.output
+    status = json.loads(result.output)
+    assert status["generation"] == "gen-0002" and status["verified"] is True
+    result = runner.invoke(gordo, ["rollback", root])
+    assert result.exit_code == 0, result.output
+    assert result.output.strip().endswith("gen-0001")
+    assert store.current_generation(root) == "gen-0001"
+    # nothing left to roll back to -> permanent config exit code
+    result = runner.invoke(gordo, ["rollback", root])
+    assert result.exit_code == 64

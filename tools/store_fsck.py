@@ -1,0 +1,327 @@
+#!/usr/bin/env python
+"""Model-store fsck: verify every artifact manifest under a models root,
+report integrity per machine, and optionally repair (``make store-fsck``
+runs the self-test).
+
+What it checks, per machine dir:
+
+- generation roots: every ``gen-NNNN`` verifies against its manifest; the
+  ``CURRENT`` pointer resolves; the serving generation is whole.
+- flat legacy dirs: the dir verifies (or is reported ``ManifestMissing``
+  — pre-store artifacts are visible, not silently trusted).
+- crash debris: leftover ``.staging-*`` / ``.trash-*`` dirs are reported
+  (and swept with ``--sweep``).
+
+Repairs (``--quarantine``):
+
+- a corrupt NON-current generation is renamed to ``.quarantined-<gen>``
+  (out of the rollback candidate set, kept for forensics);
+- a corrupt CURRENT generation triggers a rollback to the newest previous
+  generation that verifies (service restored by pointer swap), then the
+  bad generation is quarantined; with no verified predecessor it is
+  reported and left — the serving layer's load-time verification already
+  refuses it.
+
+Exit codes: 0 = every machine verified (after repairs), 1 = at least one
+unverified machine remains, 2 = usage error.
+
+Usage::
+
+    python tools/store_fsck.py /path/to/models [--quarantine] [--sweep]
+    python tools/store_fsck.py --selftest      # hermetic end-to-end check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable straight from a checkout (python tools/store_fsck.py):
+# sys.path[0] is tools/, the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fsck(
+    models_root: str,
+    quarantine: bool = False,
+    sweep: bool = False,
+    adopt: bool = False,
+) -> dict:
+    """Scan ``models_root`` and return the integrity report (see module
+    docstring). Pure function of the tree plus the requested repairs.
+    ``adopt``: write a ``MANIFEST.json`` for flat legacy dirs that predate
+    the store (hashing the bytes as found — a one-time migration step;
+    verified load refuses unmanifested artifacts)."""
+    from gordo_components_tpu.store import (
+        ManifestMissing,
+        StoreError,
+        current_generation,
+        list_generations,
+        sweep_leftovers,
+        verify_artifact,
+        write_manifest,
+    )
+
+    report: dict = {"root": os.path.abspath(models_root), "machines": {},
+                    "swept": [], "ok": True}
+    if not os.path.isdir(models_root):
+        report["ok"] = False
+        report["error"] = f"not a directory: {models_root}"
+        return report
+    if sweep:
+        report["swept"].extend(sweep_leftovers(models_root))
+    for entry in sorted(os.listdir(models_root)):
+        path = os.path.join(models_root, entry)
+        if entry.startswith(".") or not os.path.isdir(path):
+            continue
+        gens = list_generations(path)
+        is_flat = not gens and not os.path.isfile(
+            os.path.join(path, "CURRENT")
+        )
+        if is_flat and not os.path.exists(
+            os.path.join(path, "definition.json")
+        ):
+            continue  # not a model dir at all
+        machine: dict = {"generations": {}, "actions": [], "verified": False,
+                         "error": None}
+        if sweep:
+            machine["swept"] = sweep_leftovers(path)
+            report["swept"].extend(f"{entry}/{n}" for n in machine["swept"])
+        # verify every generation individually (the rollback candidate set)
+        for gen in gens:
+            try:
+                verify_artifact(os.path.join(path, gen))
+                machine["generations"][gen] = "ok"
+            except StoreError as exc:
+                machine["generations"][gen] = f"{type(exc).__name__}: {exc}"
+        # then the serving view — reusing the per-generation results above
+        # (no double hashing: state.npz can be GBs per machine)
+        error = None
+        current = None
+        try:
+            current = current_generation(path)
+        except StoreError as exc:  # malformed CURRENT pointer
+            error = f"{type(exc).__name__}: {exc}"
+        machine["current"] = current
+        if error is None and current is not None:
+            status = machine["generations"].get(current)
+            if status is None:
+                error = (
+                    f"ArtifactIncomplete: {path}: CURRENT points at "
+                    f"{current!r} which does not exist"
+                )
+            elif status != "ok":
+                error = status
+        elif error is None:  # flat legacy dir
+            try:
+                verify_artifact(path)
+            except ManifestMissing as exc:
+                if adopt:
+                    write_manifest(path)
+                    machine["actions"].append("adopted (manifest written)")
+                else:
+                    error = f"{type(exc).__name__}: {exc}"
+            except StoreError as exc:
+                error = f"{type(exc).__name__}: {exc}"
+        if error is None:
+            machine["verified"] = True
+        else:
+            machine["error"] = error
+            if quarantine:
+                _repair(path, machine)
+        if quarantine and machine["verified"]:
+            # corrupt NON-current generations are dead weight in the
+            # rollback candidate set (rollback skips them, but an
+            # operator reading `rollback --list` should not see them as
+            # options) — quarantine them too
+            current = machine.get("current")
+            for gen, status in list(machine["generations"].items()):
+                if (
+                    status != "ok"
+                    and gen != current
+                    and not status.endswith("(quarantined)")  # _repair did it
+                ):
+                    _quarantine_generation(path, gen, machine)
+        report["machines"][entry] = machine
+        if not machine["verified"]:
+            report["ok"] = False
+    return report
+
+
+def _quarantine_generation(root: str, gen: str, machine: dict) -> None:
+    doomed = os.path.join(root, gen)
+    target = os.path.join(
+        root, f".quarantined-{gen}.{time.strftime('%Y%m%d%H%M%S')}"
+    )
+    try:
+        os.rename(doomed, target)
+        machine["actions"].append(f"quarantined {gen}")
+        machine["generations"][gen] = (
+            machine["generations"].get(gen, "corrupt") + " (quarantined)"
+        )
+    except OSError as exc:
+        machine["actions"].append(f"quarantine of {gen} failed: {exc}")
+
+
+def _repair(root: str, machine: dict) -> None:
+    """CURRENT generation (or the pointer itself) is bad: roll back to the
+    newest generation that verifies, then quarantine the bad generation.
+    ``rollback_generation`` verified the restored target itself, so no
+    re-hash is needed here."""
+    from gordo_components_tpu.store import StoreError, rollback_generation
+
+    bad_gen = machine.get("current")
+    try:
+        restored = rollback_generation(root)
+    except StoreError as exc:
+        machine["actions"].append(f"rollback impossible: {exc}")
+        return
+    machine["actions"].append(
+        f"rolled back to {os.path.basename(restored)}"
+    )
+    machine["current"] = os.path.basename(restored)
+    if bad_gen:
+        _quarantine_generation(root, bad_gen, machine)
+    machine["verified"] = True
+    machine["error"] = None
+
+
+def _selftest() -> int:
+    """Hermetic end-to-end check (the ``make store-fsck`` smoke): build a
+    tiny models tree exhibiting every failure class, assert fsck detects
+    and repairs them. No training, no network, sub-second."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from gordo_components_tpu.models.pipeline import Pipeline
+    from gordo_components_tpu.models.transformers import MinMaxScaler
+    from gordo_components_tpu.serializer.persistence import (
+        STATE_FILE,
+        write_artifact_files,
+    )
+    from gordo_components_tpu.store import commit_generation, current_generation
+
+    failures = []
+
+    def check(condition, label):
+        print(("PASS " if condition else "FAIL ") + label)
+        if not condition:
+            failures.append(label)
+
+    X = np.random.default_rng(0).normal(size=(32, 3)).astype(np.float32)
+    pipe = Pipeline([MinMaxScaler()])
+    pipe.fit(X)
+    root = tempfile.mkdtemp(prefix="store-fsck-selftest-")
+    try:
+        write = lambda staging: write_artifact_files(pipe, staging)  # noqa: E731
+        # healthy: two verified generations
+        commit_generation(os.path.join(root, "m-ok"), write)
+        commit_generation(os.path.join(root, "m-ok"), write)
+        # torn: good gen-0001, corrupt (truncated) CURRENT gen-0002
+        torn_root = os.path.join(root, "m-torn")
+        commit_generation(torn_root, write)
+        gen2 = commit_generation(torn_root, write)
+        state = os.path.join(gen2, STATE_FILE)
+        with open(state, "r+b") as fh:
+            fh.truncate(os.path.getsize(state) // 2)
+        # hopeless: single corrupt generation, nothing to roll back to
+        lost_root = os.path.join(root, "m-lost")
+        gen1 = commit_generation(lost_root, write)
+        os.unlink(os.path.join(gen1, STATE_FILE))
+        # corrupt CURRENT *pointer* over two healthy generations
+        badptr_root = os.path.join(root, "m-badptr")
+        commit_generation(badptr_root, write)
+        commit_generation(badptr_root, write)
+        with open(os.path.join(badptr_root, "CURRENT"), "w") as fh:
+            fh.write("!!garbage!!\n")
+        # flat legacy dir: pre-store artifact, no MANIFEST.json
+        legacy_root = os.path.join(root, "m-legacy")
+        os.makedirs(legacy_root)
+        write(legacy_root)
+        # crash debris
+        os.makedirs(os.path.join(torn_root, ".staging-gen-0003.dead"))
+
+        report = fsck(root, quarantine=False, sweep=False)
+        check(report["machines"]["m-ok"]["verified"], "healthy machine verifies")
+        check(not report["machines"]["m-torn"]["verified"],
+              "torn CURRENT generation detected")
+        check("ArtifactCorrupt" in (report["machines"]["m-torn"]["error"] or ""),
+              "torn generation reports typed error")
+        check(not report["machines"]["m-lost"]["verified"],
+              "unrecoverable machine detected")
+        check(not report["machines"]["m-badptr"]["verified"],
+              "corrupt CURRENT pointer detected")
+        check(not report["machines"]["m-legacy"]["verified"]
+              and "ManifestMissing" in report["machines"]["m-legacy"]["error"],
+              "pre-store legacy dir reported unmanifested")
+        check(report["ok"] is False, "report not-ok with corruption present")
+
+        repaired = fsck(root, quarantine=True, sweep=True, adopt=True)
+        m_torn = repaired["machines"]["m-torn"]
+        check(m_torn["verified"], "repair rolls torn machine back")
+        m_badptr = repaired["machines"]["m-badptr"]
+        check(m_badptr["verified"]
+              and current_generation(badptr_root) == "gen-0002",
+              "corrupt pointer repaired to newest verified generation")
+        m_legacy = repaired["machines"]["m-legacy"]
+        check(m_legacy["verified"]
+              and "adopted (manifest written)" in m_legacy["actions"],
+              "--adopt manifests the legacy dir")
+        check(current_generation(torn_root) == "gen-0001",
+              "CURRENT points at the verified predecessor")
+        check(any(a.startswith("quarantined") for a in m_torn["actions"]),
+              "corrupt generation quarantined")
+        check(any(".staging-" in s for s in repaired["swept"]),
+              "crash debris swept")
+        m_lost = repaired["machines"]["m-lost"]
+        check(not m_lost["verified"]
+              and any("rollback impossible" in a for a in m_lost["actions"]),
+              "unrecoverable machine reported, not destroyed")
+        check(repaired["ok"] is False,
+              "report stays not-ok while any machine is unverified")
+
+        final = fsck(root, quarantine=False, sweep=False)
+        check(final["machines"]["m-torn"]["verified"],
+              "repaired machine verifies on re-scan")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(
+        f"\nstore-fsck selftest: "
+        f"{'OK' if not failures else f'{len(failures)} FAILURE(S)'}"
+    )
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("models_root", nargs="?",
+                        help="directory whose subdirs are model dirs")
+    parser.add_argument("--quarantine", action="store_true",
+                        help="repair: roll back corrupt CURRENT generations "
+                             "and rename corrupt generations aside")
+    parser.add_argument("--sweep", action="store_true",
+                        help="remove leftover .staging-*/.trash-* crash debris")
+    parser.add_argument("--adopt", action="store_true",
+                        help="migration: write MANIFEST.json for flat "
+                             "pre-store dirs missing one (hashes the bytes "
+                             "as found)")
+    parser.add_argument("--selftest", action="store_true",
+                        help="run the hermetic self-test and exit")
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.models_root:
+        parser.error("models_root is required (or use --selftest)")
+    report = fsck(args.models_root, quarantine=args.quarantine,
+                  sweep=args.sweep, adopt=args.adopt)
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
