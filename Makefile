@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck smoke images builder-image server-image watchman-image
+.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke smoke images builder-image server-image watchman-image
 
 test:
 	python -m pytest tests/ -q
@@ -35,8 +35,15 @@ chaos-smoke:
 store-fsck:
 	JAX_PLATFORMS=cpu python tools/store_fsck.py --selftest
 
-# the full smoke battery: exposition + resilience + store integrity
-smoke: metrics-smoke chaos-smoke store-fsck
+# serving data-plane check: two-format (npz/JSON) parity, pipelined-vs-
+# serial dispatch bit-identity, and a short saturation sweep that must
+# not collapse under concurrency (CPU backend; no absolute-RPS gates)
+perf-smoke:
+	JAX_PLATFORMS=cpu python tools/perf_smoke.py
+
+# the full smoke battery: exposition + resilience + store integrity +
+# serving data plane
+smoke: metrics-smoke chaos-smoke store-fsck perf-smoke
 
 images: builder-image server-image watchman-image
 

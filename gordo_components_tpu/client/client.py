@@ -6,6 +6,15 @@ into chunks (:func:`make_date_ranges`), fires concurrent HTTP requests with
 retry/backoff (aiohttp), assembles per-machine score DataFrames, and hands
 them to forwarders. The server does the data fetch + TPU-batched scoring
 per chunk (``?start&end`` path — SURVEY.md §4.3).
+
+Data plane (docs/ARCHITECTURE.md §12): chunk fetches negotiate the binary
+``application/x-gordo-npz`` wire format — scores arrive as ONE npz blob of
+float32 arrays instead of JSON floats — and every request of a ``Client``'s
+lifetime shares ONE pooled ``aiohttp.ClientSession`` on a persistent
+background event loop, so chunk fetches reuse kept-alive connections
+instead of paying a TCP (re)connect per ``predict`` call. Call
+:meth:`Client.close` (or use the client as a context manager) to release
+the pool; a dropped client is cleaned up best-effort.
 """
 
 from __future__ import annotations
@@ -13,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import threading
 import time
 from datetime import datetime
 from typing import Any, Dict, List, Optional, Sequence, Union
@@ -20,6 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 import pandas as pd
 
+from .. import wire
 from ..observability import tracing
 from ..observability.registry import REGISTRY
 from ..resilience import deadline
@@ -82,6 +93,134 @@ class Client:
         # paying a full connect/read timeout
         self._breakers = BreakerBoard(recovery_time=breaker_recovery)
         self.forwarders = forwarders or []
+        # ONE pooled aiohttp session for the client's lifetime, living on a
+        # persistent background event loop (asyncio.run per predict() call
+        # would tear the loop — and with it every kept-alive connection —
+        # down between calls); both are created lazily on first use and
+        # released by close()
+        self._io_lock = threading.Lock()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._session = None
+        self._session_loop: Optional[asyncio.AbstractEventLoop] = None
+
+    # -- pooled I/O lifecycle ------------------------------------------------
+    def _submit(self, coro) -> "asyncio.Future":
+        """Schedule ``coro`` on the pooled I/O loop (creating it on first
+        use). Loop lookup and submission are ONE critical section with
+        close(): a submission therefore either lands on the loop BEFORE
+        close()'s cancel sweep (call_soon_threadsafe callbacks run FIFO,
+        so the task exists when the sweep cancels everything → the caller
+        gets CancelledError) or sees the swapped-out None and builds a
+        fresh loop — it can never target a loop that is already stopping,
+        which would freeze its future unresolved."""
+        with self._io_lock:
+            if self._loop is None or self._loop.is_closed():
+                self._loop = asyncio.new_event_loop()
+                self._loop_thread = threading.Thread(
+                    target=self._loop.run_forever,
+                    name="gordo-client-io",
+                    daemon=True,
+                )
+                self._loop_thread.start()
+            return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    async def _ensure_session(self):
+        """The pooled session (created on the I/O loop). Keep-alive is
+        aiohttp's default — chunk N+1 to the same host reuses chunk N's
+        connection instead of re-handshaking. The session is pinned to the
+        loop it was created on: a close() racing a predict() can leave a
+        session bound to the OLD, dying loop (the predict re-created it
+        just before its cancel landed), and reusing that on a fresh loop
+        makes aiohttp raise on every request — so a loop mismatch discards
+        and rebuilds instead."""
+        import aiohttp
+
+        loop = asyncio.get_running_loop()
+        if (
+            self._session is None
+            or self._session.closed
+            or self._session_loop is not loop
+        ):
+            if self._session is not None and not self._session.closed:
+                # bound to a defunct loop; closing it needs that loop, so
+                # drop the reference (the connector is reclaimed by GC)
+                logger.warning(
+                    "Discarding pooled session bound to a closed I/O loop"
+                )
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout)
+            )
+            self._session_loop = loop
+        return self._session
+
+    def close(self) -> None:
+        """Release the pooled session and stop the background I/O loop.
+        Idempotent; a closed client can still be used again (the pool is
+        recreated lazily), so close() is a resource release, not a
+        poison pill."""
+        with self._io_lock:
+            loop, self._loop = self._loop, None
+            thread, self._loop_thread = self._loop_thread, None
+            session, self._session = self._session, None
+            self._session_loop = None
+        if loop is None or loop.is_closed():
+            return
+        try:
+            if session is not None and not session.closed:
+                asyncio.run_coroutine_threadsafe(
+                    session.close(), loop
+                ).result(timeout=10)
+        except Exception:
+            logger.warning(
+                "Pooled session did not close cleanly", exc_info=True
+            )
+        finally:
+            def _shutdown():
+                # cancel in-flight work BEFORE stopping: a predict() racing
+                # close() must surface CancelledError in its .result(),
+                # never block forever on a future whose loop silently
+                # exited mid-await. The loop stops only AFTER the
+                # cancelled tasks finish unwinding — stopping in the same
+                # tick would strand a task mid-cancellation with its
+                # future (and the thread joined on it) unresolved.
+                tasks = list(asyncio.all_tasks(loop))
+                for task in tasks:
+                    task.cancel()
+
+                async def _stop_when_unwound():
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                    loop.stop()
+
+                loop.create_task(_stop_when_unwound())
+
+            loop.call_soon_threadsafe(_shutdown)
+            if thread is not None:
+                thread.join(timeout=10)
+            # only close a loop that actually stopped: if work is still in
+            # flight past the join timeout (a predict() racing close()),
+            # closing would raise from __exit__ and leave the client
+            # half-torn — the daemon thread and its loop are leaked
+            # deliberately and noisily instead
+            if thread is None or not thread.is_alive():
+                loop.close()
+            else:
+                logger.warning(
+                    "Client I/O loop still busy after close(); leaking the "
+                    "daemon loop thread rather than closing a running loop"
+                )
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort backstop; close() is the contract
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _backoff_delay(self, attempt: int) -> float:
         """Exponential backoff with ±50% jitter: a fleet of clients whose
@@ -136,10 +275,15 @@ class Client:
             return None
 
     def _headers(self) -> Dict[str, str]:
-        """Per-request headers: trace id always; the context deadline's
-        remaining budget rides ``X-Gordo-Deadline`` so the server can 504
-        work we have already given up on."""
-        headers = {tracing.TRACE_HEADER: tracing.current_or_new()}
+        """Per-request headers: trace id always; npz-first content
+        negotiation (an old server ignores the Accept and answers JSON —
+        the response handlers dispatch on Content-Type, so both work); the
+        context deadline's remaining budget rides ``X-Gordo-Deadline`` so
+        the server can 504 work we have already given up on."""
+        headers = {
+            tracing.TRACE_HEADER: tracing.current_or_new(),
+            "Accept": f"{wire.NPZ_CONTENT_TYPE}, application/json",
+        }
         budget = deadline.header_value()
         if budget is not None:
             headers[deadline.DEADLINE_HEADER] = budget
@@ -233,7 +377,15 @@ class Client:
                             last_error = f"HTTP {response.status}"
                             _M_RETRIES.labels("http_5xx").inc()
                             continue
-                        payload = await response.json()
+                        ctype = wire.content_type_of(
+                            response.headers.get("Content-Type")
+                        )
+                        if ctype == wire.NPZ_CONTENT_TYPE:
+                            payload = wire.payload_from_npz(
+                                await response.read()
+                            )
+                        else:
+                            payload = await response.json()
                         breaker.record(True)
                         _M_REQUESTS.labels("ok").inc()
                         return payload
@@ -256,21 +408,21 @@ class Client:
     async def _predict_async(
         self, machines: List[str], ranges
     ) -> Dict[str, pd.DataFrame]:
-        import aiohttp
-
         semaphore = asyncio.Semaphore(self.parallelism)
-        timeout = aiohttp.ClientTimeout(total=self.timeout)
-        async with aiohttp.ClientSession(timeout=timeout) as session:
-            tasks = {
-                (machine, i): asyncio.ensure_future(
-                    self._fetch_chunk(session, semaphore, machine, start, end)
-                )
-                for machine in machines
-                for i, (start, end) in enumerate(ranges)
-            }
-            # return_exceptions: let every chunk finish, then surface the
-            # first failure via task.result() below (avoids orphan tasks)
-            await asyncio.gather(*tasks.values(), return_exceptions=True)
+        # the POOLED session: one per Client (created here on first use),
+        # NOT one per predict() call — keep-alive connections survive
+        # across chunks and across calls (see close())
+        session = await self._ensure_session()
+        tasks = {
+            (machine, i): asyncio.ensure_future(
+                self._fetch_chunk(session, semaphore, machine, start, end)
+            )
+            for machine in machines
+            for i, (start, end) in enumerate(ranges)
+        }
+        # return_exceptions: let every chunk finish, then surface the
+        # first failure via task.result() below (avoids orphan tasks)
+        await asyncio.gather(*tasks.values(), return_exceptions=True)
         frames: Dict[str, pd.DataFrame] = {}
         for machine in machines:
             chunks = [
@@ -285,9 +437,14 @@ class Client:
 
     @staticmethod
     def _chunk_frame(payload: Dict[str, Any]) -> Optional[pd.DataFrame]:
+        """One chunk payload → frame. Serves BOTH wire formats: JSON
+        payloads carry nested lists, npz payloads carry numpy arrays
+        (``wire.payload_from_npz``) — hence ``len()`` emptiness (array
+        truthiness raises) and ``np.asarray`` (a no-copy pass-through for
+        the arrays)."""
         data = payload.get("data", {})
         total = data.get("total-anomaly-score")
-        if not total:
+        if total is None or len(total) == 0:
             return None
         scores = np.asarray(data["tag-anomaly-scores"], dtype=np.float64)
         columns = {
@@ -386,12 +543,16 @@ class Client:
                 last_error = f"HTTP {response.status_code}"
                 _M_RETRIES.labels("http_5xx").inc()
                 continue
+            ctype = wire.content_type_of(response.headers.get("Content-Type"))
             try:
-                payload = response.json()
-            except ValueError:  # 2xx with a non-JSON body (broken proxy):
-                # retryable, and terminal failures stay ClientError
+                if ctype == wire.NPZ_CONTENT_TYPE:
+                    payload = wire.payload_from_npz(response.content)
+                else:
+                    payload = response.json()
+            except ValueError:  # 2xx with an undecodable body (broken
+                # proxy): retryable, and terminal failures stay ClientError
                 breaker.record(False)
-                last_error = "2xx response with non-JSON body"
+                last_error = f"2xx response with undecodable body ({ctype})"
                 _M_RETRIES.labels("bad_body").inc()
                 continue
             breaker.record(True)
@@ -417,7 +578,10 @@ class Client:
         logger.info(
             "Client.predict: %d machines x %d chunks", len(machines), len(ranges)
         )
-        frames = asyncio.run(self._predict_async(machines, ranges))
+        # run on the client's persistent I/O loop (NOT asyncio.run, which
+        # would build and tear down a loop — and the pooled session's
+        # connections with it — on every call)
+        frames = self._submit(self._predict_async(machines, ranges)).result()
         for forwarder in self.forwarders:
             for machine, frame in frames.items():
                 forwarder.forward(machine, frame)

@@ -29,6 +29,17 @@ skipped; callers fall back to the host path (``model.anomaly``), and the
 skip list + reasons are surfaced in :meth:`ServingEngine.stats` so a fleet
 operator can see WHICH machines serve via the slow path (VERDICT r2 weak
 #5).
+
+Dispatch is PIPELINED (docs/ARCHITECTURE.md §12): the leader thread only
+*enqueues* device executions — JAX's async dispatch returns before the
+compute finishes — and a per-bucket collector thread performs the
+``jax.device_get`` + result fan-out, so the next micro-batch dispatches
+while the previous one's results transfer off device and serialize on the
+handler threads. In-flight depth is bounded (default 2,
+``GORDO_DISPATCH_DEPTH``; 1 = serial, the bit-identical comparison mode),
+the ``_busy`` leader latch is released between the dispatch and fetch
+stages, and in shard mode the process-global collective-launch lock covers
+only the enqueue window — never the device-to-host copy.
 """
 
 from __future__ import annotations
@@ -37,8 +48,10 @@ import contextlib
 import json
 import logging
 import os
+import queue
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
@@ -76,8 +89,10 @@ _M_COMPILE_SECONDS = REGISTRY.histogram(
 )
 _M_DISPATCH_SECONDS = REGISTRY.histogram(
     "gordo_engine_dispatch_seconds",
-    "Compile-free device dispatch latency, by path (cold=stacked gather, "
-    "hot=unsharded hot-cache copy)",
+    "Compile-free enqueue-to-fetch-complete latency of one device "
+    "dispatch, by path (cold=stacked gather, hot=unsharded hot-cache "
+    "copy); under pipelined dispatch this includes any in-flight queue "
+    "wait ahead of the fetch",
     labels=("path",),
 )
 _M_DISPATCH_BATCH = REGISTRY.histogram(
@@ -109,6 +124,33 @@ def _round_up_pow2(n: int, minimum: int = 1) -> int:
     while bucket < n:
         bucket *= 2
     return bucket
+
+
+def _dispatch_depth() -> int:
+    """Bounded in-flight dispatch depth per bucket. 2 overlaps one
+    fetch+serialize with one device execution (the design point on real
+    serving hosts); 1 is the serial comparison mode (dispatch N+1 only
+    enqueues after fetch N completed — used by the bit-identity parity
+    gates). The DEFAULT is core-aware: overlap needs a spare core for the
+    collector + transfer next to the compute threads, and on a <4-CPU box
+    it measures as pure contention (12-thread saturation on 2 CPUs:
+    p99 37 ms at depth 1 vs ~730 ms at depth 2), so small hosts default
+    to serial. ``GORDO_DISPATCH_DEPTH`` overrides either way; a value
+    below 1 clamps to serial (0 is a sensible "pipelining off"), and a
+    non-integer falls back to the default rather than erroring a server
+    boot."""
+    default = 2 if (os.cpu_count() or 1) >= 4 else 1
+    raw = os.environ.get("GORDO_DISPATCH_DEPTH")
+    if raw is None:
+        return default
+    try:
+        depth = int(raw)
+    except (TypeError, ValueError):
+        logger.warning(
+            "GORDO_DISPATCH_DEPTH=%r is not an int; using %d", raw, default
+        )
+        return default
+    return max(1, depth)
 
 
 class ScoreResult(NamedTuple):
@@ -160,15 +202,86 @@ class _MachineEntry:
 
 
 class _Item:
-    __slots__ = ("idx", "x", "m_valid", "done", "result", "error")
+    __slots__ = ("idx", "x", "m_valid", "in_flight", "done", "result", "error")
 
     def __init__(self, idx: int, x: np.ndarray, m_valid: int):
         self.idx = idx
         self.x = x
         self.m_valid = m_valid
+        # set (under the bucket condition) when a leader pops this item off
+        # the pending queue: a woken waiter whose item is in flight must
+        # wait for the collector, not elect itself leader
+        self.in_flight = False
         self.done = threading.Event()
         self.result: Optional[ScoreResult] = None
         self.error: Optional[BaseException] = None
+
+
+class _Dispatch:
+    """One in-flight device execution: the enqueued (not yet fetched)
+    outputs plus everything the collector needs to fan results out."""
+
+    __slots__ = ("kind", "key", "fresh", "rows", "items", "outputs",
+                 "started", "hot_idx")
+
+    def __init__(self, kind, key, fresh, rows, items, outputs, started,
+                 hot_idx=None):
+        self.kind = kind  # "cold" | "hot"
+        self.key = key  # program-cache key, for compile-vs-dispatch timing
+        self.fresh = fresh  # True: this dispatch pays the XLA compile
+        self.rows = rows
+        self.items = items
+        self.outputs = outputs  # jax arrays, possibly still computing
+        self.started = started
+        self.hot_idx = hot_idx  # hot dispatches: the machine served
+
+
+class _Stop:
+    """close() sentinel, addressed to ONE collector thread: a successor
+    collector that spawned while the old one was retiring (a leader raced
+    close()) must discard a stale sentinel and keep draining, not die on
+    a poison pill meant for its predecessor."""
+
+    __slots__ = ("thread",)
+
+    def __init__(self, thread: threading.Thread):
+        self.thread = thread
+
+
+def _collector_loop(bucket_ref: "weakref.ref", fetch_queue: "queue.Queue"):
+    """Per-bucket fetch stage: ``device_get`` + result fan-out, FIFO in
+    dispatch order. Holds only a WEAK reference between jobs so a dropped
+    engine generation (reload without close()) can be collected — the
+    thread then exits at its next idle tick instead of pinning the bucket's
+    device-resident stacked params forever."""
+    while True:
+        try:
+            job = fetch_queue.get(timeout=5.0)
+        except queue.Empty:
+            if bucket_ref() is None:
+                return
+            continue
+        if isinstance(job, _Stop):  # FIFO, so in-flight work drained first
+            fetch_queue.task_done()
+            if job.thread is threading.current_thread():
+                return
+            continue  # predecessor's sentinel; this collector lives on
+        bucket = bucket_ref()
+        if bucket is None:  # can't happen while waiters hold the engine,
+            # but never leave a waiter hanging
+            for it in job.items:
+                it.error = RuntimeError("serving bucket was released")
+                it.done.set()
+            fetch_queue.task_done()
+            continue
+        try:
+            bucket._complete(job)
+        finally:
+            bucket._inflight_slots.release()
+            # AFTER _complete (incl. its promotion work): quiesce() joins
+            # on this, so "fetch stage drained" implies promotions landed
+            fetch_queue.task_done()
+            del bucket  # drop the strong ref before blocking on the queue
 
 
 class _Bucket:
@@ -210,6 +323,13 @@ class _Bucket:
         self._hot: "OrderedDict[int, Any]" = OrderedDict()
         self._hot_hits: Dict[int, int] = {}
         self._hot_last_use: Dict[int, int] = {}  # idx -> dispatch_count
+        # hot-cache state is now touched by TWO threads — the leader
+        # (routing: is this batch's machine hot?) and the collector
+        # (promotion, demotion, freshness stamping after each fetch) — so
+        # membership reads and every mutation go through this lock. Never
+        # held across a device operation (the promotion gather runs
+        # outside it, or routing would stall behind it).
+        self._hot_lock = threading.Lock()
         # idx -> times this machine's hot copy failed at dispatch and was
         # demoted; raises its re-promotion hit threshold exponentially so
         # a deterministically failing hot program can't oscillate
@@ -274,6 +394,20 @@ class _Bucket:
         self._cond = threading.Condition()
         self._busy = False
         self._pending: Dict[int, List[_Item]] = {}
+        # pipelined dispatch: the leader enqueues device executions (JAX
+        # async dispatch) and this bounded queue hands them to the
+        # collector thread for device_get + fan-out; the semaphore is the
+        # backpressure that caps in-flight depth
+        self.dispatch_depth = _dispatch_depth()
+        self._inflight_slots = threading.Semaphore(self.dispatch_depth)
+        self._fetch_queue: "queue.Queue" = queue.Queue()
+        self._collector: Optional[threading.Thread] = None
+        # serializes collector handover (spawn / close / enqueue): a
+        # close() racing an active leader must neither strand a job
+        # behind the shutdown sentinel nor leave two collectors draining
+        # one queue (see _finish / close / _ensure_collector)
+        self._collector_lock = threading.Lock()
+        self._retiring_collector: Optional[threading.Thread] = None
         # bounded dispatch stats (a long-lived server must not accumulate
         # per-dispatch history — cf. _Latency's keep cap)
         self.dispatch_count = 0
@@ -371,46 +505,121 @@ class _Bucket:
             )
         return jax.device_put(host_tree)
 
+    def warmup_hot(self, rows: int) -> None:
+        """Shard mode: pre-pay the hot path's one-time costs before live
+        traffic — one promotion gather (resharding program compile +
+        cross-device pull) and the hot program's XLA compile + first
+        dispatch at the warmed row bucket. The gathered tree is discarded:
+        promotion policy (2 cold hits) is unchanged; only the first REAL
+        promotion stops paying a compile inside a live request. Runs on
+        the warmup caller's thread, like the rest of warmup()."""
+        if not self._hot_cap or self.mesh is None:
+            return
+        tree = self._gather_machine(0)
+        key = ("hot", rows, 1)
+        program = self._hot_program(rows, 1)
+        xs = np.zeros((1, rows, self.n_features), np.float32)
+        started = time.perf_counter()
+        jax.block_until_ready(program(tree, xs))
+        if key in self._fresh_programs:
+            # this warmup dispatch paid the compile; account it as such so
+            # the first live hot dispatch records as dispatch latency
+            self._fresh_programs.discard(key)
+            _M_COMPILE_SECONDS.labels("hot").observe(
+                time.perf_counter() - started
+            )
+
     # -- request path --------------------------------------------------------
     def submit(self, idx: int, x: np.ndarray, m_valid: int) -> ScoreResult:
         """Score one request; coalesces with concurrent requests of the same
         padded row count. One thread at a time is the leader: it drains the
         whole queue (including followers that piled up while the device was
-        busy) in micro-batched dispatches; followers sleep on the condition
-        until their item completes."""
+        busy) into micro-batched dispatches. The leader only ENQUEUES each
+        dispatch (bounded by ``dispatch_depth``) — the collector thread
+        fetches and fans out — and releases the leader latch as soon as the
+        pending queue is drained, so followers for other row-buckets never
+        queue behind a device-to-host copy."""
         item = _Item(idx, x, m_valid)
         rows = x.shape[0]
         is_leader = False
         with self._cond:
             self._pending.setdefault(rows, []).append(item)
-            while self._busy and not item.done.is_set():
+            while True:
+                if item.done.is_set() or item.in_flight:
+                    break  # a leader dispatched it; await the collector
+                if not self._busy:
+                    self._busy = True
+                    is_leader = True
+                    break
                 self._cond.wait(timeout=1.0)  # predicate-looped; timeout is
                 # only a hang guard should a notify ever be missed
-            if not item.done.is_set():
-                self._busy = True
-                is_leader = True
         if is_leader:
             try:
+                # drains until the queue empties OR this leader's own item
+                # completes — under sustained arrivals the queue may never
+                # empty, and the leader must not serve everyone else's
+                # requests unboundedly while its own response sits ready;
+                # on early exit the finally's notify elects a successor
+                # leader from the un-dispatched waiters (none of them are
+                # in_flight), exactly the pre-pipeline hand-off
                 while not item.done.is_set():
                     with self._cond:
                         pending, self._pending = self._pending, {}
+                        for batch in pending.values():
+                            for it in batch:
+                                it.in_flight = True
+                        # wake coalesced followers NOW: their wait
+                        # predicate (done or in_flight) just flipped, and
+                        # under sustained load this drain loop may not
+                        # exit (and fire the finally's notify) for a long
+                        # time — without this they sleep out the full 1 s
+                        # hang-guard timeout (measured: 0.4% of requests
+                        # at ~950 ms in a 12-thread saturation run)
+                        if pending:
+                            self._cond.notify_all()
                     if not pending:
                         break
-                    for batch_rows, items in pending.items():
-                        for start in range(0, len(items), self.max_batch):
-                            self._process(
-                                batch_rows, items[start : start + self.max_batch]
-                            )
+                    batches = [
+                        (batch_rows, items[start : start + self.max_batch])
+                        for batch_rows, items in pending.items()
+                        for start in range(0, len(items), self.max_batch)
+                    ]
+                    for i, (batch_rows, batch_items) in enumerate(batches):
+                        # hand the fetch to the collector only when there
+                        # is MORE work to overlap it with (further batches
+                        # in this drain, jobs already in flight, or new
+                        # arrivals); an idle server's singleton fetches
+                        # inline on this thread — the pipeline's thread
+                        # handoff costs real microseconds per dispatch and
+                        # buys nothing without queue pressure
+                        self._dispatch(
+                            batch_rows,
+                            batch_items,
+                            defer=(i + 1 < len(batches)),
+                        )
             finally:
                 with self._cond:
                     self._busy = False
                     self._cond.notify_all()
+        item.done.wait()
         if item.error is not None:
             raise item.error
         assert item.result is not None
         return item.result
 
-    def _process(self, rows: int, items: List[_Item]) -> None:
+    def _should_pipeline(self) -> bool:
+        """Queue pressure check (leader thread, between batches): pipeline
+        the fetch when the collector already has work in flight or new
+        requests queued while dispatching — otherwise fetch inline.
+        ``unfinished_tasks`` is only ever incremented by this (the leader)
+        thread, so a zero read is stable: the collector is idle and stays
+        idle until we enqueue."""
+        if self._fetch_queue.unfinished_tasks > 0:
+            return True
+        with self._cond:
+            return bool(self._pending)
+
+    def _dispatch(self, rows: int, items: List[_Item], defer: bool) -> None:
         # the hot path fires ONLY for a PURE batch — every request for one
         # already-hot machine — which is exactly the cache's design case
         # (concentrated repeat-machine traffic, where drained batches are
@@ -420,102 +629,53 @@ class _Bucket:
         # traffic (24-machine round-robin, 8-virtual-device mesh) for no
         # latency gain, since the stacked program serves hot machines
         # correctly too.
-        if (
-            self._hot_cap
-            and items[0].idx in self._hot
-            and all(it.idx == items[0].idx for it in items)
-        ):
-            return self._process_hot(rows, items[0].idx, items)
-        self._process_cold(rows, items)
-
-    def _account(self, k: int, hot: bool = False) -> None:
-        self.dispatch_count += 1
-        self.request_count += k
-        if hot:
-            self.hot_request_count += k
-        self.max_batch_seen = max(self.max_batch_seen, k)
-        _M_REQUESTS.labels("hot" if hot else "cold").inc(k)
-        _M_DISPATCH_BATCH.observe(k)
-
-    def _time_dispatch(self, key, kind: str, seconds: float) -> None:
-        """Account one dispatch's wall time: a program's FIRST dispatch is
-        compile time (tens of seconds on TPU), everything after is the
-        dispatch-latency series a tail-latency dashboard actually wants."""
-        if key in self._fresh_programs:
-            self._fresh_programs.discard(key)
-            _M_COMPILE_SECONDS.labels(kind).observe(seconds)
+        hot_tree = None
+        idx0 = items[0].idx
+        if self._hot_cap and all(it.idx == idx0 for it in items):
+            with self._hot_lock:
+                hot_tree = self._hot.get(idx0)
+                if hot_tree is not None:
+                    self._hot.move_to_end(idx0)  # LRU touch
+        if hot_tree is not None:
+            self._dispatch_hot(rows, idx0, hot_tree, items, defer)
         else:
-            _M_DISPATCH_SECONDS.labels(kind).observe(seconds)
+            self._dispatch_cold(rows, items, defer)
 
-    def _process_hot(self, rows: int, idx: int, items: List[_Item]) -> None:
-        key = None
+    def _finish(self, job: _Dispatch, defer: bool) -> None:
+        """Route one enqueued dispatch to its fetch stage: the collector
+        when pipelining pays (``defer``, or live queue pressure), else
+        inline on the leader. The inline case runs with the collector
+        provably idle (see _should_pipeline) and this thread holding the
+        _busy latch, so _complete's bookkeeping stays single-threaded."""
+        if defer or self._should_pipeline():
+            try:
+                with self._collector_lock:
+                    # spawn-and-enqueue is atomic w.r.t. close(): the job
+                    # either lands ahead of a shutdown sentinel (drained
+                    # before the collector retires) or a fresh collector
+                    # is spawned for it (discarding any stale sentinel)
+                    self._ensure_collector()
+                    self._fetch_queue.put(job)
+            except BaseException as exc:
+                # a failed spawn (e.g. thread exhaustion under overload)
+                # must fan out like any other dispatch failure — never
+                # strand the waiters on an unset done event or leak the
+                # in-flight slot
+                self._inflight_slots.release()
+                for it in job.items:
+                    it.error = exc
+                for it in job.items:
+                    it.done.set()
+            return
         try:
-            tree = self._hot[idx]
-            self._hot.move_to_end(idx)  # LRU touch
-            k = len(items)
-            kb = _round_up_pow2(k)
-            xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
-            program = self._hot_program(rows, kb)
-            key = ("hot", rows, kb)
-            dispatch_started = time.perf_counter()
-            x_tail, pred, scaled, total = jax.device_get(program(tree, xs))
-            self._time_dispatch(
-                key, "hot", time.perf_counter() - dispatch_started,
-            )
-            # accounted before stamping so hot- and cold-path freshness
-            # both record POST-dispatch counts (_maybe_promote stamps after
-            # _process_cold's _account); stamped only on success — see the
-            # demotion below for the failure path
-            self._account(k, hot=True)
-            self._hot_last_use[idx] = self.dispatch_count
-            # a successful hot dispatch pays down the demotion backoff: a
-            # TRANSIENT past failure (device blip during another bucket's
-            # promotion) must not permanently escalate this machine's
-            # re-promotion threshold, while a deterministically failing
-            # program never reaches this line and keeps backing off
-            demotions = self._hot_demotions.get(idx)
-            if demotions:
-                if demotions > 1:
-                    self._hot_demotions[idx] = demotions - 1
-                else:
-                    del self._hot_demotions[idx]
-            self._fill_results(items, x_tail, pred, scaled, total)
-        except Exception:
-            # a failing hot copy must not keep failing this machine's pure
-            # batches while the sharded cold path could serve them — and
-            # below hot_cap nothing else would ever evict it. Demote it
-            # (re-promotion needs exponentially more cold hits each time,
-            # see _maybe_promote) and score the same items cold;
-            # _process_cold owns done/error from here.
-            logger.exception(
-                "hot-cache dispatch failed for machine idx %d; demoting "
-                "the hot copy and retrying on the cold path", idx
-            )
-            # a failed first dispatch never reaches _time_dispatch: drop
-            # the fresh marker (no sample) or the program's NEXT dispatch —
-            # milliseconds, compile long since paid — would be misrecorded
-            # as a compile
-            if key is not None:
-                self._fresh_programs.discard(key)
-            self._hot.pop(idx, None)
-            self._hot_last_use.pop(idx, None)
-            self._hot_hits.pop(idx, None)
-            self._hot_demotions[idx] = self._hot_demotions.get(idx, 0) + 1
-            _M_HOT_EVENTS.labels("demote").inc()
-            self._process_cold(rows, items)
-        except BaseException as exc:
-            # KeyboardInterrupt/SystemExit must not vanish into a cold
-            # retry — surface on every waiting thread as before
-            for it in items:
-                it.error = exc
-            for it in items:
-                it.done.set()
-        else:
-            for it in items:
-                it.done.set()
+            self._complete(job)
+        finally:
+            self._inflight_slots.release()
 
-    def _process_cold(self, rows: int, items: List[_Item]) -> None:
-        key = None
+    def _dispatch_cold(
+        self, rows: int, items: List[_Item], defer: bool = True
+    ) -> None:
+        acquired = False
         try:
             k = len(items)
             kb = _round_up_pow2(k)
@@ -525,31 +685,267 @@ class _Bucket:
             xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
             program = self._program(rows, kb)
             key = (rows, kb)
-            dispatch_started = time.perf_counter()
+            # the fresh marker is consumed HERE (leader thread, under the
+            # _busy latch) so the collector never touches _fresh_programs:
+            # this dispatch either records the compile sample or — on
+            # failure — drops it, exactly the pre-pipeline semantics
+            fresh = key in self._fresh_programs
+            self._fresh_programs.discard(key)
+            self._inflight_slots.acquire()  # backpressure: bounded depth
+            acquired = True
+            started = time.perf_counter()
             with self._dispatch_lock or contextlib.nullcontext():
-                x_tail, pred, scaled, total = jax.device_get(
-                    program(self.stacked, idxs, xs)
-                )
-            self._time_dispatch(
-                key, "cold", time.perf_counter() - dispatch_started
+                # ENQUEUE only: async dispatch returns before the compute
+                # finishes, and the shard lock covers just this collective-
+                # launch window — enqueue order is consistent across all
+                # devices, so rendezvous cannot interleave, and the
+                # device-to-host copy happens outside the lock
+                outputs = program(self.stacked, idxs, xs)
+        except BaseException as exc:  # enqueue-time failure: surface on
+            # every waiting thread (the collector never sees this job)
+            if acquired:
+                self._inflight_slots.release()
+            for it in items:
+                it.error = exc
+            for it in items:
+                it.done.set()
+            return
+        self._finish(
+            _Dispatch("cold", key, fresh, rows, items, outputs, started),
+            defer,
+        )
+
+    def _dispatch_hot(
+        self, rows: int, idx: int, tree: Any, items: List[_Item],
+        defer: bool = True,
+    ) -> None:
+        acquired = False
+        try:
+            k = len(items)
+            kb = _round_up_pow2(k)
+            xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
+            program = self._hot_program(rows, kb)
+            key = ("hot", rows, kb)
+            fresh = key in self._fresh_programs
+            self._fresh_programs.discard(key)
+            self._inflight_slots.acquire()
+            acquired = True
+            started = time.perf_counter()
+            # no shard lock: the hot program is replicated, collective-free
+            outputs = program(tree, xs)
+        except Exception:
+            # a failing hot copy must not keep failing this machine's pure
+            # batches while the sharded cold path could serve them — and
+            # below hot_cap nothing else would ever evict it. Demote it
+            # (re-promotion needs exponentially more cold hits each time,
+            # see _maybe_promote) and score the same items cold.
+            if acquired:
+                self._inflight_slots.release()
+            logger.exception(
+                "hot-cache dispatch failed for machine idx %d; demoting "
+                "the hot copy and retrying on the cold path", idx
             )
+            self._demote(idx)
+            self._dispatch_cold(rows, items, defer)
+            return
+        except BaseException as exc:
+            # KeyboardInterrupt/SystemExit must not vanish into a cold
+            # retry — surface on every waiting thread as before
+            if acquired:
+                self._inflight_slots.release()
+            for it in items:
+                it.error = exc
+            for it in items:
+                it.done.set()
+            return
+        self._finish(
+            _Dispatch("hot", key, fresh, rows, items, outputs, started,
+                      hot_idx=idx),
+            defer,
+        )
+
+    # -- fetch stage (collector thread) --------------------------------------
+    def _ensure_collector(self) -> None:
+        """Start the collector lazily (callers hold _collector_lock).
+        Engines that never dispatch never own a thread. A retiring
+        predecessor (close() raced a leader) is joined first — it exits
+        within its remaining in-flight fetches — so exactly one consumer
+        ever drains the queue and exactly one thread ever runs _complete
+        at a time (the invariant the unguarded accounting, the hot-cache
+        cap check, and the FIFO bit-identity all rely on). A predecessor
+        wedged past the first join timeout (a pathologically long fetch,
+        e.g. a cold compile on its retry path) is waited out with a
+        warning: the leader blocking here is the same wait the
+        pre-pipeline code paid inline for that fetch, and no lock the
+        collector can be blocked on is held across this join."""
+        if self._collector is not None and self._collector.is_alive():
+            return
+        retiring = self._retiring_collector
+        if retiring is not None and retiring.is_alive():
+            retiring.join(timeout=30.0)
+            if retiring.is_alive():
+                logger.warning(
+                    "Collector handover: predecessor still draining after "
+                    "30 s (long in-flight fetch); waiting it out to keep "
+                    "the single-consumer invariant"
+                )
+                retiring.join()
+        self._retiring_collector = None
+        self._collector = threading.Thread(
+            target=_collector_loop,
+            args=(weakref.ref(self), self._fetch_queue),
+            name="gordo-bucket-collector",
+            daemon=True,
+        )
+        self._collector.start()
+
+    def close(self) -> None:
+        """Stop the collector after draining in-flight work (the sentinel
+        queues FIFO behind it, addressed to exactly this collector).
+        Idempotent; called per engine generation by the server's reload
+        path so old generations release their thread deterministically
+        (the collector's weakref loop is only the backstop for callers
+        that drop an engine without closing it)."""
+        with self._collector_lock:
+            collector, self._collector = self._collector, None
+            if collector is None or not collector.is_alive():
+                return
+            self._fetch_queue.put(_Stop(collector))
+            self._retiring_collector = collector
+        collector.join(timeout=30.0)
+
+    def quiesce(self) -> None:
+        """Block until every dispatch enqueued so far has been fetched and
+        fanned out — INCLUDING the collector's post-fetch promotion work.
+        Promotion is asynchronous under pipelined dispatch (it rides the
+        fetch stage), so tests and benchmarks that assert on hot-cache
+        state call this after the promoting request returns."""
+        self._fetch_queue.join()
+
+    def _fetch(self, job: _Dispatch):
+        """The device-to-host copy of one dispatch's outputs — a seam the
+        pipeline tests fail deliberately (a mid-pipeline error must surface
+        on exactly its own waiters)."""
+        return jax.device_get(job.outputs)
+
+    def _complete(self, job: _Dispatch) -> None:
+        """Fetch one dispatch's results and fan out — including the error
+        fan-out: with async dispatch an execution failure surfaces at
+        device_get time, on exactly this job's waiters."""
+        try:
+            x_tail, pred, scaled, total = self._fetch(job)
+        except Exception as exc:
+            if job.kind == "hot":
+                # same demote-and-retry-cold contract as an enqueue-time
+                # hot failure, now caught at the fetch stage; the retry is
+                # synchronous on the collector (rare path, and the leader
+                # latch was already released)
+                logger.exception(
+                    "hot-cache fetch failed for machine idx %d; demoting "
+                    "the hot copy and retrying on the cold path",
+                    job.hot_idx,
+                )
+                self._demote(job.hot_idx)
+                self._retry_cold_sync(job.rows, job.items)
+                return
+            for it in job.items:
+                it.error = exc
+            for it in job.items:
+                it.done.set()
+            return
+        except BaseException as exc:
+            for it in job.items:
+                it.error = exc
+            for it in job.items:
+                it.done.set()
+            return
+        hot = job.kind == "hot"
+        try:
+            # everything between fetch and done.set() stays inside one
+            # guard: a metrics/bookkeeping/fill error must surface on the
+            # waiters (like any other failure), never strand them on a
+            # done event that nobody will set
+            seconds = time.perf_counter() - job.started
+            if job.fresh:
+                _M_COMPILE_SECONDS.labels(job.kind).observe(seconds)
+            else:
+                _M_DISPATCH_SECONDS.labels(job.kind).observe(seconds)
+            # accounted before stamping so hot- and cold-path freshness
+            # both record POST-dispatch counts (_maybe_promote stamps
+            # after this too); stamped only on success — see the demotion
+            # above
+            self._account(len(job.items), hot=hot)
+            if hot:
+                with self._hot_lock:
+                    self._hot_last_use[job.hot_idx] = self.dispatch_count
+                    # a successful hot dispatch pays down the demotion
+                    # backoff: a TRANSIENT past failure (device blip
+                    # during another bucket's promotion) must not
+                    # permanently escalate this machine's re-promotion
+                    # threshold, while a deterministically failing program
+                    # never reaches this line and keeps backing off
+                    demotions = self._hot_demotions.get(job.hot_idx)
+                    if demotions:
+                        if demotions > 1:
+                            self._hot_demotions[job.hot_idx] = demotions - 1
+                        else:
+                            del self._hot_demotions[job.hot_idx]
+            self._fill_results(job.items, x_tail, pred, scaled, total)
+        except BaseException as exc:
+            for it in job.items:
+                it.error = exc
+        finally:
+            for it in job.items:
+                it.done.set()
+        if job.items and job.items[0].error is not None:
+            return
+        # AFTER the waiters are released: these requests already scored —
+        # a failed promotion (e.g. no HBM headroom for the unsharded copy;
+        # capacity mode exists because the fleet is big) must never turn
+        # their success into client errors, and the promotion gather now
+        # runs on the collector, off every leader's dispatch path. Logged,
+        # and retried naturally by the next cold hit.
+        if not hot:
+            try:
+                self._maybe_promote(job.items)
+            except Exception:
+                logger.exception(
+                    "hot-cache promotion failed (serving unaffected)"
+                )
+
+    def _retry_cold_sync(self, rows: int, items: List[_Item]) -> None:
+        """Collector-side cold retry for a hot dispatch that failed at
+        fetch: synchronous (enqueue under the shard lock, fetch inline) —
+        this is the rare repair path, not the pipeline."""
+        try:
+            k = len(items)
+            kb = _round_up_pow2(k)
+            idxs = np.asarray(
+                [it.idx for it in items] + [items[0].idx] * (kb - k), np.int32
+            )
+            xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
+            program = self._program(rows, kb)
+            fresh = (rows, kb) in self._fresh_programs
+            self._fresh_programs.discard((rows, kb))
+            started = time.perf_counter()
+            with self._dispatch_lock or contextlib.nullcontext():
+                outputs = program(self.stacked, idxs, xs)
+            x_tail, pred, scaled, total = jax.device_get(outputs)
+            seconds = time.perf_counter() - started
+            if fresh:
+                _M_COMPILE_SECONDS.labels("cold").observe(seconds)
+            else:
+                _M_DISPATCH_SECONDS.labels("cold").observe(seconds)
             self._account(k)
             self._fill_results(items, x_tail, pred, scaled, total)
-        except BaseException as exc:  # surface on every waiting thread
-            # see _process_hot: a failed first dispatch must not leave the
-            # fresh-program marker behind
-            if key is not None:
-                self._fresh_programs.discard(key)
+        except BaseException as exc:
             for it in items:
                 it.error = exc
         finally:
             for it in items:
                 it.done.set()
-        # OUTSIDE the scoring try/finally: these requests already scored —
-        # a failed promotion (e.g. no HBM headroom for the unsharded copy;
-        # capacity mode exists because the fleet is big) must never turn
-        # their success into client errors. Logged, and retried naturally
-        # by the next cold hit.
+        # same post-success promotion accounting as the normal cold path
+        # (the demoted machine starts re-earning its slot immediately)
         if items and items[0].error is None:
             try:
                 self._maybe_promote(items)
@@ -557,6 +953,23 @@ class _Bucket:
                 logger.exception(
                     "hot-cache promotion failed (serving unaffected)"
                 )
+
+    def _demote(self, idx: int) -> None:
+        with self._hot_lock:
+            self._hot.pop(idx, None)
+            self._hot_last_use.pop(idx, None)
+            self._hot_hits.pop(idx, None)
+            self._hot_demotions[idx] = self._hot_demotions.get(idx, 0) + 1
+        _M_HOT_EVENTS.labels("demote").inc()
+
+    def _account(self, k: int, hot: bool = False) -> None:
+        self.dispatch_count += 1
+        self.request_count += k
+        if hot:
+            self.hot_request_count += k
+        self.max_batch_seen = max(self.max_batch_seen, k)
+        _M_REQUESTS.labels("hot" if hot else "cold").inc(k)
+        _M_DISPATCH_BATCH.observe(k)
 
     @staticmethod
     def _fill_results(items, x_tail, pred, scaled, total) -> None:
@@ -591,44 +1004,54 @@ class _Bucket:
     def _maybe_promote(self, items: List[_Item]) -> None:
         """After a successful cold dispatch: machines scoring their 2nd+
         cold request get an unsharded hot copy; freshness-guarded LRU
-        eviction bounds the cache. Runs on the leader thread only (see
-        __init__); the gather itself takes the shard dispatch lock (see
-        _gather_machine)."""
+        eviction bounds the cache. Runs on the COLLECTOR thread (the fetch
+        stage), so the promotion gather never blocks a leader's dispatch;
+        bookkeeping takes the hot lock, the gather itself runs outside it
+        (and takes the shard dispatch lock — see _gather_machine)."""
         if not self._hot_cap:
             return
         for idx in {it.idx for it in items}:
-            if idx in self._hot:
-                # hot machine served via a MIXED batch (the cold path):
-                # its traffic is demonstrably live, so refresh freshness —
-                # otherwise sustained concurrent spread traffic (always
-                # mixed batches) would age the whole cache past the guard
-                # and re-create the promote/evict churn it exists to stop
-                self._hot.move_to_end(idx)
+            with self._hot_lock:
+                if idx in self._hot:
+                    # hot machine served via a MIXED batch (the cold path):
+                    # its traffic is demonstrably live, so refresh
+                    # freshness — otherwise sustained concurrent spread
+                    # traffic (always mixed batches) would age the whole
+                    # cache past the guard and re-create the promote/evict
+                    # churn it exists to stop
+                    self._hot.move_to_end(idx)
+                    self._hot_last_use[idx] = self.dispatch_count
+                    continue
+                hits = self._hot_hits.get(idx, 0) + 1
+                self._hot_hits[idx] = hits
+                # base threshold 2; each past dispatch-failure demotion
+                # (see _dispatch_hot/_complete) multiplies it 8x, so a
+                # deterministically failing hot program backs off
+                # geometrically instead of re-entering the cache every
+                # other cold hit
+                if hits < 2 * (8 ** self._hot_demotions.get(idx, 0)):
+                    if self._hot_demotions.get(idx):
+                        _M_HOT_EVENTS.labels("backoff_defer").inc()
+                    continue
+                if len(self._hot) >= self._hot_cap:
+                    victim = next(iter(self._hot))
+                    age = self.dispatch_count - self._hot_last_use.get(
+                        victim, 0
+                    )
+                    if age < self._hot_evict_window():
+                        continue  # working set is live — don't thrash it
+                    self._hot.pop(victim)
+                    self._hot_last_use.pop(victim, None)
+                    # evicted machines must re-earn promotion, or the next
+                    # cold hit would instantly thrash them back in
+                    self._hot_hits.pop(victim, None)
+                    _M_HOT_EVENTS.labels("evict").inc()
+            # the gather dispatches a multi-device resharding program —
+            # outside the hot lock, so leader routing never stalls on it
+            tree = self._gather_machine(idx)
+            with self._hot_lock:
+                self._hot[idx] = tree
                 self._hot_last_use[idx] = self.dispatch_count
-                continue
-            hits = self._hot_hits.get(idx, 0) + 1
-            self._hot_hits[idx] = hits
-            # base threshold 2; each past dispatch-failure demotion (see
-            # _process_hot) multiplies it 8x, so a deterministically
-            # failing hot program backs off geometrically instead of
-            # re-entering the cache every other cold hit
-            if hits < 2 * (8 ** self._hot_demotions.get(idx, 0)):
-                if self._hot_demotions.get(idx):
-                    _M_HOT_EVENTS.labels("backoff_defer").inc()
-                continue
-            if len(self._hot) >= self._hot_cap:
-                victim = next(iter(self._hot))
-                age = self.dispatch_count - self._hot_last_use.get(victim, 0)
-                if age < self._hot_evict_window():
-                    continue  # working set is live — don't thrash it
-                self._hot.pop(victim)
-                self._hot_last_use.pop(victim, None)
-                # evicted machines must re-earn promotion, or the next
-                # cold hit would instantly thrash them back in
-                self._hot_hits.pop(victim, None)
-                _M_HOT_EVENTS.labels("evict").inc()
-            self._hot[idx] = self._gather_machine(idx)
-            self._hot_last_use[idx] = self.dispatch_count
             _M_HOT_EVENTS.labels("promote").inc()
 
 
@@ -812,16 +1235,33 @@ class ServingEngine:
         """Score one synthetic request per bucket so its program compiles
         (and its stacked params land on device) before traffic arrives —
         the first real request then pays dispatch, not XLA compile
-        (~20-40 s on TPU, far beyond any latency target). ``rows``: warm
-        the padded-row bucket real requests will hit (default: the
-        smallest row count each bucket can score). Returns the number of
-        buckets warmed."""
+        (~20-40 s on TPU, far beyond any latency target). In shard mode
+        this also pre-pays each bucket's HOT path: the promotion-gather
+        resharding program and the hot-cache scoring program compile here,
+        so the first live promotion no longer pays an XLA compile inside a
+        request. ``rows``: warm the padded-row bucket real requests will
+        hit (default: the smallest row count each bucket can score).
+        Returns the number of buckets warmed."""
         for bucket in self._buckets:
             need = bucket.lookback + (bucket.lookahead or 0)
             n = max(rows or 0, need, 1)
             first = bucket.names[0]
             self.anomaly(first, np.zeros((n, bucket.n_features), np.float32))
+            bucket.warmup_hot(_round_up_pow2(n, self.min_rows_bucket))
         return len(self._buckets)
+
+    def close(self) -> None:
+        """Stop every bucket's collector thread (draining in-flight work
+        first). The server's reload path calls this on the OLD generation
+        after its requests drain; engines simply dropped (tests, scripts)
+        are covered by the collectors' weakref backstop instead."""
+        for bucket in self._buckets:
+            bucket.close()
+
+    def quiesce(self) -> None:
+        """Drain every bucket's fetch stage (see ``_Bucket.quiesce``)."""
+        for bucket in self._buckets:
+            bucket.quiesce()
 
     def can_score(self, name: str) -> bool:
         return name in self._by_name
@@ -939,6 +1379,10 @@ class ServingEngine:
             # 0 = single-device replicated (latency mode); >0 = stacked
             # params sharded over that many devices (capacity mode)
             "shard_mesh_devices": self.mesh.size if self.mesh else 0,
+            # bounded in-flight dispatches per bucket (1 = serial mode)
+            "dispatch_depth": (
+                self._buckets[0].dispatch_depth if self._buckets else 0
+            ),
             # shard-mode hot cache: machines currently holding an unsharded
             # device copy, and requests that skipped the sharded gather
             "hot_machines": sum(len(b._hot) for b in self._buckets),

@@ -65,6 +65,7 @@ from ..resilience.quarantine import Quarantine
 from ..serializer import dumps as serializer_dumps
 from ..serializer import load, load_metadata
 from ..store import generations as store_generations
+from .. import wire
 from .engine import ScoreResult, ServingEngine
 
 logger = logging.getLogger(__name__)
@@ -78,6 +79,13 @@ _M_REQUESTS = REGISTRY.counter(
     "gordo_server_requests_total",
     "HTTP requests served, by endpoint and status code",
     labels=("endpoint", "status"),
+)
+_M_WIRE_FORMAT = REGISTRY.counter(
+    "gordo_server_wire_format_total",
+    "Scoring responses by negotiated wire format (npz = binary "
+    "application/x-gordo-npz, fast_json = the printf-rendered JSON "
+    "fallback) — shows whether clients actually adopt the binary plane",
+    labels=("format",),
 )
 
 _URL_MAP = Map(
@@ -483,6 +491,11 @@ class ModelServer:
                         "requests after %.1fs drain; releasing anyway",
                         self.drain_timeout,
                     )
+                # stop the old generation's collector threads (drains its
+                # fetch queue first); without this every reload would leak
+                # one idle thread per bucket until the weakref backstop
+                # notices the bucket is gone
+                state.engine.close()
                 logger.info(
                     "Reload: +%d / -%d / refreshed %d -> %d machine(s)%s",
                     len(added),
@@ -860,13 +873,9 @@ class ModelServer:
                 return machine.model.predict(X)
 
         output = self._guarded(machine, run, "Prediction failed")
-        return _json(
-            {
-                "data": {
-                    "model-input": X.tolist(),
-                    "model-output": np.asarray(output).tolist(),
-                }
-            }
+        return self._scored_response(
+            request,
+            {"model-input": X, "model-output": np.asarray(output)},
         )
 
     def _anomaly(
@@ -897,21 +906,53 @@ class ModelServer:
                 timestamps = timestamps_all[
                     len(timestamps_all) - len(scored.total_anomaly_score) :
                 ]
-        data = {
-            "model-input": scored.model_input.tolist(),
-            "model-output": scored.model_output.tolist(),
-            "tag-anomaly-scores": scored.tag_anomaly_scores.tolist(),
-            "total-anomaly-score": scored.total_anomaly_score.tolist(),
+        arrays = {
+            "model-input": scored.model_input,
+            "model-output": scored.model_output,
+            "tag-anomaly-scores": scored.tag_anomaly_scores,
+            "total-anomaly-score": scored.total_anomaly_score,
         }
-        if timestamps is not None:
-            data["timestamps"] = timestamps
         thresholds = {}
         if getattr(model, "tag_thresholds_", None) is not None:
             thresholds = {
                 "tag-thresholds": [float(v) for v in model.tag_thresholds_],
                 "total-threshold": model.total_threshold_,
             }
-        return _json({"data": data, **thresholds})
+        return self._scored_response(
+            request, arrays, timestamps=timestamps, extras=thresholds
+        )
+
+    @staticmethod
+    def _scored_response(
+        request: Request,
+        arrays: Dict[str, Any],
+        timestamps: Optional[List[str]] = None,
+        extras: Optional[Dict[str, Any]] = None,
+    ) -> Response:
+        """Scoring response with negotiated wire format: clients whose
+        ``Accept`` lists ``application/x-gordo-npz`` get ONE binary blob
+        (the arrays at native float32 + a JSON header); everyone else gets
+        the schema-identical JSON body through the fast printf encoder —
+        either way, no per-element ``.tolist()`` churn on the hot path
+        (docs/ARCHITECTURE.md §12)."""
+        arrays = {
+            name: np.asarray(getattr(arr, "values", arr))
+            for name, arr in arrays.items()
+        }
+        if wire.wants_npz(request.headers.get("Accept")):
+            header = dict(extras or {})
+            if timestamps is not None:
+                header["timestamps"] = timestamps
+            _M_WIRE_FORMAT.labels("npz").inc()
+            return Response(
+                wire.encode_npz(arrays, header),
+                mimetype=wire.NPZ_CONTENT_TYPE,
+            )
+        _M_WIRE_FORMAT.labels("fast_json").inc()
+        return Response(
+            wire.encode_scored_json(arrays, timestamps, extras),
+            mimetype="application/json",
+        )
 
     def _score_guarded(self, machine: _Machine, X, state: _ServerState):
         return self._guarded(

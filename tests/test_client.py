@@ -95,6 +95,134 @@ def test_client_machine_discovery(served):
     assert client.resolve_machines() == ["mach-1", "mach-2"]
 
 
+def test_client_negotiates_npz_and_pools_session(served):
+    """Chunk fetches ride the binary wire format (visible in the server's
+    wire-format counter) through ONE pooled aiohttp session that survives
+    across predict() calls; close() releases it and a later call simply
+    rebuilds the pool."""
+    from gordo_components_tpu.observability.registry import REGISTRY
+
+    def npz_count():
+        series = REGISTRY.snapshot().get(
+            "gordo_server_wire_format_total", {}
+        ).get("series", {})
+        return sum(
+            value for labels, value in series.items() if 'format="npz"' in labels
+        )
+
+    with Client(served, project="proj", max_interval="12h") as client:
+        before = npz_count()
+        frames = client.predict(
+            "2023-02-01T00:00:00+00:00", "2023-02-02T00:00:00+00:00"
+        )
+        assert set(frames) == {"mach-1", "mach-2"}
+        for frame in frames.values():
+            assert np.isfinite(frame["total-anomaly-score"].values).all()
+        # the server (in-process: shared registry) really answered npz
+        assert npz_count() > before
+        # the pooled session persists across calls...
+        session_first = client._session
+        assert session_first is not None and not session_first.closed
+        client.predict(
+            "2023-02-01T00:00:00+00:00", "2023-02-01T06:00:00+00:00",
+            machine_names=["mach-1"],
+        )
+        assert client._session is session_first
+    # ...and the context-manager exit released it
+    assert session_first.closed
+    assert client._session is None
+
+    # a closed client is reusable: the pool is rebuilt lazily
+    frames = client.predict(
+        "2023-02-01T00:00:00+00:00", "2023-02-01T06:00:00+00:00",
+        machine_names=["mach-2"],
+    )
+    assert set(frames) == {"mach-2"}
+    client.close()
+    client.close()  # idempotent
+
+
+def test_client_close_cancels_inflight_predict():
+    """close() while a predict() is mid-await must cancel the in-flight
+    work so the predicting thread surfaces an error promptly — never hang
+    forever on a future whose I/O loop silently exited."""
+    import socket
+    import time
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+    conns = []
+
+    def sink():  # accept, then stall: the request never completes
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            conns.append(conn)
+
+    threading.Thread(target=sink, daemon=True).start()
+    client = Client(
+        f"http://127.0.0.1:{port}", project="proj", timeout=30, retries=0
+    )
+    outcome = {}
+
+    def call():
+        try:
+            client.predict(
+                "2023-02-01", "2023-02-01T06:00:00", machine_names=["m"]
+            )
+            outcome["result"] = "returned"
+        except BaseException as exc:
+            outcome["result"] = type(exc).__name__
+
+    thread = threading.Thread(target=call)
+    thread.start()
+    time.sleep(1.0)  # let the chunk fetch park on the stalled socket
+    try:
+        client.close()
+        thread.join(timeout=15)
+        assert not thread.is_alive(), "predict() hung after close()"
+        assert outcome["result"] != "returned"
+    finally:
+        srv.close()
+        for conn in conns:
+            conn.close()
+
+
+def test_client_npz_and_json_chunks_build_identical_frames(served):
+    """The npz decode path and the JSON decode path feed one frame
+    builder: frames from a binary-speaking client match a JSON-only
+    client's frames exactly at float32 resolution."""
+    span = ("2023-02-01T00:00:00+00:00", "2023-02-01T12:00:00+00:00")
+    npz_client = Client(served, project="proj", max_interval="6h")
+    json_client = Client(served, project="proj", max_interval="6h")
+    # strip the Accept negotiation from one client: it falls back to JSON
+    original_headers = json_client._headers
+
+    def json_only():
+        headers = original_headers()
+        headers["Accept"] = "application/json"
+        return headers
+
+    json_client._headers = json_only
+    try:
+        a = npz_client.predict(*span, machine_names=["mach-1"])["mach-1"]
+        b = json_client.predict(*span, machine_names=["mach-1"])["mach-1"]
+    finally:
+        npz_client.close()
+        json_client.close()
+    assert len(a) == len(b) > 0
+    assert list(a.columns) == list(b.columns)
+    for column in a.columns:
+        np.testing.assert_array_equal(
+            a[column].values.astype(np.float32),
+            b[column].values.astype(np.float32),
+        )
+
+
 def test_client_explicit_machine_subset(served):
     client = Client(served, project="proj")
     frames = client.predict("2023-02-01", "2023-02-01T06:00:00",

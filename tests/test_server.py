@@ -190,6 +190,89 @@ def test_forecast_machine_serves_over_http(tmp_path):
     assert metrics["engine"]["host_path_machines"] == {}
 
 
+def test_anomaly_npz_negotiation_parity(client):
+    """Accept: application/x-gordo-npz answers ONE binary blob whose
+    decoded arrays are byte-identical to the JSON response's values (cast
+    to float32) — the wire-format parity gate, over the real WSGI stack."""
+    from gordo_components_tpu import wire
+
+    X = np.random.default_rng(5).normal(size=(64, 3)).tolist()
+    path = "/gordo/v0/proj/machine-a/anomaly/prediction"
+    json_body = _post(client, path, {"X": X}).get_json()
+    npz_response = client.post(
+        path,
+        data=json.dumps({"X": X}),
+        content_type="application/json",
+        headers={"Accept": wire.NPZ_CONTENT_TYPE},
+    )
+    assert npz_response.status_code == 200
+    assert npz_response.content_type == wire.NPZ_CONTENT_TYPE
+    arrays, header = wire.decode_npz(npz_response.get_data())
+    assert set(arrays) == {
+        "model-input", "model-output", "tag-anomaly-scores",
+        "total-anomaly-score",
+    }
+    for name, arr in arrays.items():
+        assert arr.dtype == np.float32
+        json_arr = np.asarray(json_body["data"][name], np.float32)
+        assert arr.tobytes() == json_arr.tobytes(), name
+    # thresholds ride the npz header, same values as the JSON top level
+    assert header["tag-thresholds"] == json_body["tag-thresholds"]
+    assert header["total-threshold"] == json_body["total-threshold"]
+    # the binary payload is materially smaller than its JSON twin (at
+    # realistic payload sizes — the fixed zip-container overhead only
+    # dominates below a few dozen rows)
+    assert len(npz_response.get_data()) < len(
+        json.dumps(json_body).encode()
+    )
+
+
+def test_prediction_npz_negotiation(client):
+    from gordo_components_tpu import wire
+
+    X = np.zeros((5, 3)).tolist()
+    response = client.post(
+        "/gordo/v0/proj/machine-p/prediction",
+        data=json.dumps({"X": X}),
+        content_type="application/json",
+        headers={"Accept": f"{wire.NPZ_CONTENT_TYPE}, application/json"},
+    )
+    assert response.status_code == 200
+    assert response.content_type == wire.NPZ_CONTENT_TYPE
+    arrays, _ = wire.decode_npz(response.get_data())
+    assert arrays["model-input"].shape == (5, 3)
+    assert arrays["model-output"].shape == (5, 3)
+
+
+def test_npz_with_server_side_fetch_carries_timestamps(client):
+    from gordo_components_tpu import wire
+
+    response = client.post(
+        "/gordo/v0/proj/machine-a/anomaly/prediction"
+        "?start=2023-02-01T00:00:00%2B00:00&end=2023-02-02T00:00:00%2B00:00",
+        headers={"Accept": wire.NPZ_CONTENT_TYPE},
+    )
+    assert response.status_code == 200
+    arrays, header = wire.decode_npz(response.get_data())
+    assert len(header["timestamps"]) == len(arrays["total-anomaly-score"]) > 0
+
+
+def test_plain_accept_still_json(client):
+    """Clients that don't speak npz (or send */*) keep getting JSON."""
+    X = np.zeros((4, 3)).tolist()
+    for accept in (None, "*/*", "application/json"):
+        headers = {"Accept": accept} if accept else {}
+        response = client.post(
+            "/gordo/v0/proj/machine-a/anomaly/prediction",
+            data=json.dumps({"X": X}),
+            content_type="application/json",
+            headers=headers,
+        )
+        assert response.status_code == 200
+        assert response.content_type.startswith("application/json")
+        assert len(response.get_json()["data"]["total-anomaly-score"]) == 4
+
+
 def test_anomaly_with_server_side_fetch(client):
     response = client.post(
         "/gordo/v0/proj/machine-a/anomaly/prediction"

@@ -236,9 +236,11 @@ def test_shard_fleet_hot_cache_engages_over_http(live_server, monkeypatch):
         shard_fleet=True,
     )
     with _serve(app) as port:
-        payloads = [
-            _post_scores(port) for _ in range(6)
-        ]  # 2 cold -> promote -> 4 hot
+        payloads = [_post_scores(port) for _ in range(2)]  # 2 cold
+        # promotion rides the engine's fetch stage (pipelined dispatch):
+        # drain it so the remaining requests deterministically serve hot
+        app.engine.quiesce()
+        payloads += [_post_scores(port) for _ in range(4)]  # 4 hot
         assert all(status == 200 for status, _, _ in payloads)
         stats = app.engine.stats()
         assert stats["shard_mesh_devices"] == 8

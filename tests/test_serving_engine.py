@@ -540,8 +540,10 @@ def test_mesh_sharded_hot_cache_promotes_and_matches(fitted_pair, monkeypatch):
     (n1, (_, X1)), (n2, (_, X2)) = sorted(fitted_pair.items())
 
     cold = engine.anomaly(n1, X1)  # hit 1: cold
+    engine.quiesce()
     assert engine.stats()["hot_machines"] == 0
     engine.anomaly(n1, X1)  # hit 2: cold, then promoted
+    engine.quiesce()  # promotion rides the fetch stage (pipelined dispatch)
     assert engine.stats()["hot_machines"] == 1
     hot = engine.anomaly(n1, X1)  # served from the hot copy
     stats = engine.stats()
@@ -558,13 +560,16 @@ def test_mesh_sharded_hot_cache_promotes_and_matches(fitted_pair, monkeypatch):
     # cap=1: promoting the second machine evicts the first (LRU)
     engine.anomaly(n2, X2)
     engine.anomaly(n2, X2)
+    engine.quiesce()
     assert engine.stats()["hot_machines"] == 1
     engine.anomaly(n2, X2)
     assert engine.stats()["hot_requests"] == 2
     # the evicted machine re-earns promotion from zero hits
     engine.anomaly(n1, X1)
+    engine.quiesce()
     assert engine.stats()["hot_machines"] == 1  # still only n2 hot
     engine.anomaly(n1, X1)  # 2nd post-eviction cold hit -> promoted again
+    engine.quiesce()
     final = engine.anomaly(n1, X1)
     np.testing.assert_allclose(
         final.total_anomaly_score, cold.total_anomaly_score, atol=1e-6
@@ -587,11 +592,13 @@ def test_mesh_sharded_hot_cache_freshness_guard(fitted_pair):
 
     engine.anomaly(n1, X1)
     engine.anomaly(n1, X1)  # promoted
+    engine.quiesce()  # promotion rides the fetch stage (pipelined dispatch)
     engine.anomaly(n1, X1)  # hot -> last_use fresh
     assert engine.stats()["hot_machines"] == 1
     # n2 earns promotion-by-hits, but n1's slot is freshly used: skipped
     for _ in range(4):
         engine.anomaly(n2, X2)
+    engine.quiesce()
     stats = engine.stats()
     assert stats["hot_machines"] == 1
     # ... and n1 still serves hot (was never evicted)
@@ -627,12 +634,14 @@ def test_mesh_sharded_hot_cache_stable_under_uniform_spread():
     for _ in range(2):  # pass 2 promotes the first hot_cap machines
         for name in names:
             engine.anomaly(name, X)
+    engine.quiesce()  # promotions ride the fetch stage
     bucket, _ = engine._by_name[names[0]]
     working_set = set(bucket._hot)
     assert len(working_set) == 2
     for _ in range(2):  # uniform spread: the set must hold, not rotate
         for name in names:
             engine.anomaly(name, X)
+    engine.quiesce()
     assert set(bucket._hot) == working_set
     # ... and the hot machines really served hot through those passes
     assert engine.stats()["hot_requests"] >= 4
@@ -667,6 +676,7 @@ def test_mesh_sharded_steady_state_tail_latency_bounded():
     for _ in range(3):  # compiles, promotions, first hot dispatches
         for name in names:
             engine.anomaly(name, X)
+    engine.quiesce()  # promotions ride the fetch stage
     # deterministically warm EVERY coalesced power-of-two batch program
     # (cold and hot variants): which sizes concurrent traffic produces is
     # timing-dependent, and one unwarmed size compiling mid-measurement
@@ -722,6 +732,7 @@ def test_mesh_sharded_hot_cache_demotes_failing_entry(fitted_pair):
 
     cold = engine.anomaly(n1, X1)
     engine.anomaly(n1, X1)  # promoted
+    engine.quiesce()  # promotion rides the fetch stage (pipelined dispatch)
     assert engine.stats()["hot_machines"] == 1
     bucket, _idx = engine._by_name[n1]
 
@@ -743,8 +754,10 @@ def test_mesh_sharded_hot_cache_demotes_failing_entry(fitted_pair):
     # dispatch above already counted as hit 1.
     for _ in range(14):
         engine.anomaly(n1, X1)
+    engine.quiesce()
     assert engine.stats()["hot_machines"] == 0  # still backing off
     engine.anomaly(n1, X1)  # hit 16 -> re-promoted (hot path repaired)
+    engine.quiesce()
     assert engine.stats()["hot_machines"] == 1
     before = engine.stats()["hot_requests"]
     again = engine.anomaly(n1, X1)

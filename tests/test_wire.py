@@ -1,0 +1,158 @@
+"""Wire formats (gordo_components_tpu.wire): npz round-trip, fast-JSON
+float32 exactness, schema parity with the legacy ``json.dumps`` encoder,
+and the negotiation predicate. Pure host-side — no jax, no server."""
+
+import json
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu import wire
+
+
+def _arrays(rows=17, tags=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "model-input": (rng.normal(size=(rows, tags)) * 3 + 5).astype(
+            np.float32
+        ),
+        "model-output": rng.normal(size=(rows, tags)).astype(np.float32),
+        "tag-anomaly-scores": np.abs(rng.normal(size=(rows, tags))).astype(
+            np.float32
+        ),
+        "total-anomaly-score": np.abs(rng.normal(size=(rows,))).astype(
+            np.float32
+        ),
+    }
+
+
+def test_npz_round_trip_arrays_and_header():
+    arrays = _arrays()
+    header = {
+        "timestamps": ["2026-01-01T00:00:00+00:00", "2026-01-01T00:10:00+00:00"],
+        "tag-thresholds": [0.1, 0.2, 0.3, 0.4, 0.5],
+        "total-threshold": 1.25,
+    }
+    blob = wire.encode_npz(arrays, header)
+    decoded, decoded_header = wire.decode_npz(blob)
+    assert decoded_header == header
+    assert set(decoded) == set(arrays)
+    for name, arr in arrays.items():
+        assert decoded[name].dtype == arr.dtype
+        # byte-identical: the binary plane must never touch the values
+        assert decoded[name].tobytes() == arr.tobytes()
+
+
+def test_npz_payload_shape_matches_json_schema():
+    """payload_from_npz returns the SAME shape a JSON response parses to:
+    array fields + timestamps under "data", thresholds at the top level —
+    one downstream frame builder serves both formats."""
+    arrays = _arrays()
+    blob = wire.encode_npz(
+        arrays, {"timestamps": ["t0", "t1"], "total-threshold": 2.0}
+    )
+    payload = wire.payload_from_npz(blob)
+    assert set(payload) == {"data", "total-threshold"}
+    assert payload["total-threshold"] == 2.0
+    assert payload["data"]["timestamps"] == ["t0", "t1"]
+    assert payload["data"]["model-output"].dtype == np.float32
+
+
+def test_npz_decode_garbage_raises_value_error():
+    for blob in (b"", b"not an npz", b"PK\x03\x04truncated"):
+        with pytest.raises(ValueError):
+            wire.decode_npz(blob)
+
+
+def test_npz_empty_header_defaults():
+    blob = wire.encode_npz({"a": np.zeros((2, 2), np.float32)})
+    arrays, header = wire.decode_npz(blob)
+    assert header == {}
+    assert arrays["a"].shape == (2, 2)
+
+
+def test_fast_json_float32_round_trips_exactly():
+    """%.17g rendering must recover the EXACT float64 widening the legacy
+    ``.tolist()`` + ``json.dumps`` path shipped (historical-value
+    compatibility), and therefore the exact float32 bits — the property
+    the binary/JSON parity gate depends on."""
+    rng = np.random.default_rng(3)
+    arr = (rng.normal(size=(64, 7)) * 1e3).astype(np.float32)
+    # include awkward values: denormal-ish, huge, tiny, negatives, zero
+    arr[0, :4] = [1e-38, 3.4e38, -7.0000001e-5, 0.0]
+    parsed64 = np.asarray(json.loads(wire.format_float_array(arr)), np.float64)
+    legacy64 = np.asarray(json.loads(json.dumps(arr.tolist())), np.float64)
+    assert parsed64.tobytes() == legacy64.tobytes()
+    assert parsed64.astype(np.float32).tobytes() == arr.tobytes()
+    vec = arr[:, 0]
+    parsed_vec = np.asarray(
+        json.loads(wire.format_float_array(vec)), np.float32
+    )
+    assert parsed_vec.tobytes() == vec.tobytes()
+
+
+def test_fast_json_float64_keeps_full_precision():
+    """Host-path machines (model.anomaly fallback) score in float64; the
+    fast encoder must render those at %.17g so nothing is lost relative
+    to the old json.dumps(arr.tolist()) path."""
+    rng = np.random.default_rng(4)
+    arr = rng.normal(size=(16, 3)) * 1e3  # float64
+    arr[0, 0] = 0.1  # classic shortest-repr-vs-truncation case
+    parsed = np.asarray(json.loads(wire.format_float_array(arr)), np.float64)
+    assert parsed.tobytes() == arr.tobytes()
+
+
+def test_fast_json_empty_and_nonfinite():
+    assert wire.format_float_array(np.zeros((0, 3), np.float32)) == "[]"
+    assert wire.format_float_array(np.zeros((0,), np.float32)) == "[]"
+    # non-finite falls back to the stdlib encoder (NaN/Infinity extension)
+    arr = np.asarray([[1.0, float("nan")], [float("inf"), 2.0]], np.float32)
+    parsed = json.loads(wire.format_float_array(arr))
+    assert parsed[0][0] == 1.0 and parsed[1][1] == 2.0
+    assert np.isnan(parsed[0][1]) and np.isinf(parsed[1][0])
+
+
+def test_encode_scored_json_schema_matches_legacy_encoder():
+    """The spliced fast-JSON body parses to the exact structure the
+    historical json.dumps path produced: {"data": {...}} + top-level
+    extras, keys in the same places."""
+    arrays = _arrays(rows=5, tags=3, seed=1)
+    timestamps = [f"2026-01-01T00:{i:02d}:00+00:00" for i in range(5)]
+    extras = {"tag-thresholds": [0.5, 0.6, 0.7], "total-threshold": 1.5}
+    body = wire.encode_scored_json(arrays, timestamps, extras)
+    parsed = json.loads(body)
+    legacy = {
+        "data": {
+            **{name: arr.tolist() for name, arr in arrays.items()},
+            "timestamps": timestamps,
+        },
+        **extras,
+    }
+    assert set(parsed) == set(legacy)
+    assert set(parsed["data"]) == set(legacy["data"])
+    assert parsed["data"]["timestamps"] == timestamps
+    assert parsed["tag-thresholds"] == extras["tag-thresholds"]
+    # values match the legacy encoder to float32 exactness
+    for name in arrays:
+        got = np.asarray(parsed["data"][name], np.float32)
+        want = np.asarray(legacy["data"][name], np.float32)
+        assert got.tobytes() == want.tobytes()
+
+
+def test_encode_scored_json_no_timestamps_no_extras():
+    body = wire.encode_scored_json(
+        {"total-anomaly-score": np.asarray([1.5, 2.5], np.float32)}
+    )
+    assert json.loads(body) == {"data": {"total-anomaly-score": [1.5, 2.5]}}
+
+
+def test_wants_npz_negotiation():
+    assert wire.wants_npz("application/x-gordo-npz")
+    assert wire.wants_npz("application/x-gordo-npz, application/json")
+    assert wire.wants_npz("application/json, application/x-gordo-npz;q=0.9")
+    assert wire.wants_npz("Application/X-Gordo-NPZ")
+    assert not wire.wants_npz(None)
+    assert not wire.wants_npz("")
+    assert not wire.wants_npz("application/json")
+    assert not wire.wants_npz("*/*")  # conservative: JSON stays the default
+    assert not wire.wants_npz("application/x-gordo-npz-v2")

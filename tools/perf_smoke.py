@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Perf smoke: the serving data plane's parity + saturation gates on the
+CPU backend (``make perf-smoke``).
+
+Checks (ISSUE 4 acceptance, minus anything rig-dependent — deliberately NO
+thresholds on absolute RPS, CI boxes vary):
+
+- wire-format parity: an ``application/x-gordo-npz`` response decodes to
+  arrays byte-identical to the JSON response's values (float32), over the
+  real WSGI stack;
+- pipeline parity: pipelined dispatch (``GORDO_DISPATCH_DEPTH=2``) is
+  bit-identical to serial mode (depth 1) on the same engine inputs;
+- saturation sanity: a short concurrent sweep (1/4/8 workers) over the
+  engine completes with every request succeeding and the dispatch
+  pipeline engaged, in BOTH replicated and shard mode. Per-rung RPS is
+  printed for the log but deliberately not gated — 2-core CI boxes show
+  ±2.5x run-to-run variance, and a flaky gate teaches people to ignore
+  the battery (bench_serving.py is where throughput is tracked).
+
+Exit codes: 0 = all checks passed, 1 = at least one failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+# runnable straight from a checkout (python tools/perf_smoke.py):
+# sys.path[0] is tools/, the package lives one level up
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# 8 virtual devices so the shard-mode sweep exercises real partitioning
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+_failures = []
+
+
+def check(ok: bool, what: str) -> None:
+    print(f"  {'ok' if ok else 'FAIL'}: {what}")
+    if not ok:
+        _failures.append(what)
+
+
+def _bits(result) -> tuple:
+    import numpy as np
+
+    return tuple(
+        np.asarray(a).tobytes()
+        for a in (result.model_input, result.model_output,
+                  result.tag_anomaly_scores, result.total_anomaly_score)
+    )
+
+
+def wire_parity() -> None:
+    """Two-format parity over the real WSGI stack."""
+    import numpy as np
+    from werkzeug.test import Client as TestClient
+
+    from gordo_components_tpu import wire
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.server import build_app
+
+    import tempfile
+
+    print("\n[1/3] wire-format parity (npz vs JSON, real WSGI stack)")
+    data_config = {
+        "type": "RandomDataset",
+        "train_start_date": "2023-01-01T00:00:00+00:00",
+        "train_end_date": "2023-01-04T00:00:00+00:00",
+        "tag_list": ["t-a", "t-b", "t-c"],
+    }
+    model_config = {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "Pipeline": {
+                    "steps": [
+                        "MinMaxScaler",
+                        {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                              "dims": [4], "epochs": 1,
+                                              "batch_size": 32}},
+                    ]
+                }
+            }
+        }
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir = provide_saved_model(
+            "m-perf", model_config, data_config, os.path.join(tmp, "m-perf"),
+            evaluation_config={"cv_mode": "build_only"},
+        )
+        client = TestClient(build_app({"m-perf": model_dir}, project="proj"))
+        X = (np.random.default_rng(0).normal(size=(96, 3)) * 2 + 4).tolist()
+        body = json.dumps({"X": X})
+        path = "/gordo/v0/proj/m-perf/anomaly/prediction"
+        json_resp = client.post(path, data=body,
+                                content_type="application/json")
+        npz_resp = client.post(path, data=body,
+                               content_type="application/json",
+                               headers={"Accept": wire.NPZ_CONTENT_TYPE})
+        check(json_resp.status_code == 200, "JSON response 200")
+        check(npz_resp.status_code == 200, "npz response 200")
+        check(npz_resp.content_type == wire.NPZ_CONTENT_TYPE,
+              "npz content type negotiated")
+        if json_resp.status_code == 200 and npz_resp.status_code == 200:
+            json_data = json_resp.get_json()["data"]
+            arrays, _ = wire.decode_npz(npz_resp.get_data())
+            for name in wire.SCORE_FIELDS:
+                same = (
+                    np.asarray(json_data[name], np.float32).tobytes()
+                    == arrays[name].tobytes()
+                )
+                check(same, f"{name}: npz byte-identical to JSON@float32")
+            check(
+                len(npz_resp.get_data()) < len(json_resp.get_data()),
+                "npz payload smaller than JSON at 96 rows",
+            )
+
+
+def _build_engines():
+    import bench_serving
+
+    models = bench_serving.build_models(8, 64, 4)
+    return models
+
+
+def pipeline_parity(models) -> None:
+    import numpy as np
+
+    from gordo_components_tpu.server.engine import ServingEngine
+
+    print("\n[2/3] pipelined-vs-serial bit-identity")
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(64, 4)).astype(np.float32) * 2 + 4
+    os.environ["GORDO_DISPATCH_DEPTH"] = "1"
+    serial = ServingEngine(models)
+    os.environ["GORDO_DISPATCH_DEPTH"] = "2"
+    pipelined = ServingEngine(models)
+    os.environ.pop("GORDO_DISPATCH_DEPTH", None)
+    names = serial.machines()
+    identical = all(
+        _bits(serial.anomaly(n, X)) == _bits(pipelined.anomaly(n, X))
+        for n in names
+    )
+    check(identical, "depth=2 bit-identical to depth=1 across the fleet")
+    serial.close()
+    pipelined.close()
+
+
+def saturation_sweep(models, shard: bool) -> None:
+    import time
+
+    import numpy as np
+
+    from gordo_components_tpu.server.engine import ServingEngine
+
+    mode = "shard" if shard else "replicated"
+    print(f"\n[3/3] saturation sweep ({mode} mode, no absolute thresholds)")
+    mesh = None
+    if shard:
+        from gordo_components_tpu.parallel.mesh import fleet_mesh
+
+        mesh = fleet_mesh(8)
+    engine = ServingEngine(models, mesh=mesh)
+    names = engine.machines()
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(64, 4)).astype(np.float32) * 2 + 4
+    for _ in range(3):  # compiles + promotions + first hot dispatches
+        for n in names:
+            engine.anomaly(n, X)
+        engine.quiesce()
+
+    def one(i):
+        engine.anomaly(names[i % len(names)], X)
+
+    n_requests = 120
+    rungs = {}
+    ok = True
+    for workers in (1, 4, 8):
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(one, range(2 * workers)))  # settle threads
+            started = time.perf_counter()
+            try:
+                list(pool.map(one, range(n_requests)))
+            except Exception as exc:
+                ok = False
+                check(False, f"{mode} {workers}w: request failed: {exc}")
+                break
+            rungs[workers] = n_requests / (time.perf_counter() - started)
+    if ok:
+        check(True, f"all requests succeeded: " + ", ".join(
+            f"{w}w={rps:.0f}rps" for w, rps in rungs.items()
+        ))
+        stats = engine.stats()
+        check(stats["max_dispatch_batch"] >= 1 and stats["dispatches"] > 0,
+              f"{mode} dispatch pipeline engaged "
+              f"({stats['dispatches']} dispatches, "
+              f"max batch {stats['max_dispatch_batch']})")
+    engine.close()
+
+
+def main() -> int:
+    print("perf smoke: wire parity + pipeline parity + saturation sanity")
+    wire_parity()
+    models = _build_engines()
+    pipeline_parity(models)
+    saturation_sweep(models, shard=False)
+    saturation_sweep(models, shard=True)
+    if _failures:
+        print(f"\nPERF SMOKE FAILED: {len(_failures)} check(s)",
+              file=sys.stderr)
+        return 1
+    print("\nperf smoke passed: both wire formats agree, pipelined == "
+          "serial, saturation holds up")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
