@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke smoke images builder-image server-image watchman-image
+.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke smoke images builder-image server-image watchman-image
 
 test:
 	python -m pytest tests/ -q
@@ -41,9 +41,17 @@ store-fsck:
 perf-smoke:
 	JAX_PLATFORMS=cpu python tools/perf_smoke.py
 
+# span-timeline attribution check: drive a request through a
+# fault-injected 200ms dispatch delay and assert the flight recorder
+# shows the delay in the dispatch stage, the Chrome trace export is
+# Perfetto-valid JSON, `gordo trace dump` works, exemplars link
+# histograms to the trace, and watchman surfaces the slow request
+trace-smoke:
+	JAX_PLATFORMS=cpu python tools/trace_smoke.py
+
 # the full smoke battery: exposition + resilience + store integrity +
-# serving data plane
-smoke: metrics-smoke chaos-smoke store-fsck perf-smoke
+# serving data plane + span attribution
+smoke: metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke
 
 images: builder-image server-image watchman-image
 

@@ -633,6 +633,8 @@ def _append_history(out: Dict[str, Any]) -> None:
     driver exec number drift): every bench run appends one compact line to
     BENCH_HISTORY.jsonl so cross-round regressions are visible in-repo."""
     try:
+        import bench_serving
+
         line = {
             "device": out.get("device"),
             "degraded": "degraded" in out,
@@ -644,9 +646,16 @@ def _append_history(out: Dict[str, Any]) -> None:
                 k: os.environ[k]
                 for k in ("BENCH_MACHINES", "BENCH_EPOCHS", "BENCH_FULL",
                           "BENCH_CONFIGS", "BENCH_CV_PARALLEL", "BENCH_CPU",
-                          "BENCH_FIT_UNROLL")
+                          "BENCH_FIT_UNROLL", "BENCH_SERVE_MACHINES",
+                          "BENCH_SERVE_ROWS", "BENCH_SERVE_TAGS",
+                          "BENCH_SERVE_REQUESTS", "BENCH_SERVE_SHARD",
+                          "GORDO_DISPATCH_DEPTH")
                 if k in os.environ
             },
+            # RESOLVED knobs (not just overrides): an empty env row was
+            # unattributable — dispatch depth, device kind, shard mode,
+            # and wire formats now ride every history line
+            "effective": bench_serving.effective_env(),
             "value": out.get("value"),
             "calib_matmul_ms": out.get("calib_matmul_ms"),
             "exec_s": {
@@ -658,11 +667,7 @@ def _append_history(out: Dict[str, Any]) -> None:
         # GORDO_BENCH_HISTORY overrides the destination (tests point it
         # at /dev/null so smoke runs cannot pollute the checked-in
         # cross-round record with mocked/tiny-shape rows)
-        path = os.environ.get("GORDO_BENCH_HISTORY") or os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl"
-        )
-        with open(path, "a") as fh:
-            fh.write(json.dumps(line) + "\n")
+        bench_serving.append_history(line)
     except Exception:
         pass  # history is never worth failing an artifact over
 

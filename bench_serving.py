@@ -49,6 +49,46 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 
+def effective_env() -> dict:
+    """The knobs that actually shaped this run — resolved values, not
+    just whichever env vars happened to be set. BENCH_HISTORY.jsonl rows
+    previously carried ``"env": {}`` whenever nothing was overridden,
+    which made a serial-dispatch CPU row indistinguishable from a
+    depth-2 TPU row and perf trajectories unattributable."""
+    import jax
+
+    from gordo_components_tpu import wire
+    from gordo_components_tpu.observability.flightrec import RECORDER
+    from gordo_components_tpu.server.engine import _dispatch_depth
+
+    return {
+        "device": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
+        "dispatch_depth": _dispatch_depth(),
+        "shard": os.environ.get("BENCH_SERVE_SHARD", "0") == "1",
+        # the transport formats this build serves/measures (the wire
+        # block reports each one's encode/decode/bytes)
+        "wire_formats": ["json", "fast_json", "npz"],
+        "npz_content_type": wire.NPZ_CONTENT_TYPE,
+        "flightrec": RECORDER.enabled,
+    }
+
+
+def append_history(line: dict) -> None:
+    """Best-effort append to BENCH_HISTORY.jsonl (GORDO_BENCH_HISTORY
+    overrides the destination; tests point it at /dev/null). Shared by
+    bench.py and bench_serving.py so both artifacts' history rows land in
+    the one cross-round record."""
+    try:
+        path = os.environ.get("GORDO_BENCH_HISTORY") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl"
+        )
+        with open(path, "a") as fh:
+            fh.write(json.dumps(line) + "\n")
+    except Exception:
+        pass  # history is never worth failing an artifact over
+
+
 def resolve_sizes(degraded: bool = False) -> dict:
     """The one place BENCH_SERVE_* env sizes and their defaults are
     resolved — shared by the standalone ``main()`` and bench.py's embedded
@@ -443,6 +483,31 @@ def main() -> None:
     from gordo_components_tpu.observability.registry import REGISTRY
 
     result["metrics"] = REGISTRY.snapshot()
+    # one attributable history row per standalone run: explicit BENCH_*
+    # overrides AND the resolved knobs (dispatch depth, device, shard
+    # mode, wire formats) that shaped the numbers. The whole block is
+    # guarded — assembling the row (effective_env touches jax) must
+    # never cost a completed run its artifact print below.
+    try:
+        append_history({
+            "metric": "serving_p50_ms",
+            "degraded": degraded,
+            "env": {
+                k: os.environ[k]
+                for k in ("BENCH_SERVE_MACHINES", "BENCH_SERVE_ROWS",
+                          "BENCH_SERVE_TAGS", "BENCH_SERVE_REQUESTS",
+                          "BENCH_SERVE_SHARD", "BENCH_CPU",
+                          "GORDO_DISPATCH_DEPTH")
+                if k in os.environ
+            },
+            "effective": effective_env(),
+            "value": result.get("value"),
+            "end_to_end_p50_ms": result.get("end_to_end_p50_ms"),
+            "end_to_end_p99_ms": result.get("end_to_end_p99_ms"),
+            "concurrent_rps": result.get("concurrent_rps"),
+        })
+    except Exception:
+        pass  # history is never worth failing an artifact over
     print(json.dumps(result))
 
 

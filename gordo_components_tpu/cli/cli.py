@@ -547,6 +547,78 @@ def workflow_generate_cmd(machine_config, output_file, image, parallelism,
         click.echo(manifest)
 
 
+@gordo.group("trace")
+def trace_group():
+    """Flight-recorder timelines from a running model server."""
+
+
+@trace_group.command("list")
+@click.option("--base-url", required=True, help="model-server base URL")
+@click.option("--limit", default=20, show_default=True,
+              help="recent timelines to list")
+def trace_list_cmd(base_url, limit):
+    """List recorded request timelines (recent + slowest + errored)."""
+    import requests
+
+    url = f"{base_url.rstrip('/')}/debug/requests?limit={limit}"
+    try:
+        response = requests.get(url, timeout=10)
+        response.raise_for_status()
+    except requests.RequestException as exc:
+        logger.error("Could not list traces from %s: %s", base_url, exc)
+        sys.exit(1)
+    click.echo(json.dumps(response.json(), indent=2))
+
+
+@trace_group.command("dump")
+@click.argument("trace_id")
+@click.option("--base-url", required=True, help="model-server base URL")
+@click.option("--output", "-o", default=None,
+              help="write to this file instead of stdout")
+@click.option("--format", "fmt", default="chrome", show_default=True,
+              type=click.Choice(["chrome", "json"]),
+              help="chrome = trace-event JSON (open at "
+                   "https://ui.perfetto.dev or chrome://tracing); "
+                   "json = the raw timeline with stage totals")
+def trace_dump_cmd(trace_id, base_url, output, fmt):
+    """Dump ONE trace's per-stage timeline.
+
+    TRACE_ID is the ``X-Gordo-Trace-Id`` a response echoed (or a trace id
+    from ``gordo trace list`` / watchman's slow-requests view). The
+    default output is Chrome trace-event JSON — load it in Perfetto to
+    see exactly which stage (queue wait, dispatch, device execution,
+    fetch, encode) the request's time went to.
+    """
+    import requests
+
+    url = f"{base_url.rstrip('/')}/debug/requests/{trace_id}"
+    if fmt == "chrome":
+        url += "?format=chrome"
+    try:
+        response = requests.get(url, timeout=10)
+    except requests.RequestException as exc:
+        logger.error("Could not fetch trace from %s: %s", base_url, exc)
+        sys.exit(1)
+    if response.status_code == 404:
+        logger.error(
+            "Trace %s is not in the flight recorder (rotated out, or "
+            "never seen by this server)", trace_id,
+        )
+        sys.exit(1)
+    try:
+        response.raise_for_status()
+    except requests.RequestException as exc:
+        logger.error("Trace fetch failed: %s", exc)
+        sys.exit(1)
+    body = json.dumps(response.json(), indent=2)
+    if output:
+        with open(output, "w") as fh:
+            fh.write(body)
+        click.echo(output)
+    else:
+        click.echo(body)
+
+
 @gordo.group("client")
 def client_group():
     """Bulk prediction against running servers."""

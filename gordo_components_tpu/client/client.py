@@ -20,6 +20,7 @@ the pool; a dropped client is cleaned up best-effort.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import random
 import threading
@@ -31,7 +32,7 @@ import numpy as np
 import pandas as pd
 
 from .. import wire
-from ..observability import tracing
+from ..observability import flightrec, spans, tracing
 from ..observability.registry import REGISTRY
 from ..resilience import deadline
 from ..resilience.breaker import BreakerBoard
@@ -312,6 +313,26 @@ class Client:
 
     # -- async core ----------------------------------------------------------
     async def _fetch_chunk(
+        self, session, semaphore, machine: str, start, end,
+        ctx: spans.SpanContext = spans.EMPTY_CONTEXT,
+    ) -> Dict[str, Any]:
+        # the caller's span context arrives EXPLICITLY: this coroutine
+        # runs on the pooled I/O loop's thread, whose contextvars know
+        # nothing about the predict() caller — binding restores the trace
+        # id for this task's log records and routes chunk_fetch/decode
+        # spans to the caller's timeline
+        with spans.bind(ctx):
+            # one trace id per chunk request (adopting any id already
+            # bound): the server echoes it and stamps it on its log
+            # records, so a slow chunk is grep-able end to end — and
+            # binding it HERE (not just in the header) stamps the
+            # client-side retry/backoff records of this chunk too
+            with tracing.trace(tracing.current_or_new()):
+                return await self._fetch_chunk_traced(
+                    session, semaphore, machine, start, end
+                )
+
+    async def _fetch_chunk_traced(
         self, session, semaphore, machine: str, start, end
     ) -> Dict[str, Any]:
         url = (
@@ -319,9 +340,6 @@ class Client:
             f"/anomaly/prediction"
         )
         params = {"start": start.isoformat(), "end": end.isoformat()}
-        # one trace id per chunk request (adopting any id already bound to
-        # the calling context): the server echoes it and stamps it on its
-        # log records, so a slow chunk is grep-able end to end
         headers = self._headers()
         breaker = self._breaker()
         started = time.monotonic()
@@ -344,51 +362,61 @@ class Client:
                 # endpoint costs the few calls that tripped it, the rest
                 # fail here in microseconds
                 _M_REQUESTS.labels("circuit_open").inc()
+                spans.event(
+                    "circuit_open", base_url=self.base_url, machine=machine
+                )
                 raise ClientError(
                     f"{machine} [{start}, {end}): circuit open for "
                     f"{self.base_url} ({last_error or 'recent failures'})"
                 )
             try:
                 async with semaphore:
-                    async with session.post(
-                        url, params=params, headers=headers
-                    ) as response:
-                        if 400 <= response.status < 500:
-                            breaker.record(True)  # alive — the REQUEST is bad
-                            body = await response.text()
-                            _M_REQUESTS.labels("permanent_4xx").inc()
-                            raise ClientError(
-                                f"{machine} [{start}, {end}): "
-                                f"HTTP {response.status}: {body[:500]}"
+                    with spans.stage(
+                        "chunk_fetch", machine=machine, attempt=attempt
+                    ):
+                        async with session.post(
+                            url, params=params, headers=headers
+                        ) as response:
+                            if 400 <= response.status < 500:
+                                breaker.record(True)  # alive — the REQUEST
+                                # is bad
+                                body = await response.text()
+                                _M_REQUESTS.labels("permanent_4xx").inc()
+                                raise ClientError(
+                                    f"{machine} [{start}, {end}): "
+                                    f"HTTP {response.status}: {body[:500]}"
+                                )
+                            if response.status >= 500:
+                                hint = self._parse_retry_after(
+                                    response.headers.get("Retry-After")
+                                )
+                                # flow control from a LIVE server — a 503
+                                # shed carrying Retry-After, or a 504 for
+                                # OUR expired deadline — must not count
+                                # toward tripping the circuit; bare 5xx
+                                # (dead proxy, crash) does
+                                breaker.record(
+                                    response.status == 504
+                                    or (response.status == 503
+                                        and hint is not None)
+                                )
+                                retry_after = hint
+                                last_error = f"HTTP {response.status}"
+                                _M_RETRIES.labels("http_5xx").inc()
+                                continue
+                            ctype = wire.content_type_of(
+                                response.headers.get("Content-Type")
                             )
-                        if response.status >= 500:
-                            hint = self._parse_retry_after(
-                                response.headers.get("Retry-After")
-                            )
-                            # flow control from a LIVE server — a 503 shed
-                            # carrying Retry-After, or a 504 for OUR expired
-                            # deadline — must not count toward tripping the
-                            # circuit; bare 5xx (dead proxy, crash) does
-                            breaker.record(
-                                response.status == 504
-                                or (response.status == 503 and hint is not None)
-                            )
-                            retry_after = hint
-                            last_error = f"HTTP {response.status}"
-                            _M_RETRIES.labels("http_5xx").inc()
-                            continue
-                        ctype = wire.content_type_of(
-                            response.headers.get("Content-Type")
-                        )
-                        if ctype == wire.NPZ_CONTENT_TYPE:
-                            payload = wire.payload_from_npz(
-                                await response.read()
-                            )
-                        else:
-                            payload = await response.json()
-                        breaker.record(True)
-                        _M_REQUESTS.labels("ok").inc()
-                        return payload
+                            raw = await response.read()
+                    if ctype == wire.NPZ_CONTENT_TYPE:
+                        with spans.stage("decode", format="npz"):
+                            payload = wire.payload_from_npz(raw)
+                    else:
+                        with spans.stage("decode", format="json"):
+                            payload = json.loads(raw)
+                    breaker.record(True)
+                    _M_REQUESTS.labels("ok").inc()
+                    return payload
             except ClientError:
                 raise
             except asyncio.TimeoutError as exc:  # distinct: a timing-out
@@ -406,7 +434,8 @@ class Client:
         )
 
     async def _predict_async(
-        self, machines: List[str], ranges
+        self, machines: List[str], ranges,
+        ctx: spans.SpanContext = spans.EMPTY_CONTEXT,
     ) -> Dict[str, pd.DataFrame]:
         semaphore = asyncio.Semaphore(self.parallelism)
         # the POOLED session: one per Client (created here on first use),
@@ -415,7 +444,9 @@ class Client:
         session = await self._ensure_session()
         tasks = {
             (machine, i): asyncio.ensure_future(
-                self._fetch_chunk(session, semaphore, machine, start, end)
+                self._fetch_chunk(
+                    session, semaphore, machine, start, end, ctx=ctx
+                )
             )
             for machine in machines
             for i, (start, end) in enumerate(ranges)
@@ -506,12 +537,20 @@ class Client:
             retry_after = None
             if not breaker.allow():
                 _M_REQUESTS.labels("circuit_open").inc()
+                spans.event(
+                    "circuit_open", base_url=self.base_url, machine=machine
+                )
                 raise ClientError(
                     f"{machine}: circuit open for {self.base_url} "
                     f"({last_error or 'recent failures'})"
                 )
             try:
-                response = requests.post(url, timeout=self.timeout, **kwargs)
+                with spans.stage(
+                    "chunk_fetch", machine=machine, attempt=attempt
+                ):
+                    response = requests.post(
+                        url, timeout=self.timeout, **kwargs
+                    )
             except requests.Timeout as exc:
                 breaker.record(False)
                 last_error = repr(exc)
@@ -578,10 +617,50 @@ class Client:
         logger.info(
             "Client.predict: %d machines x %d chunks", len(machines), len(ranges)
         )
-        # run on the client's persistent I/O loop (NOT asyncio.run, which
-        # would build and tear down a loop — and the pooled session's
-        # connections with it — on every call)
-        frames = self._submit(self._predict_async(machines, ranges)).result()
+        # span context for the fan-out: the chunk coroutines run on the
+        # I/O loop's thread, so the caller's trace id / timeline must be
+        # captured HERE and handed over explicitly. A caller without a
+        # timeline gets one per predict() call (recorded into this
+        # process's flight recorder) so client-side chunk_fetch/decode
+        # attribution exists even for bare CLI runs.
+        ctx = spans.capture()
+        own_timeline = own_token = own_trace_token = None
+        if ctx.timeline is None and flightrec.RECORDER.enabled:
+            trace_id = ctx.trace_id or tracing.new_trace_id()
+            if not ctx.trace_id:
+                # bind the minted id too, or every chunk would mint its
+                # own unrelated one and the recorded timeline's trace id
+                # would correlate with nothing server-side
+                own_trace_token = tracing.set_trace_id(trace_id)
+            own_timeline, own_token = spans.begin(
+                trace_id,
+                kind="client.predict",
+                machines=len(machines),
+                chunks=len(ranges),
+            )
+            ctx = spans.capture()
+        try:
+            # run on the client's persistent I/O loop (NOT asyncio.run,
+            # which would build and tear down a loop — and the pooled
+            # session's connections with it — on every call)
+            frames = self._submit(
+                self._predict_async(machines, ranges, ctx=ctx)
+            ).result()
+        except BaseException as exc:
+            if own_timeline is not None:
+                own_timeline.finish(
+                    status="error", error=f"{type(exc).__name__}: {exc}"
+                )
+            raise
+        else:
+            if own_timeline is not None:
+                own_timeline.finish(status="ok")
+        finally:
+            if own_token is not None:
+                spans.end(own_token)
+                if own_trace_token is not None:
+                    tracing.reset_trace_id(own_trace_token)
+                flightrec.RECORDER.record(own_timeline)
         for forwarder in self.forwarders:
             for machine, frame in frames.items():
                 forwarder.forward(machine, frame)

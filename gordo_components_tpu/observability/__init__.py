@@ -9,12 +9,20 @@ Three small modules every layer shares:
   validation (``GET /metrics?format=prometheus``).
 - :mod:`.tracing` — contextvar trace/span ids propagated via the
   ``X-Gordo-Trace-Id`` header and stamped onto every log record.
+- :mod:`.spans` — per-request stage timelines (queue_wait / dispatch /
+  device_execute / fetch / ...) with explicit span-context capture
+  across the engine's collector threads and the client's asyncio
+  fan-out; Chrome trace-event (Perfetto) export per trace.
+- :mod:`.flightrec` — the always-on bounded flight recorder behind
+  ``/debug/requests`` (``RECORDER`` is the process instance).
 - :mod:`.logsetup` — text/JSON logging configuration for the CLI.
 """
 
 from .exposition import CONTENT_TYPE, parse_prometheus_text, render_prometheus
+from .flightrec import RECORDER, FlightRecorder
 from .logsetup import configure_logging
 from .registry import REGISTRY, Counter, Gauge, Histogram, Registry, get_registry
+from .spans import SpanContext, Timeline
 from .tracing import (
     TRACE_HEADER,
     current_or_new,
@@ -28,11 +36,15 @@ from .tracing import (
 __all__ = [
     "CONTENT_TYPE",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "RECORDER",
     "REGISTRY",
     "Registry",
+    "SpanContext",
     "TRACE_HEADER",
+    "Timeline",
     "configure_logging",
     "current_or_new",
     "get_registry",

@@ -8,6 +8,15 @@ inverse validator — used by ``tools/scrape_metrics.py`` and the tests so
 a malformed exposition fails loudly instead of silently dropping series
 at the scraper.
 
+Exemplars: histogram bucket samples may carry an OpenMetrics-style
+exemplar suffix — `` # {trace_id="3f2a..."} 0.042 1690000000.123`` —
+linking the aggregate bucket to a concrete request timeline in
+``/debug/requests``. The renderer emits one per bucket when the
+observation ran under a bound trace id; the parser validates the syntax
+(label grammar, the 128-char OpenMetrics label budget, bucket/counter
+placement only) and fails loudly on malformed exemplars so the
+exposition stays ingestible by Prometheus/OpenMetrics scrapers.
+
 No ``prometheus_client`` dependency: the format is a few dozen lines and
 this image must not grow packages (repo constraint), exactly like the
 werkzeug-not-flask decision in ``server/server.py``.
@@ -31,6 +40,16 @@ _SAMPLE_RE = re.compile(
     r"(?:\s+(-?[0-9]+))?$"                  # optional timestamp
 )
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+# exemplar suffix (OpenMetrics): `<sample> # {labels} value [timestamp]`.
+# The greedy prefix makes the LAST ` # {` on the line the exemplar
+# boundary, so escaped label values earlier in the line cannot split it.
+_EXEMPLAR_RE = re.compile(
+    r"^(?P<sample>.*\S)\s+#\s+\{(?P<labels>.*)\}"
+    r"\s+(?P<value>-?[0-9.eE+-]+|[+-]Inf|NaN)"
+    r"(?:\s+(?P<ts>[0-9]+(?:\.[0-9]+)?))?$"
+)
+# OpenMetrics: an exemplar's label names + values must fit 128 runes
+_EXEMPLAR_LABEL_BUDGET = 128
 
 
 def _escape_label(value: str) -> str:
@@ -66,8 +85,26 @@ def _fmt_labels(labelnames, values, extra: Tuple[str, str] = None) -> str:
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
-def render_prometheus(registry: Registry) -> str:
-    """The registry as Prometheus text exposition format v0.0.4."""
+def _fmt_exemplar(exemplar) -> str:
+    """`` # {trace_id="..."} value timestamp`` (OpenMetrics exemplar)."""
+    trace_id, value, ts = exemplar
+    return (
+        f' # {{trace_id="{_escape_label(trace_id)}"}} '
+        f"{_fmt_value(value)} {ts:.3f}"
+    )
+
+
+def render_prometheus(registry: Registry, exemplars: bool = False) -> str:
+    """The registry as Prometheus text exposition format v0.0.4.
+
+    ``exemplars=True`` additionally renders OpenMetrics-style exemplars
+    on histogram buckets whose last traced observation is known. That is
+    an OPT-IN extension (``?exemplars=1`` on the server): the classic
+    Prometheus text parser selected by the v0.0.4 content type rejects
+    the `` # {...}`` suffix outright, so the default scrape must stay
+    strict — exemplar output is for gordo's own tooling
+    (``tools/scrape_metrics.py``, trace debugging) and
+    OpenMetrics-capable ingesters."""
     lines: List[str] = []
     for metric in registry.metrics():
         if metric.help:
@@ -75,12 +112,16 @@ def render_prometheus(registry: Registry) -> str:
         lines.append(f"# TYPE {metric.name} {metric.kind}")
         if isinstance(metric, Histogram):
             for values, data in sorted(metric.collect().items()):
-                for le, cumulative in data["buckets"]:
+                series_exemplars = data.get("exemplars") or {}
+                for i, (le, cumulative) in enumerate(data["buckets"]):
                     labels = _fmt_labels(
                         metric.labelnames, values, extra=("le", _fmt_value(le))
                     )
+                    suffix = ""
+                    if exemplars and i in series_exemplars:
+                        suffix = _fmt_exemplar(series_exemplars[i])
                     lines.append(
-                        f"{metric.name}_bucket{labels} {cumulative}"
+                        f"{metric.name}_bucket{labels} {cumulative}{suffix}"
                     )
                 labels = _fmt_labels(metric.labelnames, values)
                 lines.append(
@@ -144,16 +185,79 @@ def _parse_value(raw: str, lineno: int) -> float:
         raise ValueError(f"line {lineno}: unparseable value {raw!r}") from None
 
 
-def parse_prometheus_text(text: str) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
-    """Parse + validate exposition text; ``{name: [(labels, value), ...]}``.
+def _parse_exemplar(line: str, lineno: int, types: Dict[str, str]):
+    """Detach and validate a trailing exemplar; returns ``(sample_part,
+    exemplar_dict_or_None)``.
+
+    A line only counts as carrying an exemplar when the exemplar suffix
+    matches AND what precedes it is itself a well-formed sample — a
+    quoted label value containing `` # `` is a legal plain sample, not a
+    malformed exemplar. Once a line IS an exemplar, every defect in it
+    (bad label grammar, over-budget label set, placement on anything but
+    a histogram bucket or counter) fails loudly — a scraper would either
+    reject it or silently drop the series."""
+    if " # " not in line:
+        return line, None
+    match = _EXEMPLAR_RE.match(line)
+    if match is not None:
+        sample_part = match.group("sample")
+        sample_match = _SAMPLE_RE.match(sample_part)
+        if sample_match is not None:
+            try:
+                _parse_label_body(sample_match.group(2) or "", lineno)
+            except ValueError:
+                sample_match = None  # not a valid sample prefix after all
+        if sample_match is not None:
+            labels = _parse_label_body(match.group("labels"), lineno)
+            if not labels:
+                raise ValueError(
+                    f"line {lineno}: exemplar must carry at least one label"
+                )
+            budget = sum(len(k) + len(v) for k, v in labels.items())
+            if budget > _EXEMPLAR_LABEL_BUDGET:
+                raise ValueError(
+                    f"line {lineno}: exemplar label set is {budget} runes "
+                    f"(OpenMetrics caps it at {_EXEMPLAR_LABEL_BUDGET})"
+                )
+            value = _parse_value(match.group("value"), lineno)
+            ts = float(match.group("ts")) if match.group("ts") else None
+            # placement: OpenMetrics allows exemplars on histogram
+            # buckets and counters only — anywhere else is malformed
+            name = sample_match.group(1)
+            base = (
+                name[: -len("_bucket")] if name.endswith("_bucket") else None
+            )
+            bucket_ok = base is not None and types.get(base) == "histogram"
+            counter_ok = types.get(name) == "counter"
+            if not (bucket_ok or counter_ok):
+                raise ValueError(
+                    f"line {lineno}: exemplar on {name!r}, which is "
+                    "neither a histogram bucket nor a counter"
+                )
+            return sample_part, {
+                "labels": labels, "value": value, "timestamp": ts,
+            }
+    # no well-formed exemplar: hand the whole line to the plain sample
+    # parser (which fails loudly itself if the line is genuinely broken)
+    return line, None
+
+
+def parse_prometheus_text(
+    text: str, return_exemplars: bool = False
+) -> Any:
+    """Parse + validate exposition text; ``{name: [(labels, value), ...]}``
+    (with ``return_exemplars=True``: ``(samples, exemplars)`` where
+    ``exemplars`` maps name → ``[(labels, exemplar_dict), ...]``).
 
     Raises ``ValueError`` (with the offending line number) on any line
     that is neither a well-formed comment nor a well-formed sample, on a
-    ``# TYPE`` naming an unknown metric type, and on a histogram whose
-    ``+Inf`` bucket disagrees with its ``_count`` — the inconsistencies a
-    real scraper rejects or silently mis-ingests.
+    ``# TYPE`` naming an unknown metric type, on a malformed or misplaced
+    exemplar, and on a histogram whose ``+Inf`` bucket disagrees with its
+    ``_count`` — the inconsistencies a real scraper rejects or silently
+    mis-ingests.
     """
     samples: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    exemplars: Dict[str, List[Tuple[Dict[str, str], Dict[str, Any]]]] = {}
     types: Dict[str, str] = {}
     for lineno, line in enumerate(text.splitlines(), start=1):
         line = line.rstrip()
@@ -176,6 +280,7 @@ def parse_prometheus_text(text: str) -> Dict[str, List[Tuple[Dict[str, str], flo
                     )
                 types[parts[2]] = kind
             continue
+        line, exemplar = _parse_exemplar(line, lineno, types)
         match = _SAMPLE_RE.match(line)
         if not match:
             raise ValueError(f"line {lineno}: malformed sample line {line!r}")
@@ -183,6 +288,8 @@ def parse_prometheus_text(text: str) -> Dict[str, List[Tuple[Dict[str, str], flo
         labels = _parse_label_body(body or "", lineno)
         value = _parse_value(raw_value, lineno)
         samples.setdefault(name, []).append((labels, value))
+        if exemplar is not None:
+            exemplars.setdefault(name, []).append((labels, exemplar))
 
     # histogram consistency: the +Inf bucket IS the count
     for name, kind in types.items():
@@ -208,6 +315,8 @@ def parse_prometheus_text(text: str) -> Dict[str, List[Tuple[Dict[str, str], flo
                     f"histogram {name}: +Inf bucket {inf_buckets[key]} != "
                     f"count {count} for series {key or '(unlabeled)'}"
                 )
+    if return_exemplars:
+        return samples, exemplars
     return samples
 
 

@@ -26,9 +26,26 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 INF = float("inf")
+
+
+_get_trace_id = None
+
+
+def _current_trace_id() -> str:
+    # lazy-bound import: tracing lazily imports this module inside
+    # span(), so a top-level import here would be circular; resolved
+    # once, then one contextvar read per call (this sits on the
+    # histogram observe hot path)
+    global _get_trace_id
+    if _get_trace_id is None:
+        from .tracing import get_trace_id
+
+        _get_trace_id = get_trace_id
+    return _get_trace_id()
 
 # latency-oriented default buckets (seconds): sub-ms device dispatches up
 # through multi-second compiles land in distinct buckets
@@ -149,13 +166,20 @@ class _BoundGauge:
 
 
 class _HistSeries:
-    __slots__ = ("bucket_counts", "sum", "count", "samples")
+    __slots__ = ("bucket_counts", "sum", "count", "samples", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.bucket_counts = [0] * n_buckets  # per-bucket (non-cumulative)
         self.sum = 0.0
         self.count = 0
         self.samples: List[float] = []  # bounded rolling window
+        # bucket index -> (trace_id, value, unix_ts): the most recent
+        # traced observation landing in that bucket — the OpenMetrics
+        # exemplar linking an aggregate bucket to a concrete request in
+        # the flight recorder. Bounded by construction (<= n_buckets
+        # entries per series); only observations made under a bound trace
+        # id record one.
+        self.exemplars: Dict[int, Tuple[str, float, float]] = {}
 
 
 class Histogram(_Metric):
@@ -188,6 +212,10 @@ class Histogram(_Metric):
     def _observe(self, values: Tuple[str, ...], value: float) -> None:
         value = float(value)
         i = bisect.bisect_left(self.buckets, value)
+        # exemplar capture outside the lock: one contextvar read, and a
+        # wall-clock read only when a trace is actually bound
+        trace_id = _current_trace_id()
+        exemplar = (trace_id, value, time.time()) if trace_id else None
         with self._lock:
             series = self._series.get(values)
             if series is None:
@@ -198,17 +226,21 @@ class Histogram(_Metric):
             series.samples.append(value)
             if len(series.samples) > self.keep:
                 del series.samples[: -self.keep]
+            if exemplar is not None:
+                series.exemplars[i] = exemplar
 
     def collect(self) -> Dict[Tuple[str, ...], Dict[str, Any]]:
         """Snapshot copy: ``{labelvalues: {"buckets": [(le, cumulative)],
-        "sum": s, "count": n, "samples": [...]}}``."""
+        "sum": s, "count": n, "samples": [...], "exemplars":
+        {bucket_index: (trace_id, value, ts)}}}``."""
         with self._lock:
             copied = {
-                values: (list(s.bucket_counts), s.sum, s.count, list(s.samples))
+                values: (list(s.bucket_counts), s.sum, s.count,
+                         list(s.samples), dict(s.exemplars))
                 for values, s in self._series.items()
             }
         out: Dict[Tuple[str, ...], Dict[str, Any]] = {}
-        for values, (counts, total, count, samples) in copied.items():
+        for values, (counts, total, count, samples, exemplars) in copied.items():
             cumulative, acc = [], 0
             for le, n in zip(self.buckets, counts):
                 acc += n
@@ -218,6 +250,7 @@ class Histogram(_Metric):
                 "sum": total,
                 "count": count,
                 "samples": samples,
+                "exemplars": exemplars,
             }
         return out
 

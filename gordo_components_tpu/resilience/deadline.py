@@ -93,6 +93,13 @@ def check(where: str) -> None:
     left = remaining()
     if left is not None and left <= 0.0:
         _M_EXPIRED.labels(where).inc()
+        # a 504 storm is diagnosable after the fact: the expiry lands as
+        # a point event on the request's timeline, naming the boundary
+        from ..observability import spans
+
+        spans.event(
+            "deadline_expired", where=where, overdue_s=round(-left, 3)
+        )
         raise DeadlineExceeded(
             f"deadline exceeded {-left:.3f}s ago (checked at {where})"
         )

@@ -62,6 +62,7 @@ import numpy as np
 
 from ..models.analysis import analyze_model
 from ..models.transformers import MinMaxScaler, StandardScaler
+from ..observability import spans
 from ..observability.registry import REGISTRY
 from ..ops import windowing
 from ..ops.scaling import ScalerParams
@@ -202,7 +203,8 @@ class _MachineEntry:
 
 
 class _Item:
-    __slots__ = ("idx", "x", "m_valid", "in_flight", "done", "result", "error")
+    __slots__ = ("idx", "x", "m_valid", "in_flight", "done", "result",
+                 "error", "ctx")
 
     def __init__(self, idx: int, x: np.ndarray, m_valid: int):
         self.idx = idx
@@ -215,6 +217,12 @@ class _Item:
         self.done = threading.Event()
         self.result: Optional[ScoreResult] = None
         self.error: Optional[BaseException] = None
+        # explicit span-context capture at submit time: the leader that
+        # dispatches this item and the collector that fetches it run on
+        # OTHER threads whose contextvars know nothing about this request
+        # — dispatch/device/fetch spans (and collector log records' trace
+        # ids) route through this instead
+        self.ctx = spans.capture()
 
 
 class _Dispatch:
@@ -222,10 +230,10 @@ class _Dispatch:
     outputs plus everything the collector needs to fan results out."""
 
     __slots__ = ("kind", "key", "fresh", "rows", "items", "outputs",
-                 "started", "hot_idx")
+                 "started", "enqueued", "hot_idx")
 
     def __init__(self, kind, key, fresh, rows, items, outputs, started,
-                 hot_idx=None):
+                 enqueued=None, hot_idx=None):
         self.kind = kind  # "cold" | "hot"
         self.key = key  # program-cache key, for compile-vs-dispatch timing
         self.fresh = fresh  # True: this dispatch pays the XLA compile
@@ -233,6 +241,10 @@ class _Dispatch:
         self.items = items
         self.outputs = outputs  # jax arrays, possibly still computing
         self.started = started
+        # when the async enqueue returned: started->enqueued is the
+        # leader's dispatch span; enqueued->fetch-begin is the
+        # device_execute window the timelines attribute per item
+        self.enqueued = enqueued if enqueued is not None else started
         self.hot_idx = hot_idx  # hot dispatches: the machine served
 
 
@@ -542,6 +554,7 @@ class _Bucket:
         item = _Item(idx, x, m_valid)
         rows = x.shape[0]
         is_leader = False
+        queued = time.perf_counter()
         with self._cond:
             self._pending.setdefault(rows, []).append(item)
             while True:
@@ -553,6 +566,13 @@ class _Bucket:
                     break
                 self._cond.wait(timeout=1.0)  # predicate-looped; timeout is
                 # only a hang guard should a notify ever be missed
+        # queue_wait: pending-queue entry until this item went in flight
+        # (a leader popped it), the thread became the leader itself, or a
+        # racing leader already completed it — the time a busy bucket made
+        # this request stand in line
+        spans.record_into(
+            item.ctx, "queue_wait", queued, time.perf_counter() - queued
+        )
         if is_leader:
             try:
                 # drains until the queue empties OR this leader's own item
@@ -706,12 +726,25 @@ class _Bucket:
             if acquired:
                 self._inflight_slots.release()
             for it in items:
+                spans.event_into(
+                    it.ctx, "dispatch_error", error=type(exc).__name__,
+                    path="cold",
+                )
                 it.error = exc
             for it in items:
                 it.done.set()
             return
+        enqueued = time.perf_counter()
+        for it in items:
+            # the leader may be ANOTHER request's handler thread: the
+            # dispatch span goes to each batched item's own timeline
+            spans.record_into(
+                it.ctx, "dispatch", started, enqueued - started,
+                path="cold", batch=len(items),
+            )
         self._finish(
-            _Dispatch("cold", key, fresh, rows, items, outputs, started),
+            _Dispatch("cold", key, fresh, rows, items, outputs, started,
+                      enqueued=enqueued),
             defer,
         )
 
@@ -758,9 +791,15 @@ class _Bucket:
             for it in items:
                 it.done.set()
             return
+        enqueued = time.perf_counter()
+        for it in items:
+            spans.record_into(
+                it.ctx, "dispatch", started, enqueued - started,
+                path="hot", batch=len(items),
+            )
         self._finish(
             _Dispatch("hot", key, fresh, rows, items, outputs, started,
-                      hot_idx=idx),
+                      enqueued=enqueued, hot_idx=idx),
             defer,
         )
 
@@ -831,7 +870,29 @@ class _Bucket:
     def _complete(self, job: _Dispatch) -> None:
         """Fetch one dispatch's results and fan out — including the error
         fan-out: with async dispatch an execution failure surfaces at
-        device_get time, on exactly this job's waiters."""
+        device_get time, on exactly this job's waiters.
+
+        Runs under the FIRST item's captured span context: the collector
+        thread inherits no contextvars from the request, so without the
+        re-bind every log record emitted here (hot-fetch demotions,
+        promotion failures) lost its ``X-Gordo-Trace-Id``, and the
+        dispatch histograms observed below could never carry exemplar
+        trace ids. A micro-batch can coalesce several traces; the first
+        item's id stands for the batch in logs, while SPANS are recorded
+        per item into each request's own timeline."""
+        ctx = job.items[0].ctx if job.items else spans.EMPTY_CONTEXT
+        with spans.bind(ctx):
+            self._complete_bound(job)
+
+    def _complete_bound(self, job: _Dispatch) -> None:
+        fetch_started = time.perf_counter()
+        for it in job.items:
+            # enqueue -> fetch-begin: the window the device computes in
+            # (overlapped with any pipeline queue wait ahead of this job)
+            spans.record_into(
+                it.ctx, "device_execute", job.enqueued,
+                fetch_started - job.enqueued, path=job.kind,
+            )
         try:
             x_tail, pred, scaled, total = self._fetch(job)
         except Exception as exc:
@@ -845,10 +906,19 @@ class _Bucket:
                     "the hot copy and retrying on the cold path",
                     job.hot_idx,
                 )
+                for it in job.items:
+                    spans.event_into(
+                        it.ctx, "hot_fetch_failed_retry_cold",
+                        error=type(exc).__name__,
+                    )
                 self._demote(job.hot_idx)
                 self._retry_cold_sync(job.rows, job.items)
                 return
             for it in job.items:
+                spans.event_into(
+                    it.ctx, "fetch_error", error=type(exc).__name__,
+                    path=job.kind,
+                )
                 it.error = exc
             for it in job.items:
                 it.done.set()
@@ -859,6 +929,12 @@ class _Bucket:
             for it in job.items:
                 it.done.set()
             return
+        fetched = time.perf_counter()
+        for it in job.items:
+            spans.record_into(
+                it.ctx, "fetch", fetch_started, fetched - fetch_started,
+                path=job.kind, batch=len(job.items),
+            )
         hot = job.kind == "hot"
         try:
             # everything between fetch and done.set() stays inside one
@@ -930,8 +1006,19 @@ class _Bucket:
             started = time.perf_counter()
             with self._dispatch_lock or contextlib.nullcontext():
                 outputs = program(self.stacked, idxs, xs)
+            enqueued = time.perf_counter()
             x_tail, pred, scaled, total = jax.device_get(outputs)
             seconds = time.perf_counter() - started
+            fetched = time.perf_counter()
+            for it in items:
+                spans.record_into(
+                    it.ctx, "dispatch", started, enqueued - started,
+                    path="cold", retry="hot-fetch-failure",
+                )
+                spans.record_into(
+                    it.ctx, "fetch", enqueued, fetched - enqueued,
+                    path="cold", retry="hot-fetch-failure",
+                )
             if fresh:
                 _M_COMPILE_SECONDS.labels("cold").observe(seconds)
             else:
@@ -1308,10 +1395,13 @@ class ServingEngine:
         # resilience seams, both no-ops in the common case: expired work
         # must not queue behind the bucket's leader latch (the 504 path),
         # and the chaos harness injects latency/error/corruption HERE —
-        # the boundary a real device hang or memory corruption would hit
-        deadline.check("engine.dispatch")
-        faults.inject("engine-dispatch", name)
-        X = faults.corrupt("engine-dispatch", name, X)
+        # the boundary a real device hang or memory corruption would hit.
+        # Staged as "dispatch" so an injected (or real) pre-dispatch stall
+        # is attributed to the dispatch stage in the request's timeline.
+        with spans.stage("dispatch", machine=name):
+            deadline.check("engine.dispatch")
+            faults.inject("engine-dispatch", name)
+            X = faults.corrupt("engine-dispatch", name, X)
         X = np.asarray(getattr(X, "values", X), np.float32)
         if X.ndim == 1:
             X = X[None, :]

@@ -56,7 +56,7 @@ from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
 from ..models.anomaly.base import AnomalyDetectorBase
-from ..observability import exposition, tracing
+from ..observability import exposition, flightrec, spans, tracing
 from ..observability.registry import REGISTRY
 from ..resilience import deadline, faults
 from ..resilience.admission import AdmissionController, AdmissionRejected
@@ -98,6 +98,10 @@ _URL_MAP = Map(
         Rule("/prediction", endpoint="prediction"),
         Rule("/anomaly/prediction", endpoint="anomaly"),
         Rule("/download-model", endpoint="download-model"),
+        # flight recorder: recent/slow/errored request timelines, and one
+        # trace's full timeline (?format=chrome = Perfetto-loadable)
+        Rule("/debug/requests", endpoint="debug-requests"),
+        Rule("/debug/requests/<trace_id>", endpoint="debug-request"),
         Rule("/gordo/v0/<project>/<machine>/healthz", endpoint="healthz"),
         Rule("/gordo/v0/<project>/<machine>/metadata", endpoint="metadata"),
         Rule("/gordo/v0/<project>/<machine>/prediction", endpoint="prediction"),
@@ -538,6 +542,15 @@ class ModelServer:
         deadline_token = (
             deadline.set_deadline(budget) if budget is not None else None
         )
+        # per-request span timeline, bound to this handler's context; the
+        # engine's leader/collector threads receive it via each item's
+        # captured SpanContext (contextvars do not cross those threads)
+        timeline = None
+        timeline_token = None
+        if flightrec.RECORDER.enabled:
+            timeline, timeline_token = spans.begin(
+                trace_id, method=request.method, path=request.path
+            )
         adapter = _URL_MAP.bind_to_environ(environ)
         # ONE state snapshot per request: machines and engine must come from
         # the same generation even if a reload swaps mid-request
@@ -548,6 +561,10 @@ class ModelServer:
                 response = self._dispatch(request, endpoint, args, state)
             except AdmissionRejected as exc:
                 # load shed: tell the client WHEN to come back, not just no
+                spans.event(
+                    "admission_rejected", reason=str(exc),
+                    retry_after=exc.retry_after,
+                )
                 response = _json({"error": f"overloaded: {exc}"}, status=503)
                 response.headers["Retry-After"] = _retry_after(exc.retry_after)
             except DeadlineExceeded as exc:
@@ -571,6 +588,20 @@ class ModelServer:
             elapsed = time.perf_counter() - started
             _M_REQUEST_SECONDS.labels(endpoint).observe(elapsed)
             _M_REQUESTS.labels(endpoint, str(response.status_code)).inc()
+            if timeline is not None:
+                status = response.status_code
+                timeline.meta["endpoint"] = endpoint
+                timeline.finish(
+                    status=str(status),
+                    error=f"HTTP {status}" if status >= 500 else "",
+                )
+                # probe/scrape endpoints are excluded: a watchman polling
+                # N machines would flush every scoring trace out of the
+                # ring within one poll interval
+                if endpoint not in (
+                    "healthz", "metrics", "debug-requests", "debug-request"
+                ):
+                    flightrec.RECORDER.record(timeline)
             # DEBUG for probe endpoints: a watchman polling N machines'
             # /healthz plus scrapers hitting /metrics would otherwise
             # double steady-state log volume (werkzeug's own access line
@@ -586,6 +617,8 @@ class ModelServer:
                 trace_id,
             )
         finally:
+            if timeline_token is not None:
+                spans.end(timeline_token)
             if deadline_token is not None:
                 deadline.reset(deadline_token)
             tracing.reset_trace_id(token)
@@ -688,8 +721,16 @@ class ModelServer:
             )
         if endpoint == "metrics":
             if request.args.get("format") == "prometheus":
+                # &exemplars=1 opts into OpenMetrics-style exemplar
+                # suffixes (gordo tooling / OpenMetrics ingesters); the
+                # bare scrape stays strict v0.0.4 — the classic
+                # Prometheus text parser rejects exemplar syntax
                 return Response(
-                    exposition.render_prometheus(REGISTRY),
+                    exposition.render_prometheus(
+                        REGISTRY,
+                        exemplars=request.args.get("exemplars")
+                        in ("1", "true"),
+                    ),
                     content_type=exposition.CONTENT_TYPE,
                 )
             return _json(
@@ -709,6 +750,21 @@ class ModelServer:
                     "registry": REGISTRY.snapshot(),
                 }
             )
+        if endpoint == "debug-requests":
+            limit = request.args.get("limit", type=int)
+            return _json(
+                flightrec.RECORDER.summaries(limit=limit if limit else 50)
+            )
+        if endpoint == "debug-request":
+            recorded = flightrec.RECORDER.get(args["trace_id"])
+            if recorded is None:
+                raise NotFound(
+                    f"no recorded timeline for trace {args['trace_id']!r} "
+                    "(rotated out of the flight recorder, or never seen)"
+                )
+            if request.args.get("format") == "chrome":
+                return _json(recorded.to_chrome_trace())
+            return _json(recorded.to_dict())
         if endpoint == "models":
             return _json({"project": self.project, "models": sorted(state.machines)})
         if endpoint == "reload":
@@ -752,7 +808,12 @@ class ModelServer:
             probing = True
             logger.info("Quarantine recovery probe for machine %r", name)
         try:
-            with self.admission.admit():
+            # the admit() call itself is the gate wait (it returns the
+            # release handle): staged so a queued request's timeline shows
+            # WHERE the pre-engine time went
+            with spans.stage("admission"):
+                admitted = self.admission.admit()
+            with admitted:
                 if endpoint == "prediction":
                     response = self._predict(request, machine, state)
                 else:
@@ -866,7 +927,7 @@ class ModelServer:
         self._validate_X(X, machine)
 
         def run():
-            with tracing.span("server.predict"):
+            with spans.stage("score", machine=machine.name):
                 if state.engine.can_score(machine.name):
                     return state.engine.predict(machine.name, X)
                 deadline.check("server.predict")
@@ -944,15 +1005,13 @@ class ModelServer:
             if timestamps is not None:
                 header["timestamps"] = timestamps
             _M_WIRE_FORMAT.labels("npz").inc()
-            return Response(
-                wire.encode_npz(arrays, header),
-                mimetype=wire.NPZ_CONTENT_TYPE,
-            )
+            with spans.stage("encode", format="npz"):
+                body = wire.encode_npz(arrays, header)
+            return Response(body, mimetype=wire.NPZ_CONTENT_TYPE)
         _M_WIRE_FORMAT.labels("fast_json").inc()
-        return Response(
-            wire.encode_scored_json(arrays, timestamps, extras),
-            mimetype="application/json",
-        )
+        with spans.stage("encode", format="fast_json"):
+            body = wire.encode_scored_json(arrays, timestamps, extras)
+        return Response(body, mimetype="application/json")
 
     def _score_guarded(self, machine: _Machine, X, state: _ServerState):
         return self._guarded(
@@ -1020,20 +1079,24 @@ class ModelServer:
 
     def _score(self, machine: _Machine, X, state: _ServerState):
         """Anomaly arrays via the stacked TPU engine when the machine is
-        lifted into it, else the host path (``model.anomaly``)."""
-        if state.engine.can_score(machine.name):
-            with tracing.span("server.anomaly"):
+        lifted into it, else the host path (``model.anomaly``). Either way
+        the whole call is the timeline's ``score`` stage (its engine
+        children — queue_wait/dispatch/device_execute/fetch — nest inside
+        it; a host-path machine shows a flat score span)."""
+        with spans.stage("score", machine=machine.name):
+            if state.engine.can_score(machine.name):
                 return state.engine.anomaly(machine.name, X)
-        # host path: the engine's own pre-dispatch deadline check doesn't
-        # cover these machines, so gate here before the slow scoring
-        deadline.check("server.anomaly_host")
-        cols = machine.target_columns
-        if cols is None:
-            frame = machine.model.anomaly(X)
-        elif hasattr(X, "iloc"):  # DataFrame from ?start&end fetch
-            frame = machine.model.anomaly(X, y=X.iloc[:, cols])
-        else:
-            frame = machine.model.anomaly(X, y=np.asarray(X)[:, cols])
+            # host path: the engine's own pre-dispatch deadline check
+            # doesn't cover these machines, so gate here before the slow
+            # scoring
+            deadline.check("server.anomaly_host")
+            cols = machine.target_columns
+            if cols is None:
+                frame = machine.model.anomaly(X)
+            elif hasattr(X, "iloc"):  # DataFrame from ?start&end fetch
+                frame = machine.model.anomaly(X, y=X.iloc[:, cols])
+            else:
+                frame = machine.model.anomaly(X, y=np.asarray(X)[:, cols])
         return ScoreResult(
             model_input=frame["model-input"].values,
             model_output=frame["model-output"].values,
@@ -1063,9 +1126,10 @@ class ModelServer:
         config["train_start_date"] = start
         config["train_end_date"] = end
         try:
-            faults.inject("data-fetch", machine.name)  # chaos: dead lake
-            dataset = GordoBaseDataset.from_dict(config)
-            X, _ = dataset.get_data()
+            with spans.stage("data_fetch", machine=machine.name):
+                faults.inject("data-fetch", machine.name)  # chaos: dead lake
+                dataset = GordoBaseDataset.from_dict(config)
+                X, _ = dataset.get_data()
         except Exception as exc:  # provider/parse errors → client error
             _abort(400, f"Data fetch failed: {exc}")
         return X

@@ -206,13 +206,42 @@ class WatchmanServer:
             return None
         return read_build_progress(self.manifest_path)
 
+    def _slowest_request(self, base_url: str) -> Optional[Dict]:
+        """The target server's slowest recorded request — the flight
+        recorder's summary row (trace id, duration, dominant stage) from
+        ``/debug/requests`` — or None when the target predates the
+        recorder or is unreachable. One scrape per distinct base URL, so
+        a 1000-machine single-server fleet costs one extra GET per
+        status poll."""
+        import requests
+
+        # read-only breaker peek (allow() would consume the half-open
+        # probe slot the health checks own): an unreachable host must not
+        # cost an extra timeout per poll on top of its probe
+        if self._breakers.get(base_url.rstrip("/")).state != "closed":
+            return None
+        url = f"{base_url.rstrip('/')}/debug/requests?limit=1"
+        try:
+            response = requests.get(url, timeout=self.timeout)
+            if response.status_code != 200:
+                return None
+            json_fn = getattr(response, "json", None)
+            body = json_fn() if callable(json_fn) else None
+        except (requests.RequestException, ValueError):
+            return None
+        if not isinstance(body, dict):
+            return None
+        return body.get("slowest")
+
     def status(self) -> Dict:
         targets = sorted(self.machine_urls.items())
         workers = min(self.max_poll_workers, max(1, len(targets)))
+        urls = sorted(set(self.machine_urls.values()))
         with ThreadPoolExecutor(max_workers=workers) as pool:
             endpoints: List[Dict] = list(
                 pool.map(lambda mu: self._check(*mu), targets)
             )
+            slow = dict(zip(urls, pool.map(self._slowest_request, urls)))
         body = {
             "project-name": self.project,
             "ok": all(e["healthy"] for e in endpoints),
@@ -223,6 +252,12 @@ class WatchmanServer:
                 name: state
                 for name, state in self._breakers.states().items()
                 if state != "closed"
+            },
+            # per-target slowest recorded request (flight recorder): the
+            # "which trace do I open in Perfetto" pointer, fleet-wide
+            "slow-requests": {
+                url: summary for url, summary in slow.items()
+                if summary is not None
             },
         }
         build = self._build_progress()

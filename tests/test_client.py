@@ -95,6 +95,90 @@ def test_client_machine_discovery(served):
     assert client.resolve_machines() == ["mach-1", "mach-2"]
 
 
+def test_client_fanout_carries_trace_context(served, monkeypatch, caplog):
+    """The asyncio chunk fan-out runs on the pooled I/O loop's thread,
+    which inherits no contextvars from the predict() caller: the explicit
+    SpanContext handoff must (1) stamp the caller's trace id onto log
+    records emitted inside the chunk coroutines, (2) route
+    chunk_fetch/decode spans into the caller's timeline, and (3) send the
+    same trace id to the server (visible here because the in-process
+    server shares the flight recorder)."""
+    import logging
+
+    from gordo_components_tpu import wire
+    from gordo_components_tpu.observability import spans, tracing
+    from gordo_components_tpu.observability.flightrec import RECORDER
+
+    tracing.install_log_record_factory()
+    client_logger = logging.getLogger("gordo_components_tpu.client.client")
+    original = wire.payload_from_npz
+
+    def noisy_decode(raw):
+        client_logger.info("decoding chunk on the io thread")
+        return original(raw)
+
+    monkeypatch.setattr(wire, "payload_from_npz", noisy_decode)
+    trace_id = "f00d000011112222"
+    with caplog.at_level(logging.INFO, logger=client_logger.name):
+        with tracing.trace(trace_id):
+            timeline, token = spans.begin(trace_id)
+            try:
+                with Client(served, project="proj",
+                            max_interval="12h") as client:
+                    frames = client.predict(
+                        "2023-02-01T00:00:00+00:00",
+                        "2023-02-02T00:00:00+00:00",
+                        machine_names=["mach-1"],
+                    )
+            finally:
+                spans.end(token)
+    assert len(frames["mach-1"]) > 0
+    # (1) every log record of this request shares the one trace id,
+    # including those emitted on the I/O loop thread
+    decode_logs = [
+        r for r in caplog.records if "decoding chunk" in r.getMessage()
+    ]
+    assert decode_logs
+    assert all(r.trace_id == trace_id for r in decode_logs), [
+        r.trace_id for r in decode_logs
+    ]
+    assert any(r.threadName == "gordo-client-io" for r in decode_logs)
+    # (2) chunk_fetch + decode spans landed in the CALLER's timeline
+    chunk_spans = [s for s in timeline.spans if s.name == "chunk_fetch"]
+    decode_spans = [s for s in timeline.spans if s.name == "decode"]
+    assert len(chunk_spans) == 2  # 24h at 12h intervals = 2 chunks
+    assert len(decode_spans) == 2
+    assert all(s.thread == "gordo-client-io" for s in chunk_spans)
+    # (3) the server adopted the same trace id (shared in-process
+    # recorder: its own timeline for this trace exists and scored)
+    server_timeline = RECORDER.get(trace_id)
+    assert server_timeline is not None
+    assert "score" in server_timeline.stage_seconds()
+
+
+def test_client_bare_predict_mints_one_correlated_trace(served):
+    """A predict() with NO caller-bound trace mints one id, binds it,
+    and sends it on every chunk — so the recorded client timeline's
+    trace id matches real server-side timelines instead of correlating
+    with nothing."""
+    from gordo_components_tpu.observability.flightrec import RECORDER
+
+    with Client(served, project="proj", max_interval="12h") as client:
+        client.predict(
+            "2023-02-01T00:00:00+00:00", "2023-02-02T00:00:00+00:00",
+            machine_names=["mach-2"],
+        )
+    rows = RECORDER.summaries(limit=100)["requests"]
+    client_rows = [r for r in rows if r.get("kind") == "client.predict"]
+    assert client_rows  # newest first
+    trace_id = client_rows[0]["trace_id"]
+    server_rows = [
+        r for r in rows
+        if r["trace_id"] == trace_id and r.get("endpoint") == "anomaly"
+    ]
+    assert len(server_rows) == 2  # both chunks rode the one minted id
+
+
 def test_client_negotiates_npz_and_pools_session(served):
     """Chunk fetches ride the binary wire format (visible in the server's
     wire-format counter) through ONE pooled aiohttp session that survives

@@ -42,7 +42,10 @@ def scrape(url: str, timeout: float = 10.0) -> str:
     if "://" not in url:  # accept host:port/metrics shorthand
         url = f"http://{url}"
     if "?" not in url:
-        url += "?format=prometheus"
+        # exemplars=1: the OpenMetrics-style suffixes this tool validates
+        # and pretty-prints (a bare ?format=prometheus scrape is strict
+        # v0.0.4 with none, for classic Prometheus parsers)
+        url += "?format=prometheus&exemplars=1"
     response = requests.get(url, timeout=timeout)
     response.raise_for_status()
     return response.text
@@ -54,18 +57,53 @@ def validate(text: str, require_gordo: bool = False) -> int:
     )
 
     try:
-        samples = parse_prometheus_text(text)
+        # return_exemplars also VALIDATES exemplar syntax: a malformed
+        # ` # {...}` suffix (bad label grammar, over-budget label set,
+        # exemplar on a gauge) fails here loudly instead of silently
+        # breaking a Prometheus/OpenMetrics scraper downstream
+        samples, exemplars = parse_prometheus_text(
+            text, return_exemplars=True
+        )
     except ValueError as exc:
         print(f"MALFORMED exposition: {exc}", file=sys.stderr)
         return 1
     total = sum(len(v) for v in samples.values())
-    print(f"OK: {len(samples)} metric families, {total} samples")
+    n_exemplars = sum(len(v) for v in exemplars.values())
+    print(
+        f"OK: {len(samples)} metric families, {total} samples, "
+        f"{n_exemplars} exemplars"
+    )
     for name in sorted(samples):
         print(f"  {name}: {len(samples[name])} series")
+    if exemplars:
+        print("exemplars (bucket -> trace):")
+        for name in sorted(exemplars):
+            for labels, exemplar in exemplars[name][:5]:
+                le = labels.get("le", "")
+                trace = exemplar["labels"].get("trace_id", "?")
+                print(
+                    f"  {name}{{le={le}}} -> trace {trace} "
+                    f"value {exemplar['value']}"
+                    + (
+                        f" @ {exemplar['timestamp']:.3f}"
+                        if exemplar["timestamp"] is not None
+                        else ""
+                    )
+                )
+            extra = len(exemplars[name]) - 5
+            if extra > 0:
+                print(f"  {name}: ... and {extra} more")
     if require_gordo:
         missing = [name for name in REQUIRED_SERIES if name not in samples]
         if missing:
             print(f"MISSING required series: {missing}", file=sys.stderr)
+            return 1
+        if not exemplars:
+            # a warm traced request just ran (--spawn) or the operator
+            # asked for the full gordo contract: at least one histogram
+            # bucket must link to a concrete trace
+            print("MISSING exemplars: no histogram bucket carries a "
+                  "trace_id exemplar", file=sys.stderr)
             return 1
     return 0
 
