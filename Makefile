@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke smoke images builder-image server-image watchman-image
+.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke smoke images builder-image server-image watchman-image
 
 test:
 	python -m pytest tests/ -q
@@ -49,9 +49,16 @@ perf-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu python tools/trace_smoke.py
 
+# persistent-compile-cache check: a warm boot pays zero fresh XLA
+# compiles (load-not-compile), /reload and rollback adopt generations
+# recompile-free, and corrupt/stale/torn cache entries fall back to JIT
+# with bit-identical scores
+coldstart-smoke:
+	JAX_PLATFORMS=cpu python tools/coldstart_smoke.py
+
 # the full smoke battery: exposition + resilience + store integrity +
-# serving data plane + span attribution
-smoke: metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke
+# serving data plane + span attribution + cold-start economics
+smoke: metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke
 
 images: builder-image server-image watchman-image
 

@@ -35,7 +35,10 @@ Env overrides: BENCH_SERVE_MACHINES (100), BENCH_SERVE_ROWS (144 = one day
 at 10-min resolution), BENCH_SERVE_TAGS (10), BENCH_SERVE_REQUESTS (200),
 BENCH_CPU (0 — force the CPU backend, e.g. when the accelerator tunnel is
 down), BENCH_SERVE_SHARD (0 — shard stacked params over all devices, the
-HBM capacity mode; measures the gather-hop latency cost vs replicated).
+HBM capacity mode; measures the gather-hop latency cost vs replicated),
+BENCH_SERVE_COLDSTART (1 — include the two-boot persistent-compile-cache
+block; 0 skips it), BENCH_SERVE_WARM_KB (override the derived batch-warm
+bound — see warm_batch_bound).
 """
 
 from __future__ import annotations
@@ -47,6 +50,29 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
+
+# the concurrent-load ramp, and therefore the deepest micro-batch any
+# rung can coalesce: the batch-program warm loop below derives its bound
+# from THIS tuple (and the engine's max_batch), so adding a rung can
+# never silently desynchronize the warmed program set (ADVICE r5)
+SATURATION_WORKERS = (1, 2, 4, 8, 16, 32)
+
+
+def warm_batch_bound(engine) -> int:
+    """Deepest power-of-two dispatch batch worth pre-compiling: bounded by
+    the deepest saturation rung (queue depth can't exceed the worker
+    count) AND the engine's own ``max_batch`` (programs past it are dead
+    weight — the engine never coalesces that many). ``BENCH_SERVE_WARM_KB``
+    overrides (a deliberate oversized warm is a measurement tool)."""
+    from gordo_components_tpu.server.engine import _round_up_pow2
+
+    raw = os.environ.get("BENCH_SERVE_WARM_KB")
+    if raw:
+        return max(1, int(raw))
+    return min(
+        _round_up_pow2(max(SATURATION_WORKERS)),
+        _round_up_pow2(engine.max_batch),
+    )
 
 
 def effective_env() -> dict:
@@ -192,6 +218,8 @@ def measure(
     (from :func:`build_models`) skips the fit when measuring both modes."""
     import jax
 
+    if models is None:
+        models = build_models(machines, rows, tags)
     engine = build_engine(machines, rows, tags, shard=shard, models=models)
     names = engine.machines()
     rng = np.random.default_rng(1)
@@ -248,9 +276,19 @@ def measure(
     bucket, idx = engine._by_name[names[0]]
     x_padded, _ = engine._prepare(bucket, X)
     program = bucket._program(x_padded.shape[0], 1)
-    xs_dev = jax.device_put(x_padded[None])
+    # donating engines (TPU) CONSUME the request stack: this raw-program
+    # loop must hand each call its own buffer (an async device_put enqueue,
+    # like the real dispatch path's implicit put of a fresh np.stack) —
+    # re-dispatching a donated array raises. Non-donating engines keep the
+    # single resident buffer, the historical measurement.
+    xs_host = x_padded[None]
+    xs_resident = None if bucket._donate else jax.device_put(xs_host)
+
+    def xs_arg():
+        return jax.device_put(xs_host) if bucket._donate else xs_resident
+
     idxs_dev = jax.device_put(np.asarray([idx], np.int32))
-    jax.block_until_ready(program(bucket.stacked, idxs_dev, xs_dev))
+    jax.block_until_ready(program(bucket.stacked, idxs_dev, xs_arg()))
     n_pipe = max(n_requests, 100)
     shard_mode = engine.mesh is not None
     started = time.perf_counter()
@@ -259,9 +297,14 @@ def measure(
         # interleave their in-process rendezvous (CPU backend) — await each
         # dispatch, so this number includes the per-call gather cost
         for _ in range(n_pipe):
-            jax.block_until_ready(program(bucket.stacked, idxs_dev, xs_dev))
+            jax.block_until_ready(
+                program(bucket.stacked, idxs_dev, xs_arg())
+            )
     else:
-        outs = [program(bucket.stacked, idxs_dev, xs_dev) for _ in range(n_pipe)]
+        outs = [
+            program(bucket.stacked, idxs_dev, xs_arg())
+            for _ in range(n_pipe)
+        ]
         jax.block_until_ready(outs)
     device_ms = (time.perf_counter() - started) / n_pipe * 1000.0
 
@@ -281,11 +324,17 @@ def measure(
     # each batch size's FIRST execution compiles a new program — which
     # batch sizes occur is timing-dependent, so warm every possible one
     # (cold and hot variants) deterministically before any timed rung, or
-    # a rung's p99 measures XLA compile time, not serving
+    # a rung's p99 measures XLA compile time, not serving. The bound is
+    # DERIVED (deepest rung ∧ engine.max_batch — see warm_batch_bound),
+    # not a literal, so the rung list and the warm set cannot drift
     rows_padded = x_padded.shape[0]
     kb = 1
-    while kb <= 32:  # queue depth is bounded by the deepest rung (32)
-        xs_kb = jax.device_put(np.repeat(x_padded[None], kb, axis=0))
+    max_kb = warm_batch_bound(engine)
+    while kb <= max_kb:
+        # host copy per program call: donating engines consume the stack
+        # (see the device-loop note above), so each warm dispatch gets its
+        # own implicit device_put — exactly what a live dispatch does
+        xs_kb = np.repeat(x_padded[None], kb, axis=0)
         idxs_kb = jax.device_put(np.full((kb,), idx, np.int32))
         jax.block_until_ready(
             bucket._program(rows_padded, kb)(bucket.stacked, idxs_kb, xs_kb)
@@ -294,12 +343,12 @@ def measure(
             hot_idx = next(iter(bucket._hot))
             jax.block_until_ready(
                 bucket._hot_program(rows_padded, kb)(
-                    bucket._hot[hot_idx], np.asarray(xs_kb)
+                    bucket._hot[hot_idx], np.repeat(x_padded[None], kb, axis=0)
                 )
             )
         kb *= 2
     saturation = []
-    for workers in (1, 2, 4, 8, 16, 32):
+    for workers in SATURATION_WORKERS:
         with ThreadPoolExecutor(max_workers=workers) as pool:
             # settle the pool's threads before timing
             list(pool.map(one, range(min(n_requests, 2 * workers))))
@@ -398,6 +447,18 @@ def measure(
         },
     }
 
+    # -- cold start: boot cost with and without the persistent compile
+    # cache (ROADMAP #3 / ISSUE 6). Two boots against one cache root: the
+    # first pays the compiles and writes AOT executables back, the second
+    # must be load-not-compile (compiles_at_boot 0, cache hits > 0) — the
+    # number /reload and rollback pay when adopting a generation.
+    # Replicated runs only: measure_cold_start boots replicated engines,
+    # and bench.py's shard-mode measure() calls must not re-pay (or
+    # mislabel) the identical replicated measurement a second time.
+    cold_start = None
+    if not shard_mode and os.environ.get("BENCH_SERVE_COLDSTART", "1") == "1":
+        cold_start = measure_cold_start(models, rows, tags)
+
     stats = engine.stats()
     on_tpu = jax.devices()[0].platform == "tpu"
     return {
@@ -458,7 +519,64 @@ def measure(
             round(hot_p50, 3) if hot_p50 is not None else None
         ),
         "hot_requests": stats["hot_requests"],
+        # boot economics: warmup wall time, first-request latency, and
+        # fresh-XLA-compile count for a cold vs a warmed persistent
+        # compile cache (None = BENCH_SERVE_COLDSTART=0)
+        "cold_start": cold_start,
     }
+
+
+def measure_cold_start(models, rows: int, tags: int) -> dict:
+    """Boot the serving engine twice against ONE throwaway compile-cache
+    root and report each boot's warmup wall time, first-request latency,
+    fresh-compile count, and cache counters. Replicated (single-device)
+    engines only — the cache's design case is the latency-mode boot path;
+    shard-mode executables may not serialize on every backend and would
+    report an honest-but-noisy partial warm here."""
+    import tempfile
+
+    from gordo_components_tpu.compile_cache import CompileCacheStore
+    from gordo_components_tpu.observability.registry import REGISTRY
+    from gordo_components_tpu.server.engine import ServingEngine
+
+    def fresh_compiles() -> float:
+        for metric in REGISTRY.metrics():
+            if metric.name == "gordo_engine_compile_seconds":
+                return sum(s["count"] for s in metric.stats().values())
+        return 0
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(rows, tags)).astype(np.float32) * 2 + 4
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        root = os.path.join(tmp, "compile-cache")
+        for label in ("cold_boot", "warm_boot"):
+            store = CompileCacheStore(root)
+            before = fresh_compiles()
+            started = time.perf_counter()
+            engine = ServingEngine(models, compile_cache=store)
+            engine.warmup(rows)
+            warmup_s = time.perf_counter() - started
+            name = engine.machines()[0]
+            started = time.perf_counter()
+            engine.anomaly(name, X)
+            first_ms = (time.perf_counter() - started) * 1000.0
+            engine.close()
+            out[label] = {
+                "warmup_s": round(warmup_s, 3),
+                "first_request_ms": round(first_ms, 3),
+                # fresh XLA compiles this boot paid (the acceptance gate:
+                # 0 on the warm boot — coldstart_smoke enforces it)
+                "compiles_at_boot": int(fresh_compiles() - before),
+                "cache": dict(store.counters),
+            }
+        speedup = (
+            out["cold_boot"]["warmup_s"] / out["warm_boot"]["warmup_s"]
+            if out["warm_boot"]["warmup_s"] > 0
+            else None
+        )
+        out["warmup_speedup"] = round(speedup, 2) if speedup else None
+    return out
 
 
 def main() -> None:
@@ -505,6 +623,8 @@ def main() -> None:
             "end_to_end_p50_ms": result.get("end_to_end_p50_ms"),
             "end_to_end_p99_ms": result.get("end_to_end_p99_ms"),
             "concurrent_rps": result.get("concurrent_rps"),
+            # boot economics headline: compile-on-boot vs load-on-boot
+            "cold_start": result.get("cold_start"),
         })
     except Exception:
         pass  # history is never worth failing an artifact over

@@ -43,10 +43,18 @@ def _is_permanent_xla_error(message: str) -> bool:
     alone is NOT enough: gRPC uses the same status for transient
     flow-control/overload on cross-host transfers, so it only counts as
     the deterministic device OOM when paired with allocator wording.
+
+    Status matches are anchored to the START of the message (ADVICE r5):
+    a transient multi-host failure whose wrapped/chained error text merely
+    EMBEDS "INVALID_ARGUMENT" somewhere (e.g. an UNAVAILABLE transport
+    error quoting a peer's status) must stay retryable — only a message
+    that leads with the status (jax raises them as "STATUS: detail") is
+    the deterministic device failure this classifier exists for.
     """
-    if "INVALID_ARGUMENT" in message:
+    lead = message.lstrip()
+    if lead.startswith("INVALID_ARGUMENT"):
         return True
-    if "RESOURCE_EXHAUSTED" in message:
+    if lead.startswith("RESOURCE_EXHAUSTED"):
         lowered = message.lower()
         return any(w in lowered for w in ("allocat", "hbm", "memory"))
     return False
@@ -232,11 +240,20 @@ def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
               type=int, help="multi-host: total process count")
 @click.option("--process-id", envvar="GORDO_PROCESS_ID", default=None,
               type=int, help="multi-host: this host's process index")
+@click.option("--serving-cache/--no-serving-cache", default=True,
+              show_default=True,
+              help="after the build, export AOT-serialized SERVING "
+                   "executables into <output-dir>/.compile-cache (the root "
+                   "run-server --models-dir defaults to), so the first "
+                   "server boot — and every /reload and rollback — loads "
+                   "compiled programs instead of paying XLA compiles "
+                   "(single-host builds only; best-effort)")
 @_COMPILE_CACHE_OPT
 @_TRACE_DIR_OPT
 def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
                     n_splits, seed, slice_size, coordinator_address,
-                    num_processes, process_id, compile_cache_dir, trace_dir):
+                    num_processes, process_id, serving_cache,
+                    compile_cache_dir, trace_dir):
     """Build an entire fleet: machines are bucketed and trained as vmapped
     programs sharded over the device mesh. With ``--coordinator-address``
     (or on a TPU pod with autodetectable cluster metadata plus explicit
@@ -334,6 +351,26 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
             EXIT_RETRYABLE,
         )
         sys.exit(EXIT_RETRYABLE)
+    if serving_cache and results and not multihost:
+        # pay the SERVING compiles here, once, where the build already
+        # owns the device — every later boot/reload/rollback against this
+        # tree is then O(load). Best-effort by contract: a failed export
+        # costs the first boot its compiles, never the build its artifacts
+        import os
+
+        from ..compile_cache import export_serving_cache
+
+        try:
+            summary = export_serving_cache(
+                results, os.path.join(output_dir, ".compile-cache")
+            )
+            logger.info("Serving compile-cache export: %s", summary)
+        except Exception:
+            logger.warning(
+                "Serving compile-cache export failed (builds unaffected; "
+                "the first server boot will compile instead)",
+                exc_info=True,
+            )
     click.echo(json.dumps(results, indent=2))
 
 
@@ -365,6 +402,93 @@ def rollback_cmd(model_dir, list_only):
     click.echo(restored)
 
 
+@gordo.group("cache")
+def cache_group():
+    """Persistent serving compile cache (AOT-serialized executables).
+
+    The store that makes boot, /reload, and rollback O(load) instead of
+    O(compile) — see docs/ARCHITECTURE.md §14 for the key schema,
+    invalidation rules, and the never-fatal JIT fallback contract.
+    """
+
+
+@cache_group.command("list")
+@click.option("--store", "store_dir", required=True,
+              help="compile-cache root (e.g. <models-dir>/.compile-cache)")
+def cache_list_cmd(store_dir):
+    """List cache entries as JSON: program key, size, verification state,
+    and whether each entry's backend fingerprint matches THIS process
+    (``current: false`` entries are what ``purge --stale`` removes)."""
+    from ..compile_cache import CompileCacheStore, backend_fingerprint
+
+    store = CompileCacheStore(store_dir)
+    click.echo(json.dumps(
+        {
+            "root": store.root,
+            "backend": backend_fingerprint(),
+            "entries": store.entries(),
+        },
+        indent=2,
+    ))
+
+
+@cache_group.command("warm")
+@click.option("--models-dir", required=True,
+              help="directory whose immediate subdirs are model dirs (the "
+                   "tree run-server --models-dir serves)")
+@click.option("--store", "store_dir", default=None,
+              help="compile-cache root (default: "
+                   "<models-dir>/.compile-cache, run-server's default)")
+@click.option("--shard-fleet", is_flag=True, default=False,
+              help="warm the mesh-sharded engine variant (must match how "
+                   "the server will boot — sharding is part of the key)")
+@click.option("--rows", default=None, type=int,
+              help="warm the padded-row bucket real requests will hit "
+                   "(default: each bucket's minimum scorable request)")
+def cache_warm_cmd(models_dir, store_dir, shard_fleet, rows):
+    """Pre-pay the serving compiles into the cache, off the serving path.
+
+    Loads every model under MODELS-DIR, warms a throwaway serving engine
+    wired to the store (the exact boot code path, so keys match by
+    construction), and prints the summary. Run it wherever fleet-build's
+    automatic export can't — after copying a models tree to a new rig, or
+    after a jaxlib upgrade invalidated the old entries.
+    """
+    import os
+
+    from ..compile_cache import export_serving_cache
+    from ..server.server import scan_models_root
+
+    model_dirs = scan_models_root(models_dir)
+    if not model_dirs:
+        raise click.UsageError(f"No model dirs found under {models_dir!r}")
+    summary = export_serving_cache(
+        model_dirs,
+        store_dir or os.path.join(models_dir, ".compile-cache"),
+        rows=rows,
+        shard_fleet=shard_fleet,
+    )
+    click.echo(json.dumps(summary, indent=2))
+
+
+@cache_group.command("purge")
+@click.option("--store", "store_dir", required=True,
+              help="compile-cache root")
+@click.option("--stale", "stale_only", is_flag=True, default=False,
+              help="remove only entries whose backend fingerprint no "
+                   "longer matches this process (old jaxlib / device / "
+                   "topology) or that fail verification; without it the "
+                   "whole cache is cleared")
+def cache_purge_cmd(store_dir, stale_only):
+    """Delete cache entries (and sweep crash debris). Safe while servers
+    run: entries are immutable and lookups that miss fall back to JIT."""
+    from ..compile_cache import CompileCacheStore
+
+    store = CompileCacheStore(store_dir)
+    removed = store.purge(stale_only=stale_only)
+    click.echo(json.dumps({"root": store.root, "removed": removed}, indent=2))
+
+
 @gordo.command("run-server")
 @click.option("--model-dir", "model_dirs", multiple=True,
               envvar="MODEL_LOCATION",
@@ -390,9 +514,17 @@ def rollback_cmd(model_dir, list_only):
                    "engine-dispatch, probe, data-fetch; kinds: error, "
                    "latency, corrupt) — injects failures at the named "
                    "boundaries; NEVER set in production")
+@click.option("--compile-cache-store", default=None,
+              envvar="GORDO_COMPILE_CACHE_STORE",
+              help="persistent serving compile-cache root (AOT-serialized "
+                   "scoring executables; 'off' disables). Default: "
+                   "<models-dir>/.compile-cache when --models-dir is given "
+                   "— the root fleet-build exports into, so boot, /reload "
+                   "and rollback pay zero fresh XLA compiles against a "
+                   "warmed store")
 @_TRACE_DIR_OPT
 def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
-                   max_inflight, faults, trace_dir):
+                   max_inflight, faults, compile_cache_store, trace_dir):
     """Serve built model(s) over REST."""
     import os
 
@@ -429,13 +561,15 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
     if len(resolved) == 1 and not models_dir:
         run_server(next(iter(resolved.values())), host=host, port=port,
                    project=project, shard_fleet=shard_fleet,
-                   trace_dir=trace_dir, max_inflight=max_inflight)
+                   trace_dir=trace_dir, max_inflight=max_inflight,
+                   compile_cache_store=compile_cache_store)
     else:
         # models_dir servers stay reload-capable (POST /reload picks up
         # machines a fleet build adds to the tree after startup)
         run_server(resolved, host=host, port=port, project=project,
                    models_root=models_dir, shard_fleet=shard_fleet,
-                   trace_dir=trace_dir, max_inflight=max_inflight)
+                   trace_dir=trace_dir, max_inflight=max_inflight,
+                   compile_cache_store=compile_cache_store)
 
 
 @gordo.command("run-watchman")

@@ -1,0 +1,31 @@
+"""Persistent compile cache: AOT-serialized executables in the model store.
+
+Makes boot, ``/reload``, and ``gordo rollback`` O(load) instead of
+O(compile): the serving engine's scoring programs are AOT-compiled once,
+serialized, and committed as checksummed artifacts beside the models they
+serve (``docs/ARCHITECTURE.md`` §14 — key schema, invalidation rules, and
+the never-fatal JIT fallback contract).
+"""
+
+from .fingerprint import backend_fingerprint, canonical, entry_name, full_key
+from .store import STORE_ENV, CompileCacheStore, resolve_store
+
+__all__ = [
+    "CompileCacheStore",
+    "STORE_ENV",
+    "backend_fingerprint",
+    "canonical",
+    "entry_name",
+    "export_serving_cache",
+    "full_key",
+    "resolve_store",
+]
+
+
+def export_serving_cache(*args, **kwargs):
+    """Lazy proxy for :func:`.export.export_serving_cache` (pulls in the
+    serving engine; the store itself must stay importable from the
+    builder without that weight)."""
+    from .export import export_serving_cache as _export
+
+    return _export(*args, **kwargs)

@@ -1,0 +1,85 @@
+"""Build-time export of serving executables into the compile cache.
+
+The fleet builder is the one place that already pays for compiles (every
+bucket's training program AOT-compiles in ``parallel/fleet.py``), knows
+the full fleet composition, and runs off the serving path — so it is the
+right place to ALSO pay the serving compiles, once, into the persistent
+cache. A server booting against the same models tree then warms by
+loading executables instead of compiling them; ``/reload`` and
+``gordo rollback`` adopt generations with zero recompiles.
+
+Implementation: load the freshly-built models and warm a throwaway
+:class:`~gordo_components_tpu.server.engine.ServingEngine` wired to the
+cache — the exact code path a server boot runs, so the cache keys match
+by construction (re-deriving the engine's bucket/shape logic here would
+be a second copy that drifts). Best-effort end to end: a failed export
+costs the first server boot its compiles, never the build its artifacts.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def export_serving_cache(
+    model_dirs: Dict[str, str],
+    cache_root: str,
+    rows: Optional[int] = None,
+    shard_fleet: bool = False,
+) -> Dict[str, Any]:
+    """Warm the serving compile cache at ``cache_root`` for the fleet in
+    ``model_dirs`` (``{machine_name: model_dir}``). Returns a summary
+    (buckets warmed, cache hits/writes, skipped machines); raises only on
+    programmer error — per-machine load failures are skipped and named.
+
+    ``rows``: warm the row bucket real traffic will hit (default: each
+    bucket's minimum scorable request, the same default ``warmup()``
+    uses). ``shard_fleet``: warm the mesh-sharded engine variant instead
+    (must match how the server will boot — sharding is part of the key).
+    """
+    from ..serializer import load
+    from ..server.engine import ServingEngine
+    from .store import CompileCacheStore
+
+    started = time.perf_counter()
+    models: Dict[str, Any] = {}
+    skipped: Dict[str, str] = {}
+    for name, model_dir in sorted(model_dirs.items()):
+        try:
+            models[name] = load(model_dir)
+        except Exception as exc:
+            skipped[name] = f"{type(exc).__name__}: {exc}"
+    if not models:
+        return {"buckets": 0, "machines": 0, "skipped": skipped}
+
+    mesh = None
+    if shard_fleet:
+        from ..parallel.mesh import fleet_mesh
+
+        mesh = fleet_mesh()
+    store = CompileCacheStore(cache_root)
+    engine = ServingEngine(models, mesh=mesh, compile_cache=store)
+    try:
+        buckets = engine.warmup(rows)
+    finally:
+        engine.close()
+    summary = {
+        "buckets": buckets,
+        "machines": len(models),
+        "skipped": skipped,
+        "cache_root": store.root,
+        "cache": dict(store.counters),
+        "duration_s": round(time.perf_counter() - started, 3),
+    }
+    logger.info(
+        "Serving compile cache export: %d bucket(s) over %d machine(s) in "
+        "%.1fs (hits %d, writes %d) -> %s",
+        buckets, len(models), summary["duration_s"],
+        store.counters.get("hit", 0), store.counters.get("write", 0),
+        store.root,
+    )
+    return summary

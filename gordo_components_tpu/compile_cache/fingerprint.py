@@ -1,0 +1,77 @@
+"""Cache-key construction for persisted executables.
+
+An XLA executable serialized on one rig is garbage on another: the bytes
+encode the backend (CPU vs TPU), the device generation (v4 vs v5e tile
+layouts), the device count a sharded program was partitioned over, and
+the jax/jaxlib pair that produced them — none of which the bytes
+themselves declare loudly enough to trust. So every cache entry's key
+carries two halves:
+
+- the **program identity** the caller supplies (architecture signature,
+  stacked machine count, shape bucket ``(rows, k)``, sharding/donation
+  config — see ``server/engine.py``), and
+- the **backend fingerprint** computed here (jax + jaxlib versions,
+  platform, device kind, topology, host ISA).
+
+The entry NAME hashes the canonical JSON of the whole key, so a jaxlib
+bump or a device swap simply *misses* (new name) rather than loading an
+incompatible binary; the stored ``KEY.json`` is compared byte-for-byte on
+load as the second line of defense (a tampered or hash-colliding entry
+reads as *stale*, never as a program).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import platform
+from typing import Any, Dict, Optional
+
+ENTRY_PREFIX = "cc-"
+
+_fingerprint_cache: Optional[Dict[str, Any]] = None
+
+
+def backend_fingerprint() -> Dict[str, Any]:
+    """The environment half of every cache key. Computed once per process
+    (device enumeration can touch a slow accelerator transport)."""
+    global _fingerprint_cache
+    if _fingerprint_cache is None:
+        import jax
+        import jaxlib
+
+        devices = jax.devices()
+        _fingerprint_cache = {
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "platform": devices[0].platform,
+            "device_kind": devices[0].device_kind,
+            "n_devices": len(devices),
+            "process_count": jax.process_count(),
+            # XLA:CPU executables embed host-ISA-specific code paths; a
+            # cache dir on shared storage must not hand an AVX-512 binary
+            # to a host without it
+            "machine": platform.machine(),
+        }
+    return dict(_fingerprint_cache)
+
+
+def full_key(program_key: Dict[str, Any]) -> Dict[str, Any]:
+    """Program identity + backend fingerprint, the complete key one entry
+    is stored and validated under."""
+    return {"program": dict(program_key), "backend": backend_fingerprint()}
+
+
+def canonical(key: Dict[str, Any]) -> str:
+    """The one rendering of a key — sorted keys, no whitespace — so the
+    entry name hash and the stored/loaded ``KEY.json`` comparison can
+    never disagree about identity."""
+    return json.dumps(key, sort_keys=True, separators=(",", ":"), default=str)
+
+
+def entry_name(key: Dict[str, Any]) -> str:
+    """Directory name for a full key: content-addressed, so stale entries
+    (old jaxlib, old topology) age out as unreferenced garbage instead of
+    being loaded and mistrusted."""
+    digest = hashlib.sha256(canonical(key).encode()).hexdigest()
+    return f"{ENTRY_PREFIX}{digest[:32]}"

@@ -113,6 +113,15 @@ _M_HOT_EVENTS = REGISTRY.counter(
     labels=("event",),
 )
 
+
+def _supports_donation(mesh) -> bool:
+    """Whether scoring dispatches may donate their input buffers (XLA:CPU
+    silently copies donated buffers and warns per execution — see
+    parallel.fleet.backend_supports_donation, deliberately not imported at
+    module scope: the engine must not drag the training stack in)."""
+    device = mesh.devices.flat[0] if mesh is not None else jax.devices()[0]
+    return device.platform != "cpu"
+
 # ONE lock per PROCESS for sharded dispatches: collective rendezvous (CPU
 # backend) aborts the process if two sharded executions interleave, and the
 # hazard spans engine GENERATIONS (a /reload warms a new engine while the
@@ -317,8 +326,25 @@ class _Bucket:
         mesh=None,
         dispatch_lock: Optional[threading.Lock] = None,
         hot_cap: int = 0,
+        compile_cache=None,
+        arch_sig: str = "",
     ):
         self.apply_fn = apply_fn
+        # persistent compile cache (compile_cache.CompileCacheStore or
+        # None): with a store, _program/_hot_program consult it before
+        # JIT-compiling and write AOT-serialized executables back on miss
+        # — the O(load)-boot machinery of ARCHITECTURE §14. arch_sig is
+        # the engine's architecture-group signature, the program-identity
+        # half of every cache key.
+        self._compile_cache = compile_cache
+        self._arch_sig = arch_sig
+        # donate request buffers to the scoring executables (idxs/xs are
+        # rebuilt per dispatch and never reused after the call, so XLA may
+        # overlay intermediates on their HBM); gated off on CPU, where
+        # donation is unsupported and only emits per-dispatch warnings.
+        # Part of the cache key: a donating and a non-donating executable
+        # are different binaries.
+        self._donate = _supports_donation(mesh)
         self.lookback = lookback
         self.lookahead = lookahead
         self.max_batch = max_batch
@@ -464,7 +490,6 @@ class _Bucket:
             _M_PROGRAM_CACHE.labels("stacked", "hit").inc()
             return program
         _M_PROGRAM_CACHE.labels("stacked", "miss").inc()
-        self._fresh_programs.add(key)
         machine_score = self._machine_score_fn()
 
         def score_one(stacked, idx, x):
@@ -472,17 +497,39 @@ class _Bucket:
             return machine_score(machine, x)
 
         vmapped = jax.vmap(score_one, in_axes=(None, 0, 0))
+        donate = (2,) if self._donate else ()  # xs: rebuilt per dispatch
         if self._fleet_sharding is None:
-            program = jax.jit(vmapped)
+            jitted = jax.jit(vmapped, donate_argnums=donate)
         else:
             from jax.sharding import NamedSharding, PartitionSpec
 
             replicated = NamedSharding(self.mesh, PartitionSpec())
-            program = jax.jit(
+            jitted = jax.jit(
                 vmapped,
                 in_shardings=(self._fleet_sharding, replicated, replicated),
                 out_shardings=replicated,
+                donate_argnums=donate,
             )
+        if self._compile_cache is None:
+            # no store: today's lazy path — the first dispatch pays the
+            # compile and _fresh_programs routes its duration to the
+            # compile histogram
+            self._fresh_programs.add(key)
+            self._programs[key] = jitted
+            return jitted
+        avatars = (
+            self._stacked_avatar(),
+            jax.ShapeDtypeStruct((k,), jnp.int32),
+            jax.ShapeDtypeStruct((k, rows, self.n_features), jnp.float32),
+        )
+        program = self._cached_program(
+            "cold", (rows, k), jitted, avatars,
+            probe_args=lambda: (
+                self.stacked,
+                np.zeros((k,), np.int32),
+                np.zeros((k, rows, self.n_features), np.float32),
+            ),
+        )
         self._programs[key] = program
         return program
 
@@ -492,16 +539,106 @@ class _Bucket:
         gather, no collectives, no shard dispatch lock."""
         key = ("hot", rows, k)
         program = self._programs.get(key)
-        if program is None:
-            _M_PROGRAM_CACHE.labels("hot", "miss").inc()
-            self._fresh_programs.add(key)
-            program = jax.jit(
-                jax.vmap(self._machine_score_fn(), in_axes=(None, 0))
-            )
-            self._programs[key] = program
-        else:
+        if program is not None:
             _M_PROGRAM_CACHE.labels("hot", "hit").inc()
+            return program
+        _M_PROGRAM_CACHE.labels("hot", "miss").inc()
+        donate = (1,) if self._donate else ()
+        jitted = jax.jit(
+            jax.vmap(self._machine_score_fn(), in_axes=(None, 0)),
+            donate_argnums=donate,
+        )
+        if self._compile_cache is None:
+            self._fresh_programs.add(key)
+            self._programs[key] = jitted
+            return jitted
+        machine_avatar = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), self.stacked
+        )
+        avatars = (
+            machine_avatar,
+            jax.ShapeDtypeStruct((k, rows, self.n_features), jnp.float32),
+        )
+        program = self._cached_program(
+            "hot", (rows, k), jitted, avatars,
+            probe_args=lambda: (
+                jax.tree_util.tree_map(
+                    lambda a: np.zeros(a.shape[1:], a.dtype), self.stacked
+                ),
+                np.zeros((k, rows, self.n_features), np.float32),
+            ),
+        )
+        self._programs[key] = program
         return program
+
+    # -- persistent compile cache (ARCHITECTURE §14) -------------------------
+    def _stacked_avatar(self):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self.stacked
+        )
+
+    def _cache_key(self, kind: str, rows: int, k: int) -> Dict[str, Any]:
+        """Program-identity half of the persistent cache key. The backend
+        fingerprint (jax/jaxlib, device kind, topology, host ISA) is added
+        by the store; together they are the invalidation rule — any drift
+        reads as a miss or stale entry, never as a wrong executable."""
+        return {
+            "kind": f"serving-{kind}",
+            "arch": self._arch_sig,
+            "machines": int(self.stacked["tcols"].shape[0]),
+            "features": self.n_features,
+            "rows": rows,
+            "batch": k,
+            "mesh": list(self.mesh.devices.shape) if self.mesh else None,
+            "donate": self._donate,
+        }
+
+    def _cached_program(self, kind, shape_key, jitted, avatars, probe_args):
+        """Store-backed program resolution: load the AOT executable when a
+        valid entry exists (one probe dispatch vets it on THIS host), else
+        AOT-compile the jitted program now — its duration lands in the
+        compile histogram here, so the triggering dispatch records honest
+        dispatch latency — and write the executable back. Every cache
+        failure degrades to the compiled program; this path never raises
+        for cache reasons."""
+        rows, k = shape_key
+        ckey = self._cache_key(kind, rows, k)
+
+        def probe(loaded):
+            # vet the deserialized binary with a zeros batch before
+            # adopting it: a verifying-but-unrunnable entry must read as
+            # invalid here, not fail live requests later. Sharded probes
+            # take the collective-launch lock like any other dispatch.
+            with self._dispatch_lock or contextlib.nullcontext():
+                jax.block_until_ready(loaded(*probe_args()))
+
+        loaded = self._compile_cache.get(ckey, probe=probe)
+        if loaded is not None:
+            spans.event(
+                "compile_cache", outcome="hit", kind=kind, rows=rows, batch=k
+            )
+            return loaded
+        spans.event(
+            "compile_cache", outcome="miss", kind=kind, rows=rows, batch=k
+        )
+        started = time.perf_counter()
+        try:
+            compiled = jitted.lower(*avatars).compile()
+        except Exception:
+            # an avatar/lowering bug must not take scoring down with it:
+            # fall back to the lazy-jit contract (first dispatch compiles,
+            # _fresh_programs accounts it) and skip the write-back
+            logger.exception(
+                "AOT compile for the persistent cache failed (kind=%s "
+                "rows=%d k=%d); serving via lazy JIT", kind, rows, k,
+            )
+            self._fresh_programs.add(
+                (rows, k) if kind == "cold" else ("hot", rows, k)
+            )
+            return jitted
+        _M_COMPILE_SECONDS.labels(kind).observe(time.perf_counter() - started)
+        self._compile_cache.put(ckey, compiled)
+        return compiled
 
     def _gather_machine(self, idx: int):
         """One machine's slice of the sharded stack, pulled to host and
@@ -946,6 +1083,11 @@ class _Bucket:
                 _M_COMPILE_SECONDS.labels(job.kind).observe(seconds)
             else:
                 _M_DISPATCH_SECONDS.labels(job.kind).observe(seconds)
+            # results are filled BEFORE any accounting (ADVICE r5): a
+            # _fill_results failure must error the waiters without having
+            # counted their requests as served — previously hot counts
+            # stayed inflated for work that ultimately failed
+            self._fill_results(job.items, x_tail, pred, scaled, total)
             # accounted before stamping so hot- and cold-path freshness
             # both record POST-dispatch counts (_maybe_promote stamps
             # after this too); stamped only on success — see the demotion
@@ -966,7 +1108,6 @@ class _Bucket:
                             self._hot_demotions[job.hot_idx] = demotions - 1
                         else:
                             del self._hot_demotions[job.hot_idx]
-            self._fill_results(job.items, x_tail, pred, scaled, total)
         except BaseException as exc:
             for it in job.items:
                 it.error = exc
@@ -1023,8 +1164,11 @@ class _Bucket:
                 _M_COMPILE_SECONDS.labels("cold").observe(seconds)
             else:
                 _M_DISPATCH_SECONDS.labels("cold").observe(seconds)
-            self._account(k)
+            # fill first, account after (ADVICE r5): a fill failure here
+            # must not count these requests served a second time on top of
+            # the hot path's failed attempt
             self._fill_results(items, x_tail, pred, scaled, total)
+            self._account(k)
         except BaseException as exc:
             for it in items:
                 it.error = exc
@@ -1171,8 +1315,14 @@ class ServingEngine:
         target_cols: Optional[Dict[str, Optional[List[int]]]] = None,
         mesh=None,
         hot_cap: Optional[int] = None,
+        compile_cache=None,
     ):
         self.mesh = mesh
+        # persistent compile cache (compile_cache.CompileCacheStore or
+        # None = compile-on-boot): buckets consult it before JIT-compiling
+        # and write AOT executables back, so a boot/reload/rollback against
+        # a warmed store pays zero fresh XLA compiles (ARCHITECTURE §14)
+        self.compile_cache = compile_cache
         # shard mode only: machines scoring repeatedly keep an unsharded
         # device copy of their params, skipping the per-dispatch
         # cross-device gather (ROADMAP #3). Default 16, env-tunable;
@@ -1290,6 +1440,8 @@ class ServingEngine:
                 mesh=mesh,
                 dispatch_lock=self._shard_dispatch_lock,
                 hot_cap=self.hot_cap,
+                compile_cache=compile_cache,
+                arch_sig=sig,
             )
             self._buckets.append(bucket)
             for i, (_, entry) in enumerate(members):
@@ -1478,5 +1630,12 @@ class ServingEngine:
             "hot_machines": sum(len(b._hot) for b in self._buckets),
             "hot_requests": sum(
                 b.hot_request_count for b in self._buckets
+            ),
+            # persistent compile cache: this engine's store-lookup counts
+            # (None = cache off, the compile-on-boot mode)
+            "compile_cache": (
+                dict(self.compile_cache.counters)
+                if self.compile_cache is not None
+                else None
             ),
         }

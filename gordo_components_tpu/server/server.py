@@ -227,7 +227,12 @@ class _ServerState:
 
     __slots__ = ("machines", "single", "engine", "_inflight", "_cond")
 
-    def __init__(self, machines: Dict[str, _Machine], shard_fleet: bool = False):
+    def __init__(
+        self,
+        machines: Dict[str, _Machine],
+        shard_fleet: bool = False,
+        compile_cache=None,
+    ):
         self._inflight = 0
         self._cond = threading.Condition()
         self.machines = machines
@@ -252,6 +257,11 @@ class _ServerState:
                 for name, machine in machines.items()
             },
             mesh=mesh,
+            # persistent compile cache: warmup (and every later program
+            # build) loads AOT executables instead of compiling, so
+            # adopting a generation — boot, /reload, rollback — is
+            # O(load) against a warmed store (ARCHITECTURE §14)
+            compile_cache=compile_cache,
         )
 
     def enter(self) -> None:
@@ -293,6 +303,7 @@ class ModelServer:
         max_inflight: Optional[int] = None,
         quarantine_cooldown: float = 30.0,
         drain_timeout: float = 10.0,
+        compile_cache_store: Optional[str] = None,
     ):
         """``models_root``: optional directory whose immediate subdirs are
         model dirs; enables ``POST /reload`` so machines built AFTER server
@@ -306,8 +317,21 @@ class ModelServer:
         hard-failed machine waits before a recovery probe is allowed.
         ``drain_timeout``: how long a reload waits for the old
         generation's in-flight requests before releasing dropped models.
+
+        ``compile_cache_store``: path of the persistent compile-cache
+        root (AOT-serialized scoring executables; ``"off"`` disables).
+        Default: the ``GORDO_COMPILE_CACHE_STORE`` env var, else
+        ``<models_root>/.compile-cache`` when a models_root is given —
+        the same root a fleet build exports into, so first boot is
+        already warm. Single-dir servers without the env var run with
+        the cache off (nothing anchors a sensible root).
         """
+        from ..compile_cache import resolve_store
+
         self.shard_fleet = shard_fleet
+        self.compile_cache = resolve_store(
+            explicit=compile_cache_store, models_root=models_root
+        )
         if max_inflight is None:
             max_inflight = int(os.environ.get("GORDO_MAX_INFLIGHT", "64"))
         self.admission = AdmissionController(
@@ -355,7 +379,10 @@ class ModelServer:
         # under their metadata name rather than their dir basename)
         self._pinned = dict(machines) if models_root else {}
         self._reload_lock = threading.Lock()
-        self._state = _ServerState(machines, shard_fleet=shard_fleet)
+        self._state = _ServerState(
+            machines, shard_fleet=shard_fleet,
+            compile_cache=self.compile_cache,
+        )
         # every record emitted while serving a request carries its trace id
         # (idempotent; composes with logsetup.configure_logging)
         tracing.install_log_record_factory()
@@ -480,7 +507,14 @@ class ModelServer:
                 self.quarantine.recover(name)
             removed = sorted(set(state.machines) - set(machines))
             if added or removed or refreshed:
-                new_state = _ServerState(machines, shard_fleet=self.shard_fleet)
+                # same compile cache as boot: the new generation's warm-up
+                # below loads executables instead of compiling them, so a
+                # reload (or a rollback adopted via reload) pays zero
+                # fresh XLA compiles against a warmed store
+                new_state = _ServerState(
+                    machines, shard_fleet=self.shard_fleet,
+                    compile_cache=self.compile_cache,
+                )
                 # warm new/changed bucket programs BEFORE publishing the
                 # generation: the old state serves meanwhile, so no request
                 # ever races the compile (the reload POST waits instead)
@@ -1179,12 +1213,14 @@ def build_app(
     shard_fleet: bool = False,
     max_inflight: Optional[int] = None,
     quarantine_cooldown: float = 30.0,
+    compile_cache_store: Optional[str] = None,
 ) -> ModelServer:
     """App factory (reference: ``server.build_app``)."""
     return ModelServer(
         model_dirs, project=project, models_root=models_root,
         shard_fleet=shard_fleet, max_inflight=max_inflight,
         quarantine_cooldown=quarantine_cooldown,
+        compile_cache_store=compile_cache_store,
     )
 
 
@@ -1197,6 +1233,7 @@ def run_server(
     shard_fleet: bool = False,
     trace_dir: Optional[str] = None,
     max_inflight: Optional[int] = None,
+    compile_cache_store: Optional[str] = None,
 ) -> None:
     """Serve with werkzeug's multithreaded server.
 
@@ -1222,11 +1259,14 @@ def run_server(
     app = build_app(
         model_dirs, project=project, models_root=models_root,
         shard_fleet=shard_fleet, max_inflight=max_inflight,
+        compile_cache_store=compile_cache_store,
     )
-    # compile each bucket's scoring program BEFORE accepting traffic: the
+    # warm each bucket's scoring program BEFORE accepting traffic: the
     # first request must pay dispatch (ms), not XLA compile (tens of s).
-    # Best-effort — one broken bucket must not keep the healthy machines
-    # from serving (its own requests will surface the error)
+    # Against a warmed compile-cache store this is load-not-compile —
+    # zero fresh XLA compiles at boot. Best-effort — one broken bucket
+    # must not keep the healthy machines from serving (its own requests
+    # will surface the error)
     try:
         with device_trace(trace_dir):
             warmed = app.engine.warmup()
@@ -1234,7 +1274,15 @@ def run_server(
         logger.warning("Serving engine warm-up failed", exc_info=True)
     else:
         if warmed:
+            cache = app.compile_cache
             logger.info(
-                "Serving engine warm: %d bucket program(s) compiled", warmed
+                "Serving engine warm: %d bucket(s)%s", warmed,
+                (
+                    f" (compile cache {cache.root}: "
+                    f"{cache.counters.get('hit', 0)} hit(s), "
+                    f"{cache.counters.get('write', 0)} write(s))"
+                    if cache is not None
+                    else " (compile cache off)"
+                ),
             )
     run_simple(host, port, app, threaded=True)

@@ -82,7 +82,27 @@ class NormalizedConfig:
         if not isinstance(config, dict):
             raise ValueError(f"Fleet config must be a mapping, got {type(config)}")
         crd_name = None
-        if "spec" in config and isinstance(config.get("spec"), dict):
+        # the CRD unwrap requires CRD MARKERS (kind/apiVersion), not just a
+        # top-level 'spec' mapping: a plain fleet config that happens to
+        # carry a 'spec' key must parse normally instead of being rejected
+        # with "no spec.config mapping" (ADVICE r5). A config that declares
+        # kind: Gordo (or any apiVersion) and has a spec mapping is
+        # unambiguously the wrapper — and a WRONG kind with a spec is
+        # rejected loudly rather than misread as a flat config.
+        kind = config.get("kind")
+        is_crd = isinstance(config.get("spec"), dict) and (
+            kind is not None or "apiVersion" in config
+        )
+        if kind is not None and not is_crd:
+            raise ValueError(
+                f"Config declares kind: {kind!r} but has no spec mapping; "
+                "a CRD-shaped fleet config needs spec.config"
+            )
+        if is_crd and kind not in (None, "Gordo"):
+            raise ValueError(
+                f"Unsupported CRD kind {kind!r}; expected 'Gordo'"
+            )
+        if is_crd:
             # the reference's full CRD wrapper (apiVersion: equinor.com/v1,
             # kind: Gordo): machines/globals live under spec.config and the
             # project name under metadata.name — accepted verbatim so a

@@ -175,6 +175,32 @@ def test_cli_fleet_build_device_error_exit_codes(runner, tmp_path, monkeypatch):
         assert result.exit_code == expected, (message, result.output)
 
 
+def test_permanent_xla_classifier_is_anchored():
+    """ADVICE r5: the permanent-failure classifier must match statuses at
+    the START of the message — a transient failure whose wrapped error
+    text merely EMBEDS a permanent-looking status must stay retryable."""
+    from gordo_components_tpu.cli.cli import _is_permanent_xla_error
+
+    # leading statuses classify (jax raises as "STATUS: detail")
+    assert _is_permanent_xla_error("INVALID_ARGUMENT: unsupported HLO")
+    assert _is_permanent_xla_error("  INVALID_ARGUMENT: after whitespace")
+    assert _is_permanent_xla_error(
+        "RESOURCE_EXHAUSTED: attempting to allocate 21.0G"
+    )
+    # embedded statuses do NOT: a dead-peer transport error quoting its
+    # peer's INVALID_ARGUMENT must retry, not FailJob the build
+    assert not _is_permanent_xla_error(
+        "UNAVAILABLE: peer reported INVALID_ARGUMENT: bad collective"
+    )
+    assert not _is_permanent_xla_error(
+        "INTERNAL: retrying after RESOURCE_EXHAUSTED: allocation failed"
+    )
+    # RESOURCE_EXHAUSTED without allocator wording stays retryable
+    assert not _is_permanent_xla_error(
+        "RESOURCE_EXHAUSTED: trailing metadata size exceeds limit"
+    )
+
+
 def _jax_cache_dir():
     import jax as _jax
 

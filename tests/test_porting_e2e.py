@@ -40,7 +40,15 @@ def test_crd_wrapper_normalizes():
         "gordo_components.model.anomaly.diff.DiffBasedAnomalyDetector"
         in config.machines[0].model
     )
+    # a MARKED CRD (kind present) with a broken spec fails on spec.config;
+    # an unmarked mapping with a 'spec' key is a plain fleet config and
+    # fails on its own terms instead (ADVICE r5: the unwrap keys on
+    # kind/apiVersion, not on any top-level 'spec' mapping)
     with pytest.raises(ValueError, match="spec.config"):
+        NormalizedConfig(
+            {"kind": "Gordo", "spec": {}, "metadata": {"name": "x"}}
+        )
+    with pytest.raises(ValueError, match="machines"):
         NormalizedConfig({"spec": {}, "metadata": {"name": "x"}})
 
 

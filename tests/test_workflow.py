@@ -61,6 +61,47 @@ def test_normalized_config_merges_globals():
     assert steps[1]["DenseAutoEncoder"]["epochs"] == 5
 
 
+def test_crd_unwrap_requires_crd_markers():
+    """ADVICE r5: the CRD unwrap must trigger on kind/apiVersion, not on
+    any top-level 'spec' mapping — a plain fleet config that happens to
+    carry a 'spec' key parses normally."""
+    plain = yaml.safe_load(FLEET_YAML)
+    # a user-chosen extra key named 'spec' must not reroute parsing
+    plain["spec"] = {"arbitrary": "user data"}
+    config = NormalizedConfig(plain)
+    assert [m.name for m in config.machines] == [
+        "compressor-1", "compressor-2",
+    ]
+    assert config.project_name == "plant-x"
+
+    # the real CRD wrapper still unwraps (kind marker present)
+    crd = {
+        "apiVersion": "equinor.com/v1",
+        "kind": "Gordo",
+        "metadata": {"name": "crd-project"},
+        "spec": {"config": yaml.safe_load(FLEET_YAML)},
+    }
+    unwrapped = NormalizedConfig(crd)
+    assert unwrapped.project_name == "plant-x"  # project-name beats crd name
+    assert len(unwrapped.machines) == 2
+
+    # apiVersion alone is marker enough (some tooling strips kind)
+    no_kind = {
+        "apiVersion": "equinor.com/v1",
+        "spec": {"config": yaml.safe_load(FLEET_YAML)},
+    }
+    assert len(NormalizedConfig(no_kind).machines) == 2
+
+    # a wrong kind with a spec fails loudly instead of misparsing
+    with pytest.raises(ValueError, match="kind"):
+        NormalizedConfig({"kind": "Deployment", "spec": {"config": {}}})
+    # a declared kind with no spec is a broken CRD, not a fleet config
+    with pytest.raises(ValueError, match="spec"):
+        NormalizedConfig(
+            {"kind": "Gordo", "machines": [{"name": "m", "dataset": {"x": 1}}]}
+        )
+
+
 def test_normalized_config_validation():
     with pytest.raises(ValueError, match="machines"):
         NormalizedConfig({"project-name": "x"})
