@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke smoke images builder-image server-image watchman-image
+.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke smoke images builder-image server-image watchman-image
 
 test:
 	python -m pytest tests/ -q
@@ -56,9 +56,17 @@ trace-smoke:
 coldstart-smoke:
 	JAX_PLATFORMS=cpu python tools/coldstart_smoke.py
 
+# cross-machine megabatching check: the fused stacked program is
+# bit-identical to the per-machine path at matched batches, 12 threads
+# spread over 8 machines fuse into fewer device dispatches than requests
+# (fusion ratio > 1.5), and shard mode falls back cleanly
+megabatch-smoke:
+	JAX_PLATFORMS=cpu python tools/megabatch_smoke.py
+
 # the full smoke battery: exposition + resilience + store integrity +
-# serving data plane + span attribution + cold-start economics
-smoke: metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke
+# serving data plane + span attribution + cold-start economics +
+# cross-machine megabatching
+smoke: metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke
 
 images: builder-image server-image watchman-image
 

@@ -38,7 +38,10 @@ down), BENCH_SERVE_SHARD (0 — shard stacked params over all devices, the
 HBM capacity mode; measures the gather-hop latency cost vs replicated),
 BENCH_SERVE_COLDSTART (1 — include the two-boot persistent-compile-cache
 block; 0 skips it), BENCH_SERVE_WARM_KB (override the derived batch-warm
-bound — see warm_batch_bound).
+bound — see warm_batch_bound), BENCH_SERVE_XMACHINE (1 — include the
+cross-machine megabatch saturation block; 0 skips it). The engine's own
+GORDO_MEGABATCH / GORDO_FILL_WINDOW_US / GORDO_MEGABATCH_RESIDENCY knobs
+apply as in production (ARCHITECTURE §15).
 """
 
 from __future__ import annotations
@@ -85,13 +88,23 @@ def effective_env() -> dict:
 
     from gordo_components_tpu import wire
     from gordo_components_tpu.observability.flightrec import RECORDER
-    from gordo_components_tpu.server.engine import _dispatch_depth
+    from gordo_components_tpu.server.engine import (
+        _dispatch_depth,
+        _fill_window_us,
+        _megabatch_enabled,
+        _megabatch_residency_cap,
+    )
 
     return {
         "device": jax.devices()[0].platform,
         "n_devices": len(jax.devices()),
         "dispatch_depth": _dispatch_depth(),
         "shard": os.environ.get("BENCH_SERVE_SHARD", "0") == "1",
+        # cross-machine megabatching knobs as the engine resolved them
+        # (shard-mode engines disable megabatching regardless)
+        "megabatch": _megabatch_enabled(),
+        "fill_window_us": _fill_window_us(),
+        "megabatch_residency": _megabatch_residency_cap(),
         # the transport formats this build serves/measures (the wire
         # block reports each one's encode/decode/bytes)
         "wire_formats": ["json", "fast_json", "npz"],
@@ -339,6 +352,17 @@ def measure(
         jax.block_until_ready(
             bucket._program(rows_padded, kb)(bucket.stacked, idxs_kb, xs_kb)
         )
+        if bucket._mega_enabled:
+            # megabatched engines serve live traffic through the fused
+            # program — warm ITS batch shapes too, or the first fused
+            # k>1 dispatch pays an XLA compile inside a timed rung
+            jax.block_until_ready(
+                bucket._mega_program(rows_padded, kb)(
+                    bucket._warm_mega_stack(),
+                    np.zeros((kb,), np.int32),
+                    np.repeat(x_padded[None], kb, axis=0),
+                )
+            )
         if shard_mode and engine.hot_cap and bucket._hot:
             hot_idx = next(iter(bucket._hot))
             jax.block_until_ready(
@@ -374,6 +398,18 @@ def measure(
         if jax.devices()[0].platform == "tpu"
         else None
     )
+
+    # -- cross-machine megabatch saturation (ISSUE 7): 12 client threads
+    # SPREAD over >= 8 distinct machines — each thread walks its own
+    # offset through the spread set, so concurrent dispatch windows
+    # almost always contain several different machines. The main
+    # saturation ramp above round-robins one shared counter, which lets
+    # per-dispatch overhead hide inside repeat-machine micro-batches;
+    # this block is the workload megabatching exists for, and reports
+    # the engine's fused-batch stats delta next to rps.
+    cross_machine = None
+    if os.environ.get("BENCH_SERVE_XMACHINE", "1") == "1":
+        cross_machine = measure_cross_machine(engine, names, X, n_requests)
 
     # -- shard mode: hot-machine cache latency (ROADMAP #3) -----------------
     # repeat-machine traffic promotes an unsharded copy after 2 cold hits;
@@ -499,6 +535,12 @@ def measure(
         # worker curve it lands. 0.0 = no rung qualified; null = non-TPU
         # run (the SLO is a TPU anchor, like vs_baseline)
         "rps_at_p99_lt_5ms": rps_at_p99_lt_5ms,
+        # 12 threads spread over >= 8 distinct machines: rps/latency plus
+        # this block's fused-dispatch delta (fusion_ratio > 1 ⇔ fewer
+        # device dispatches than requests). None = BENCH_SERVE_XMACHINE=0
+        "cross_machine": cross_machine,
+        # engine-resolved megabatch config + lifetime fusion counters
+        "megabatch": stats["megabatch"],
         # per-format serialization cost vs the device dispatch cost above
         # (``value``): the host-side half of each request, which pipelined
         # dispatch overlaps with device compute (ARCHITECTURE §12)
@@ -523,6 +565,79 @@ def measure(
         # fresh-XLA-compile count for a cold vs a warmed persistent
         # compile cache (None = BENCH_SERVE_COLDSTART=0)
         "cold_start": cold_start,
+    }
+
+
+def measure_cross_machine(engine, names, X, n_requests: int) -> dict:
+    """The cross-machine saturation sweep: 12 threads, each pinned to its
+    own round-robin offset over ``spread`` distinct machines, so almost
+    every coalesced dispatch window holds requests for several DIFFERENT
+    machines. Reports throughput/latency plus the engine's fused-dispatch
+    delta for exactly this block — ``fusion_ratio`` (requests per device
+    dispatch) is the megabatch acceptance headline; on engines with
+    megabatching off (or shard mode) the same numbers quantify the
+    per-machine baseline the fused path is compared against."""
+    workers = 12
+    spread = list(names[: min(max(8, workers), len(names))])
+    per_thread = max(4, n_requests // workers)
+
+    def one(t: int):
+        lat = []
+        for i in range(per_thread):
+            name = spread[(t + i) % len(spread)]
+            started = time.perf_counter()
+            engine.anomaly(name, X)
+            lat.append(time.perf_counter() - started)
+        return lat
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        list(pool.map(one, range(workers)))  # settle threads + programs
+        engine.quiesce()  # the settle pass must not leak into the deltas
+        before = engine.stats()
+        started = time.perf_counter()
+        lat_lists = list(pool.map(one, range(workers)))
+    elapsed = time.perf_counter() - started
+    engine.quiesce()  # fused-batch stats ride the fetch stage
+    after = engine.stats()
+    lat_ms = np.asarray([v for lat in lat_lists for v in lat]) * 1000.0
+    total = int(lat_ms.size)
+    dispatches = after["dispatches"] - before["dispatches"]
+    requests = after["batched_requests"] - before["batched_requests"]
+    mb_before, mb_after = before["megabatch"], after["megabatch"]
+    mega_dispatches = mb_after["dispatches"] - mb_before["dispatches"]
+    mega_requests = mb_after["requests"] - mb_before["requests"]
+    return {
+        "workers": workers,
+        "machines": len(spread),
+        "requests": total,
+        "rps": round(total / elapsed, 1),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        # fused-batch stats for THIS block only (deltas): dispatches <
+        # requests ⇔ fusion ratio > 1 — the ISSUE 7 acceptance shape
+        "dispatches": dispatches,
+        "fusion_ratio": (
+            round(requests / dispatches, 3) if dispatches else None
+        ),
+        "megabatch": {
+            "enabled": mb_after["enabled"],
+            "dispatches": mega_dispatches,
+            "requests": mega_requests,
+            "fusion_ratio": (
+                round(mega_requests / mega_dispatches, 3)
+                if mega_dispatches
+                else None
+            ),
+            "fill_timeout_total": (
+                mb_after["fill_timeout_total"]
+                - mb_before["fill_timeout_total"]
+            ),
+            "fill_size_total": (
+                mb_after["fill_size_total"] - mb_before["fill_size_total"]
+            ),
+            "fill_window_us": mb_after["fill_window_us"],
+            "resident_machines": mb_after["resident_machines"],
+        },
     }
 
 
@@ -615,7 +730,9 @@ def main() -> None:
                 for k in ("BENCH_SERVE_MACHINES", "BENCH_SERVE_ROWS",
                           "BENCH_SERVE_TAGS", "BENCH_SERVE_REQUESTS",
                           "BENCH_SERVE_SHARD", "BENCH_CPU",
-                          "GORDO_DISPATCH_DEPTH")
+                          "GORDO_DISPATCH_DEPTH", "GORDO_MEGABATCH",
+                          "GORDO_FILL_WINDOW_US",
+                          "GORDO_MEGABATCH_RESIDENCY")
                 if k in os.environ
             },
             "effective": effective_env(),
@@ -625,6 +742,8 @@ def main() -> None:
             "concurrent_rps": result.get("concurrent_rps"),
             # boot economics headline: compile-on-boot vs load-on-boot
             "cold_start": result.get("cold_start"),
+            # cross-machine fused-batch stats (the megabatch headline)
+            "cross_machine": result.get("cross_machine"),
         })
     except Exception:
         pass  # history is never worth failing an artifact over

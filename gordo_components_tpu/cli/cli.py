@@ -522,14 +522,34 @@ def cache_purge_cmd(store_dir, stale_only):
                    "— the root fleet-build exports into, so boot, /reload "
                    "and rollback pay zero fresh XLA compiles against a "
                    "warmed store")
+@click.option("--megabatch/--no-megabatch", default=None,
+              help="cross-machine megabatching: concurrent requests for "
+                   "different machines fuse into one stacked device "
+                   "dispatch (default on; always off with --shard-fleet). "
+                   "Overrides GORDO_MEGABATCH")
+@click.option("--fill-window-us", default=None, type=int,
+              envvar="GORDO_FILL_WINDOW_US",
+              help="bounded megabatch fill window in microseconds: how "
+                   "long a dispatch leader that observes concurrency "
+                   "collects further requests before dispatching the "
+                   "fused batch (core-aware default; 0 disables the "
+                   "wait; idle requests never wait)")
 @_TRACE_DIR_OPT
 def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
-                   max_inflight, faults, compile_cache_store, trace_dir):
+                   max_inflight, faults, compile_cache_store, megabatch,
+                   fill_window_us, trace_dir):
     """Serve built model(s) over REST."""
     import os
 
     from ..serializer import load_metadata
     from ..server import run_server
+
+    # engine knobs resolve from env at construction: export the CLI's
+    # answers so boot AND every /reload generation swap agree on them
+    if megabatch is not None:
+        os.environ["GORDO_MEGABATCH"] = "1" if megabatch else "0"
+    if fill_window_us is not None:
+        os.environ["GORDO_FILL_WINDOW_US"] = str(fill_window_us)
 
     if faults is not None:
         from ..resilience import faults as faults_mod

@@ -12,8 +12,13 @@ Implementation: load the freshly-built models and warm a throwaway
 :class:`~gordo_components_tpu.server.engine.ServingEngine` wired to the
 cache — the exact code path a server boot runs, so the cache keys match
 by construction (re-deriving the engine's bucket/shape logic here would
-be a second copy that drifts). Best-effort end to end: a failed export
-costs the first server boot its compiles, never the build its artifacts.
+be a second copy that drifts). Because warmup routes through the same
+dispatch paths a server uses, the export covers whatever the boot will
+need: the ``serving-mega`` fused megabatch executable on replicated
+engines (ARCHITECTURE §15), the cold/hot programs in shard mode — under
+the same ``GORDO_MEGABATCH*`` env the server will boot with.
+Best-effort end to end: a failed export costs the first server boot its
+compiles, never the build its artifacts.
 """
 
 from __future__ import annotations

@@ -7,9 +7,11 @@ the jax/jaxlib pair that produced them — none of which the bytes
 themselves declare loudly enough to trust. So every cache entry's key
 carries two halves:
 
-- the **program identity** the caller supplies (architecture signature,
-  stacked machine count, shape bucket ``(rows, k)``, sharding/donation
-  config — see ``server/engine.py``), and
+- the **program identity** the caller supplies (kind — ``serving-cold``
+  / ``serving-hot`` / ``serving-mega`` for the fused megabatch program,
+  which also carries its resident-stack height — plus architecture
+  signature, stacked machine count, shape bucket ``(rows, k)``,
+  sharding/donation config — see ``server/engine.py``), and
 - the **backend fingerprint** computed here (jax + jaxlib versions,
   platform, device kind, topology, host ISA).
 

@@ -40,6 +40,8 @@ from .registry import REGISTRY
 STAGES = (
     "admission",       # admission-gate wait (server)
     "queue_wait",      # bucket pending queue until a leader dispatches it
+    "megabatch",       # leader's bounded fill window collecting concurrent
+                       # submits across machines into one fused dispatch
     "dispatch",        # pre-dispatch seams + async enqueue (leader thread)
     "device_execute",  # enqueue -> fetch-begin (device compute overlap)
     "fetch",           # jax.device_get: remaining compute + D2H copy

@@ -40,6 +40,25 @@ handler threads. In-flight depth is bounded (default 2,
 the ``_busy`` leader latch is released between the dispatch and fetch
 stages, and in shard mode the process-global collective-launch lock covers
 only the enqueue window — never the device-to-host copy.
+
+Cross-machine MEGABATCHING (docs/ARCHITECTURE.md §15): replicated engines
+serve concurrent requests for *different* machines of one shape bucket
+through a single resident stacked-parameter program —
+``vmap(machine_score)`` over a machine axis, gather-by-slot, per-slot
+validity handled host-side (padding slots replicate a live slot and are
+never fanned out). The hot-cache promotion machinery generalizes here
+into *which machines are resident in the stacked program*: fleets within
+``GORDO_MEGABATCH_RESIDENCY`` (default 128) are fully resident from boot
+(the resident stack IS the bucket's stacked tree); larger fleets earn
+slots in a capped resident stack exactly like hot-cache promotion, with
+freshness-guarded LRU eviction and demotion backoff. A bounded FILL
+WINDOW (``GORDO_FILL_WINDOW_US``, core-aware default) lets a new leader
+that observes concurrency collect in-flight submits across machines
+before dispatching — fill overlaps device execute via the pipelined
+leader/collector split, and a lone request on an idle bucket bypasses
+the wait entirely. Odd shapes, non-resident machines, and shard mode
+fall back to the per-machine paths below, bit-identically (the
+perf_smoke/megabatch_smoke parity harnesses gate this).
 """
 
 from __future__ import annotations
@@ -112,6 +131,38 @@ _M_HOT_EVENTS = REGISTRY.counter(
     "failure), backoff_defer (re-promotion blocked by demotion backoff)",
     labels=("event",),
 )
+_M_MEGA_BATCH = REGISTRY.histogram(
+    "gordo_engine_megabatch_fused_requests",
+    "Requests fused into one cross-machine megabatch dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+_M_MEGA_MACHINES = REGISTRY.histogram(
+    "gordo_engine_megabatch_fused_machines",
+    "DISTINCT machines fused into one megabatch dispatch (the "
+    "cross-machine half of the fusion win; 1 = a pure single-machine "
+    "batch served through the resident stacked program)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+_M_FILL_TRIGGER = REGISTRY.counter(
+    "gordo_engine_fill_window_total",
+    "Fill-window outcomes per leadership: size (a full max_batch was "
+    "pending before the window elapsed), timeout (window elapsed), "
+    "bypass (no concurrency evidence — idle requests never wait)",
+    labels=("trigger",),
+)
+_M_FILL_OCCUPANCY = REGISTRY.histogram(
+    "gordo_engine_fill_window_occupancy",
+    "Pending requests at fill-window close, as a fraction of max_batch",
+    buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+)
+_M_MEGA_EVENTS = REGISTRY.counter(
+    "gordo_engine_megabatch_events_total",
+    "Megabatch residency + repair lifecycle: promote, evict, demote, "
+    "backoff_defer (re-promotion blocked by demotion backoff), "
+    "fallback_cold (enqueue failure rescored as one cold batch), "
+    "retry_isolated (fetch failure rescored one request at a time)",
+    labels=("event",),
+)
 
 
 def _supports_donation(mesh) -> bool:
@@ -136,6 +187,22 @@ def _round_up_pow2(n: int, minimum: int = 1) -> int:
     return bucket
 
 
+def _env_int(name: str, default: int, minimum: int = 0) -> int:
+    """Robust integer env knob: unset → default; a non-integer warns and
+    falls back (a bad env var must never fail a server boot); values
+    clamp to ``minimum``. The one copy of the parse contract every
+    engine knob shares."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = int(raw)
+    except (TypeError, ValueError):
+        logger.warning("%s=%r is not an int; using %d", name, raw, default)
+        return default
+    return max(minimum, value)
+
+
 def _dispatch_depth() -> int:
     """Bounded in-flight dispatch depth per bucket. 2 overlaps one
     fetch+serialize with one device execution (the design point on real
@@ -150,17 +217,46 @@ def _dispatch_depth() -> int:
     non-integer falls back to the default rather than erroring a server
     boot."""
     default = 2 if (os.cpu_count() or 1) >= 4 else 1
-    raw = os.environ.get("GORDO_DISPATCH_DEPTH")
+    return _env_int("GORDO_DISPATCH_DEPTH", default, minimum=1)
+
+
+def _megabatch_enabled() -> bool:
+    """``GORDO_MEGABATCH``: cross-machine fused dispatch through the
+    resident stacked program (default ON for replicated engines; shard
+    mode always falls back to the per-machine paths — ARCHITECTURE §15).
+    Any of 0/false/off/no disables; everything else, including unset,
+    enables."""
+    raw = os.environ.get("GORDO_MEGABATCH")
     if raw is None:
-        return default
-    try:
-        depth = int(raw)
-    except (TypeError, ValueError):
-        logger.warning(
-            "GORDO_DISPATCH_DEPTH=%r is not an int; using %d", raw, default
-        )
-        return default
-    return max(1, depth)
+        return True
+    return raw.strip().lower() not in ("0", "false", "off", "no")
+
+
+def _megabatch_residency_cap() -> int:
+    """``GORDO_MEGABATCH_RESIDENCY``: how many machines per bucket may be
+    resident in the stacked megabatch program at once. Fleets at or under
+    the cap are fully resident from boot with ZERO extra device memory
+    (the resident stack aliases the bucket's stacked tree); larger fleets
+    earn slots in a capped copy, hot-cache-style. 0 disables megabatching
+    outright (no residents, ever); a non-integer falls back to the
+    default rather than erroring a server boot."""
+    return _env_int("GORDO_MEGABATCH_RESIDENCY", 128)
+
+
+def _fill_window_us() -> int:
+    """``GORDO_FILL_WINDOW_US``: the bounded megabatch fill window in
+    MICROSECONDS — how long a new leader that observes concurrency may
+    hold its dispatch to collect in-flight submits across machines into
+    one fused batch. The default is core-aware, like the dispatch depth:
+    on a <4-CPU host per-dispatch overhead dominates throughput (the
+    same PR 4 measurement that defaults such hosts to serial dispatch),
+    so the window is wider there; hosts with spare cores keep it tight
+    because overlap already hides most dispatch cost. 0 disables the
+    wait (fusion still happens opportunistically via queue drains). The
+    window never delays a lone request on an idle bucket — see
+    ``_Bucket._fill_window``."""
+    default = 250 if (os.cpu_count() or 1) >= 4 else 1000
+    return _env_int("GORDO_FILL_WINDOW_US", default)
 
 
 class ScoreResult(NamedTuple):
@@ -328,6 +424,9 @@ class _Bucket:
         hot_cap: int = 0,
         compile_cache=None,
         arch_sig: str = "",
+        megabatch: bool = False,
+        fill_window_s: float = 0.0,
+        mega_cap: int = 0,
     ):
         self.apply_fn = apply_fn
         # persistent compile cache (compile_cache.CompileCacheStore or
@@ -421,8 +520,48 @@ class _Bucket:
             if self._fleet_sharding is None
             else jax.device_put(stacked, self._fleet_sharding)
         )
+        # cross-machine megabatching (ARCHITECTURE §15): replicated mode
+        # only — sharded stacks keep the per-machine paths (their fused
+        # program would re-pay the cross-device gather per slot AND the
+        # collective-launch lock, exactly what the hot cache exists to
+        # skip). Residency generalizes the hot cache: _mega_slots maps
+        # machine idx -> slot in the resident stacked tree the megabatch
+        # program gathers from. Fleets within mega_cap are fully resident
+        # from boot and the resident stack ALIASES self.stacked (zero
+        # copy); bigger fleets earn slots in a capped rebuilt stack via
+        # _maybe_promote_mega. Routing (leader) reads slots/stack under
+        # _mega_lock; every mutation runs on the single _complete thread
+        # (the collector invariant), also under the lock.
+        self._mega_enabled = bool(megabatch) and mesh is None and mega_cap > 0
+        self._mega_cap = int(mega_cap)
+        self._mega_full = (
+            self._mega_enabled and len(self.names) <= self._mega_cap
+        )
+        self._mega_lock = threading.Lock()
+        self._mega_slots: "OrderedDict[int, int]" = OrderedDict()
+        if self._mega_full:
+            self._mega_slots.update((i, i) for i in range(len(self.names)))
+        self._mega_free: List[int] = (
+            list(range(self._mega_cap))
+            if (self._mega_enabled and not self._mega_full)
+            else []
+        )
+        self._mega_host_stack = None  # partial mode: numpy mirror (lazy)
+        self._mega_stack_dev = None  # partial mode: device resident stack
+        self._mega_hits: Dict[int, int] = {}
+        self._mega_last_use: Dict[int, int] = {}
+        self._mega_demotions: Dict[int, int] = {}
+        # bounded fill window (seconds); only engages under megabatching —
+        # shard mode's fallback keeps today's no-added-wait drain
+        self._fill_s = max(0.0, fill_window_s) if self._mega_enabled else 0.0
+        self._filling = False  # a leader is inside its fill window
+        self.mega_dispatch_count = 0
+        self.mega_request_count = 0
+        self.fill_timeout_count = 0
+        self.fill_size_count = 0
         # (rows, k) -> stacked gather-by-idx program;
-        # ("hot", rows, k) -> unsharded hot-machine program
+        # ("hot", rows, k) -> unsharded hot-machine program;
+        # ("mega", rows, k) -> resident-stack gather-by-slot program
         self._programs: Dict[Tuple[Any, ...], Any] = {}
         # program keys built but not yet dispatched: their FIRST dispatch
         # pays the XLA compile, so its duration is accounted to the compile
@@ -571,6 +710,122 @@ class _Bucket:
         self._programs[key] = program
         return program
 
+    @property
+    def _mega_stack_height(self) -> int:
+        """Machine-axis length of the resident stack the megabatch
+        program gathers from — the full stacked tree in full-residency
+        mode, the residency cap otherwise. Part of the program's identity
+        (shape AND cache key)."""
+        if self._mega_full:
+            return int(self.stacked["tcols"].shape[0])
+        return self._mega_cap
+
+    def _mega_program(self, rows: int, k: int):
+        """The cross-machine megabatch program: ``vmap(machine_score)``
+        over a RESIDENT stacked tree, gather-by-slot — one device
+        execution scores up to ``k`` requests for as many distinct
+        resident machines. Identical math to the cold program (same
+        ``machine_score`` closure, same gather-then-score structure), so
+        fused and per-machine scores are bit-identical; replicated mode
+        only, so no shard lock and no collectives."""
+        key = ("mega", rows, k)
+        program = self._programs.get(key)
+        if program is not None:
+            _M_PROGRAM_CACHE.labels("mega", "hit").inc()
+            return program
+        _M_PROGRAM_CACHE.labels("mega", "miss").inc()
+        machine_score = self._machine_score_fn()
+
+        def score_slot(resident, slot, x):
+            machine = jax.tree_util.tree_map(lambda a: a[slot], resident)
+            return machine_score(machine, x)
+
+        vmapped = jax.vmap(score_slot, in_axes=(None, 0, 0))
+        donate = (2,) if self._donate else ()  # xs: rebuilt per dispatch
+        jitted = jax.jit(vmapped, donate_argnums=donate)
+        if self._compile_cache is None:
+            self._fresh_programs.add(key)
+            self._programs[key] = jitted
+            return jitted
+        height = self._mega_stack_height
+        stack_avatar = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                (height,) + tuple(a.shape[1:]), a.dtype
+            ),
+            self.stacked,
+        )
+        avatars = (
+            stack_avatar,
+            jax.ShapeDtypeStruct((k,), jnp.int32),
+            jax.ShapeDtypeStruct((k, rows, self.n_features), jnp.float32),
+        )
+        # probe stack: full residency aliases the live stacked tree (like
+        # the cold probe); only a capped stack needs a throwaway zeros
+        # tree of its own height
+        probe_stack = (
+            (lambda: self.stacked)
+            if self._mega_full
+            else (
+                lambda: jax.tree_util.tree_map(
+                    lambda a: np.zeros(
+                        (height,) + tuple(a.shape[1:]), a.dtype
+                    ),
+                    self.stacked,
+                )
+            )
+        )
+        program = self._cached_program(
+            "mega", (rows, k), jitted, avatars,
+            probe_args=lambda: (
+                probe_stack(),
+                np.zeros((k,), np.int32),
+                np.zeros((k, rows, self.n_features), np.float32),
+            ),
+        )
+        self._programs[key] = program
+        return program
+
+    def _warm_mega_stack(self):
+        """A dispatchable resident stack for the warm paths (warmup,
+        bench program warming): the live stack when one exists, else a
+        zeros stack of the right height (partial mode before any
+        promotion — the warmed program's binary is slot-content-agnostic,
+        only the SHAPE matters)."""
+        with self._mega_lock:
+            stack = self.stacked if self._mega_full else self._mega_stack_dev
+        if stack is not None:
+            return stack
+        return jax.tree_util.tree_map(
+            lambda a: np.zeros(
+                (self._mega_cap,) + tuple(a.shape[1:]), a.dtype
+            ),
+            self.stacked,
+        )
+
+    def warmup_mega(self, rows: int) -> None:
+        """Pre-pay the megabatch program's first-dispatch cost at the
+        warmed row bucket (mirrors ``warmup_hot``). Full-residency
+        buckets usually compiled it already through warmup's live scoring
+        request; partial-mode buckets boot with an EMPTY residency set
+        (their warmup request scores cold), so without this the first
+        promoted machine's fused dispatch would pay an XLA compile inside
+        a live request."""
+        if not self._mega_enabled:
+            return
+        key = ("mega", rows, 1)
+        if key in self._programs and key not in self._fresh_programs:
+            return  # live traffic already compiled AND dispatched it
+        program = self._mega_program(rows, 1)
+        stack = self._warm_mega_stack()
+        xs = np.zeros((1, rows, self.n_features), np.float32)
+        started = time.perf_counter()
+        jax.block_until_ready(program(stack, np.zeros((1,), np.int32), xs))
+        if key in self._fresh_programs:
+            self._fresh_programs.discard(key)
+            _M_COMPILE_SECONDS.labels("mega").observe(
+                time.perf_counter() - started
+            )
+
     # -- persistent compile cache (ARCHITECTURE §14) -------------------------
     def _stacked_avatar(self):
         return jax.tree_util.tree_map(
@@ -582,7 +837,7 @@ class _Bucket:
         fingerprint (jax/jaxlib, device kind, topology, host ISA) is added
         by the store; together they are the invalidation rule — any drift
         reads as a miss or stale entry, never as a wrong executable."""
-        return {
+        key = {
             "kind": f"serving-{kind}",
             "arch": self._arch_sig,
             "machines": int(self.stacked["tcols"].shape[0]),
@@ -592,6 +847,12 @@ class _Bucket:
             "mesh": list(self.mesh.devices.shape) if self.mesh else None,
             "donate": self._donate,
         }
+        if kind == "mega":
+            # the resident stack's machine-axis length is part of the
+            # megabatch program's identity: a capped resident stack
+            # compiles a different gather than a fully-resident one
+            key["resident"] = int(self._mega_stack_height)
+        return key
 
     def _cached_program(self, kind, shape_key, jitted, avatars, probe_args):
         """Store-backed program resolution: load the AOT executable when a
@@ -633,7 +894,7 @@ class _Bucket:
                 "rows=%d k=%d); serving via lazy JIT", kind, rows, k,
             )
             self._fresh_programs.add(
-                (rows, k) if kind == "cold" else ("hot", rows, k)
+                (rows, k) if kind == "cold" else (kind, rows, k)
             )
             return jitted
         _M_COMPILE_SECONDS.labels(kind).observe(time.perf_counter() - started)
@@ -694,6 +955,11 @@ class _Bucket:
         queued = time.perf_counter()
         with self._cond:
             self._pending.setdefault(rows, []).append(item)
+            if self._filling:
+                # a leader is holding its fill window open for exactly
+                # this arrival — wake it so a full max_batch can
+                # size-trigger before the timeout
+                self._cond.notify_all()
             while True:
                 if item.done.is_set() or item.in_flight:
                     break  # a leader dispatched it; await the collector
@@ -712,6 +978,11 @@ class _Bucket:
         )
         if is_leader:
             try:
+                # megabatch fill: bounded wait collecting concurrent
+                # submits across machines before the first drain round
+                # (no-op without a window, without concurrency evidence,
+                # or if a racing leader already completed this item)
+                self._fill_window(item)
                 # drains until the queue empties OR this leader's own item
                 # completes — under sustained arrivals the queue may never
                 # empty, and the leader must not serve everyone else's
@@ -764,6 +1035,63 @@ class _Bucket:
         assert item.result is not None
         return item.result
 
+    def _fill_window(self, item: _Item) -> None:
+        """The megabatch fill window (ARCHITECTURE §15): a NEW leader
+        with evidence of concurrency — other requests already pending, or
+        dispatches in flight — holds its first drain for up to the window,
+        collecting concurrent submits across machines into one fused
+        batch. A lone request on an idle bucket bypasses the wait
+        entirely, so idle-path p50 is unchanged; a full ``max_batch``
+        pending size-triggers dispatch before the timeout. The wait rides
+        the pipelined split: while this leader fills, the collector is
+        still fetching the previous dispatches."""
+        window = self._fill_s
+        if not window or item.done.is_set():
+            return
+        started = time.perf_counter()
+        deadline_at = started + window
+        trigger = "timeout"
+        with self._cond:
+            # concurrency evidence counts EVERY pending request (any
+            # arrival rate justifies filling); the size trigger and the
+            # occupancy metric below measure the LARGEST single-shape
+            # batch — requests in different row buckets can never fuse,
+            # so the cross-bucket total would close windows early and
+            # overstate fused-batch fullness
+            total = sum(len(v) for v in self._pending.values())
+            if total <= 1 and self._fetch_queue.unfinished_tasks == 0:
+                _M_FILL_TRIGGER.labels("bypass").inc()
+                return
+            self._filling = True
+            try:
+                while True:
+                    largest = max(
+                        (len(v) for v in self._pending.values()), default=0
+                    )
+                    if largest >= self.max_batch:
+                        trigger = "size"
+                        break
+                    remaining = deadline_at - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            finally:
+                self._filling = False
+        duration = time.perf_counter() - started
+        if trigger == "size":
+            self.fill_size_count += 1
+        else:
+            self.fill_timeout_count += 1
+        _M_FILL_TRIGGER.labels(trigger).inc()
+        _M_FILL_OCCUPANCY.observe(min(1.0, largest / float(self.max_batch)))
+        # the megabatch stage: how long THIS request's leader held the
+        # fill open, and what it collected (each fused item still gets
+        # its own dispatch/device_execute/fetch spans)
+        spans.record_into(
+            item.ctx, "megabatch", started, duration,
+            trigger=trigger, collected=largest,
+        )
+
     def _should_pipeline(self) -> bool:
         """Queue pressure check (leader thread, between batches): pipeline
         the fetch when the collector already has work in flight or new
@@ -777,6 +1105,18 @@ class _Bucket:
             return bool(self._pending)
 
     def _dispatch(self, rows: int, items: List[_Item], defer: bool) -> None:
+        # megabatch first (replicated mode): a batch whose machines are
+        # ALL resident in the stacked program fuses into one gather-by-
+        # slot execution — cross-machine continuous batching. Any
+        # non-resident machine in the batch keeps the whole batch on the
+        # cold path (which serves it correctly and counts the hit toward
+        # its promotion), mirroring the hot path's pure-batch rule.
+        if self._mega_enabled:
+            routed = self._mega_route(items)
+            if routed is not None:
+                stack, slots = routed
+                self._dispatch_mega(rows, items, stack, slots, defer)
+                return
         # the hot path fires ONLY for a PURE batch — every request for one
         # already-hot machine — which is exactly the cache's design case
         # (concentrated repeat-machine traffic, where drained batches are
@@ -797,6 +1137,98 @@ class _Bucket:
             self._dispatch_hot(rows, idx0, hot_tree, items, defer)
         else:
             self._dispatch_cold(rows, items, defer)
+
+    def _mega_route(self, items: List[_Item]):
+        """Resolve a drained batch against the residency set: the
+        ``(resident stack, slot list)`` to dispatch through when EVERY
+        item's machine is resident, else None (cold fallback). The stack
+        and slots are snapshotted together under the lock so an in-flight
+        dispatch can never pair new slots with an old stack."""
+        with self._mega_lock:
+            stack = self.stacked if self._mega_full else self._mega_stack_dev
+            if stack is None:
+                return None
+            slots = []
+            for it in items:
+                slot = self._mega_slots.get(it.idx)
+                if slot is None:
+                    return None
+                slots.append(slot)
+            for it in items:
+                self._mega_slots.move_to_end(it.idx)  # LRU touch
+        return stack, slots
+
+    def _dispatch_mega(
+        self, rows: int, items: List[_Item], stack: Any, slots: List[int],
+        defer: bool = True,
+    ) -> None:
+        acquired = False
+        try:
+            k = len(items)
+            kb = _round_up_pow2(k)
+            # per-slot validity is HOST-side: padding slots replicate a
+            # live resident slot and their outputs are never fanned out
+            # (an in-program mask would multiply scores by 1.0 — a no-op
+            # bought with an extra input that changes the executable)
+            slot_idxs = np.asarray(
+                slots + [slots[0]] * (kb - k), np.int32
+            )
+            xs = np.stack([it.x for it in items] + [items[0].x] * (kb - k))
+            program = self._mega_program(rows, kb)
+            key = ("mega", rows, kb)
+            fresh = key in self._fresh_programs
+            self._fresh_programs.discard(key)
+            self._inflight_slots.acquire()
+            acquired = True
+            started = time.perf_counter()
+            # replicated program, no collectives: no shard lock needed
+            outputs = program(stack, slot_idxs, xs)
+        except Exception as exc:
+            # the fused path must never fail a request the per-machine
+            # path could serve: demote the batch's machines (a broken
+            # fused program or resident stack must stop being routed to,
+            # exactly the hot path's enqueue-failure contract — backoff
+            # lets them re-earn residency) and rescore the SAME batch
+            # cold (which also owns the error fan-out if it fails too)
+            if acquired:
+                self._inflight_slots.release()
+            logger.exception(
+                "megabatch dispatch failed at enqueue for a fused "
+                "%d-request batch; demoting its machines and rescoring "
+                "on the per-machine cold path",
+                len(items),
+            )
+            _M_MEGA_EVENTS.labels("fallback_cold").inc()
+            for it in items:
+                spans.event_into(
+                    it.ctx, "megabatch_fallback_cold",
+                    error=type(exc).__name__,
+                )
+            for idx in {it.idx for it in items}:
+                self._mega_demote(idx)
+            self._dispatch_cold(rows, items, defer)
+            return
+        except BaseException as exc:
+            # KeyboardInterrupt/SystemExit: surface, don't retry
+            if acquired:
+                self._inflight_slots.release()
+            for it in items:
+                it.error = exc
+            for it in items:
+                it.done.set()
+            return
+        enqueued = time.perf_counter()
+        machines = len({it.idx for it in items})
+        for it in items:
+            spans.record_into(
+                it.ctx, "dispatch", started, enqueued - started,
+                path="mega", batch=len(items), machines=machines,
+            )
+        self._finish(
+            _Dispatch("mega", key, fresh, rows, items, outputs, started,
+                      enqueued=enqueued),
+            defer,
+        )
 
     def _finish(self, job: _Dispatch, defer: bool) -> None:
         """Route one enqueued dispatch to its fetch stage: the collector
@@ -1051,6 +1483,33 @@ class _Bucket:
                 self._demote(job.hot_idx)
                 self._retry_cold_sync(job.rows, job.items)
                 return
+            if job.kind == "mega":
+                # a fused execution is all-or-nothing on device, so the
+                # repair path rescopes the failure: each request rescored
+                # in its OWN cold dispatch — one bad machine fails only
+                # its own waiters (error isolation). The batch's machines
+                # are demoted FIRST (the hot path's contract): whether
+                # the culprit is one machine, the resident stack, or the
+                # fused executable itself, the next drained batch must
+                # route cold instead of looping fail-then-repair forever;
+                # innocents re-earn residency under backoff, paid down by
+                # later successes.
+                logger.exception(
+                    "megabatch fetch failed for a fused %d-request batch; "
+                    "demoting its machines and rescoring each request in "
+                    "isolation on the per-machine cold path",
+                    len(job.items),
+                )
+                _M_MEGA_EVENTS.labels("retry_isolated").inc()
+                for it in job.items:
+                    spans.event_into(
+                        it.ctx, "megabatch_fetch_failed_retry_isolated",
+                        error=type(exc).__name__,
+                    )
+                for idx in {it.idx for it in job.items}:
+                    self._mega_demote(idx)
+                self._retry_isolated_sync(job.rows, job.items)
+                return
             for it in job.items:
                 spans.event_into(
                     it.ctx, "fetch_error", error=type(exc).__name__,
@@ -1092,22 +1551,20 @@ class _Bucket:
             # both record POST-dispatch counts (_maybe_promote stamps
             # after this too); stamped only on success — see the demotion
             # above
-            self._account(len(job.items), hot=hot)
+            self._account(len(job.items), path=job.kind)
+            if job.kind == "mega":
+                _M_MEGA_BATCH.observe(len(job.items))
+                _M_MEGA_MACHINES.observe(len({it.idx for it in job.items}))
+                with self._mega_lock:
+                    for idx in {it.idx for it in job.items}:
+                        self._mega_last_use[idx] = self.dispatch_count
+                        self._pay_down_demotions(self._mega_demotions, idx)
             if hot:
                 with self._hot_lock:
                     self._hot_last_use[job.hot_idx] = self.dispatch_count
-                    # a successful hot dispatch pays down the demotion
-                    # backoff: a TRANSIENT past failure (device blip
-                    # during another bucket's promotion) must not
-                    # permanently escalate this machine's re-promotion
-                    # threshold, while a deterministically failing program
-                    # never reaches this line and keeps backing off
-                    demotions = self._hot_demotions.get(job.hot_idx)
-                    if demotions:
-                        if demotions > 1:
-                            self._hot_demotions[job.hot_idx] = demotions - 1
-                        else:
-                            del self._hot_demotions[job.hot_idx]
+                    self._pay_down_demotions(
+                        self._hot_demotions, job.hot_idx
+                    )
         except BaseException as exc:
             for it in job.items:
                 it.error = exc
@@ -1121,13 +1578,22 @@ class _Bucket:
         # capacity mode exists because the fleet is big) must never turn
         # their success into client errors, and the promotion gather now
         # runs on the collector, off every leader's dispatch path. Logged,
-        # and retried naturally by the next cold hit.
-        if not hot:
+        # and retried naturally by the next cold hit. Cold successes feed
+        # BOTH residency caches (hot is shard-only, mega is
+        # replicated-only, so at most one is live per engine).
+        if job.kind == "cold":
             try:
                 self._maybe_promote(job.items)
             except Exception:
                 logger.exception(
                     "hot-cache promotion failed (serving unaffected)"
+                )
+            try:
+                self._maybe_promote_mega(job.items)
+            except Exception:
+                logger.exception(
+                    "megabatch residency promotion failed "
+                    "(serving unaffected)"
                 )
 
     def _retry_cold_sync(self, rows: int, items: List[_Item]) -> None:
@@ -1185,6 +1651,201 @@ class _Bucket:
                     "hot-cache promotion failed (serving unaffected)"
                 )
 
+    def _retry_isolated_sync(self, rows: int, items: List[_Item]) -> None:
+        """Megabatch repair path: a fused dispatch whose fetch failed is
+        rescored ONE REQUEST AT A TIME through the per-machine cold path,
+        so one bad machine fails only its own waiters — the fused program
+        is all-or-nothing on device, and a batch-level retry would fail
+        every waiter again if any single machine is deterministically
+        bad. Synchronous on the collector, like ``_retry_cold_sync``. The
+        caller demoted the batch's machines before this runs; the
+        per-item demote below is a backstop for future callers (a no-op
+        when the machine is already non-resident)."""
+        for item in items:
+            try:
+                program = self._program(rows, 1)
+                fresh = (rows, 1) in self._fresh_programs
+                self._fresh_programs.discard((rows, 1))
+                idxs = np.asarray([item.idx], np.int32)
+                started = time.perf_counter()
+                with self._dispatch_lock or contextlib.nullcontext():
+                    outputs = program(self.stacked, idxs, item.x[None])
+                enqueued = time.perf_counter()
+                x_tail, pred, scaled, total = jax.device_get(outputs)
+                fetched = time.perf_counter()
+                spans.record_into(
+                    item.ctx, "dispatch", started, enqueued - started,
+                    path="cold", retry="megabatch-fetch-failure",
+                )
+                spans.record_into(
+                    item.ctx, "fetch", enqueued, fetched - enqueued,
+                    path="cold", retry="megabatch-fetch-failure",
+                )
+                if fresh:
+                    _M_COMPILE_SECONDS.labels("cold").observe(
+                        fetched - started
+                    )
+                else:
+                    _M_DISPATCH_SECONDS.labels("cold").observe(
+                        fetched - started
+                    )
+                # fill first, account after (ADVICE r5), like every
+                # other completion path
+                self._fill_results([item], x_tail, pred, scaled, total)
+                self._account(1)
+            except BaseException as exc:
+                item.error = exc
+                spans.event_into(
+                    item.ctx, "megabatch_isolated_retry_failed",
+                    error=type(exc).__name__,
+                )
+                try:
+                    self._mega_demote(item.idx)
+                except Exception:  # pragma: no cover - bookkeeping only
+                    logger.exception("megabatch demotion failed")
+            finally:
+                item.done.set()
+
+    def _mega_demote(self, idx: int) -> None:
+        """Remove a machine from megabatch residency (its fused serves
+        failed); its traffic falls back to the cold path and re-earns a
+        slot under exponential backoff, mirroring hot-cache demotion."""
+        with self._mega_lock:
+            slot = self._mega_slots.pop(idx, None)
+            if slot is None:
+                return
+            if not self._mega_full:
+                self._mega_free.append(slot)
+            self._mega_last_use.pop(idx, None)
+            self._mega_hits.pop(idx, None)
+            self._mega_demotions[idx] = self._mega_demotions.get(idx, 0) + 1
+        _M_MEGA_EVENTS.labels("demote").inc()
+        spans.event(
+            "megabatch_residency", action="demote",
+            machine=self.names[idx] if idx < len(self.names) else idx,
+        )
+
+    def _maybe_promote_mega(self, items: List[_Item]) -> None:
+        """After a successful cold dispatch: megabatch residency — the
+        hot-cache promotion policy generalized to 'which machines are
+        resident in the stacked program'. Full-residency buckets only
+        ever re-admit machines demoted by failures (slot == machine idx,
+        the stack aliases ``self.stacked``, so re-admission is free);
+        capped buckets assign slots in a REBUILT resident stack (host
+        gather + device upload, outside the lock so leader routing never
+        stalls on it), with the same hit thresholds, freshness-guarded
+        LRU eviction, and demotion backoff as the hot cache. Runs on the
+        single ``_complete`` thread, like ``_maybe_promote``."""
+        if not self._mega_enabled:
+            return
+        pending: List[Tuple[int, int]] = []  # (idx, slot) claimed below
+        for idx in {it.idx for it in items}:
+            with self._mega_lock:
+                if idx in self._mega_slots:
+                    # resident machine served via a mixed cold batch:
+                    # refresh freshness (same churn rationale as the hot
+                    # cache's mixed-batch touch)
+                    self._mega_slots.move_to_end(idx)
+                    self._mega_last_use[idx] = self.dispatch_count
+                    continue
+                hits = self._mega_hits.get(idx, 0) + 1
+                self._mega_hits[idx] = hits
+                if hits < 2 * (8 ** self._mega_demotions.get(idx, 0)):
+                    if self._mega_demotions.get(idx):
+                        _M_MEGA_EVENTS.labels("backoff_defer").inc()
+                    continue
+                if self._mega_full:
+                    # re-admission after demotion: no stack work at all
+                    self._mega_slots[idx] = idx
+                    self._mega_last_use[idx] = self.dispatch_count
+                    self._mega_hits.pop(idx, None)
+                    _M_MEGA_EVENTS.labels("promote").inc()
+                    spans.event(
+                        "megabatch_residency", action="promote",
+                        machine=self.names[idx], slot=idx,
+                    )
+                    continue
+                if not self._mega_free:
+                    victim = next(iter(self._mega_slots))
+                    age = self.dispatch_count - self._mega_last_use.get(
+                        victim, 0
+                    )
+                    if age < self._hot_evict_window():
+                        continue  # working set is live — don't thrash it
+                    self._mega_free.append(self._mega_slots.pop(victim))
+                    self._mega_last_use.pop(victim, None)
+                    self._mega_hits.pop(victim, None)
+                    _M_MEGA_EVENTS.labels("evict").inc()
+                    spans.event(
+                        "megabatch_residency", action="evict",
+                        machine=self.names[victim],
+                    )
+                # reserve the slot now: a multi-machine drain can promote
+                # several machines in one pass, and each needs its own
+                pending.append((idx, self._mega_free.pop()))
+        if not pending:
+            return
+        # the stack rebuild runs OUTSIDE the lock: host gathers plus ONE
+        # (cap, ...) device upload for the whole pass — per-machine
+        # uploads would transfer the full stack once per promotion — and
+        # none of it may stall leader routing. Mutation is safe lock-free:
+        # promotions are serialized by the single-_complete-thread
+        # invariant; only the final pointer/slot swap needs the lock
+        # (routing snapshots both together, and in-flight dispatches keep
+        # the OLD stack+slots pair alive and consistent).
+        try:
+            if self._mega_host_stack is None:
+                self._mega_host_stack = jax.tree_util.tree_map(
+                    lambda a: np.zeros(
+                        (self._mega_cap,) + tuple(a.shape[1:]), a.dtype
+                    ),
+                    self.stacked,
+                )
+            for idx, slot in pending:
+                host_tree = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a[idx]), self.stacked
+                )
+                for dst, src in zip(
+                    jax.tree_util.tree_leaves(self._mega_host_stack),
+                    jax.tree_util.tree_leaves(host_tree),
+                ):
+                    dst[slot] = src
+            new_stack = jax.device_put(self._mega_host_stack)
+            with self._mega_lock:
+                for idx, slot in pending:
+                    self._mega_slots[idx] = slot
+                    self._mega_last_use[idx] = self.dispatch_count
+                    self._mega_hits.pop(idx, None)
+                self._mega_stack_dev = new_stack
+        except BaseException:
+            # a failed gather/upload must hand the reserved slots back,
+            # or the cap shrinks permanently with every failure
+            with self._mega_lock:
+                for idx, slot in pending:
+                    if self._mega_slots.get(idx) != slot:
+                        self._mega_free.append(slot)
+            raise
+        for idx, slot in pending:
+            _M_MEGA_EVENTS.labels("promote").inc()
+            spans.event(
+                "megabatch_residency", action="promote",
+                machine=self.names[idx], slot=slot,
+            )
+
+    @staticmethod
+    def _pay_down_demotions(demotions: Dict[int, int], idx: int) -> None:
+        """A successful serve pays down a machine's demotion backoff
+        (hot OR megabatch residency): a TRANSIENT past failure must not
+        permanently escalate its re-promotion threshold, while a
+        deterministically failing machine never reaches this and keeps
+        backing off. Callers hold the matching cache lock."""
+        count = demotions.get(idx)
+        if count:
+            if count > 1:
+                demotions[idx] = count - 1
+            else:
+                del demotions[idx]
+
     def _demote(self, idx: int) -> None:
         with self._hot_lock:
             self._hot.pop(idx, None)
@@ -1193,13 +1854,16 @@ class _Bucket:
             self._hot_demotions[idx] = self._hot_demotions.get(idx, 0) + 1
         _M_HOT_EVENTS.labels("demote").inc()
 
-    def _account(self, k: int, hot: bool = False) -> None:
+    def _account(self, k: int, path: str = "cold") -> None:
         self.dispatch_count += 1
         self.request_count += k
-        if hot:
+        if path == "hot":
             self.hot_request_count += k
+        elif path == "mega":
+            self.mega_dispatch_count += 1
+            self.mega_request_count += k
         self.max_batch_seen = max(self.max_batch_seen, k)
-        _M_REQUESTS.labels("hot" if hot else "cold").inc(k)
+        _M_REQUESTS.labels(path).inc(k)
         _M_DISPATCH_BATCH.observe(k)
 
     @staticmethod
@@ -1316,8 +1980,28 @@ class ServingEngine:
         mesh=None,
         hot_cap: Optional[int] = None,
         compile_cache=None,
+        megabatch: Optional[bool] = None,
+        fill_window_us: Optional[int] = None,
+        megabatch_residency: Optional[int] = None,
     ):
         self.mesh = mesh
+        # cross-machine megabatching (ARCHITECTURE §15): replicated mode
+        # only; env-resolved unless the caller overrides. fill_window_us
+        # is zeroed when megabatching is off — the window is the fused
+        # path's batching aid, not a general dispatch delay.
+        if megabatch is None:
+            megabatch = _megabatch_enabled()
+        if megabatch_residency is None:
+            megabatch_residency = _megabatch_residency_cap()
+        if fill_window_us is None:
+            fill_window_us = _fill_window_us()
+        self.megabatch_residency = max(0, int(megabatch_residency))
+        self.megabatch = (
+            bool(megabatch) and mesh is None and self.megabatch_residency > 0
+        )
+        self.fill_window_us = (
+            max(0, int(fill_window_us)) if self.megabatch else 0
+        )
         # persistent compile cache (compile_cache.CompileCacheStore or
         # None = compile-on-boot): buckets consult it before JIT-compiling
         # and write AOT executables back, so a boot/reload/rollback against
@@ -1442,6 +2126,9 @@ class ServingEngine:
                 hot_cap=self.hot_cap,
                 compile_cache=compile_cache,
                 arch_sig=sig,
+                megabatch=self.megabatch,
+                fill_window_s=self.fill_window_us / 1e6,
+                mega_cap=self.megabatch_residency,
             )
             self._buckets.append(bucket)
             for i, (_, entry) in enumerate(members):
@@ -1486,7 +2173,12 @@ class ServingEngine:
             n = max(rows or 0, need, 1)
             first = bucket.names[0]
             self.anomaly(first, np.zeros((n, bucket.n_features), np.float32))
-            bucket.warmup_hot(_round_up_pow2(n, self.min_rows_bucket))
+            rows_padded = _round_up_pow2(n, self.min_rows_bucket)
+            bucket.warmup_hot(rows_padded)
+            # megabatch: a no-op when the live request above already
+            # compiled+ran the fused program (full residency), the
+            # first-promotion compile pre-payment otherwise
+            bucket.warmup_mega(rows_padded)
         return len(self._buckets)
 
     def close(self) -> None:
@@ -1606,6 +2298,8 @@ class ServingEngine:
         return self.anomaly(name, X).model_output
 
     def stats(self) -> Dict[str, Any]:
+        mega_dispatches = sum(b.mega_dispatch_count for b in self._buckets)
+        mega_requests = sum(b.mega_request_count for b in self._buckets)
         return {
             "machines": len(self._by_name),
             "buckets": len(self._buckets),
@@ -1631,6 +2325,31 @@ class ServingEngine:
             "hot_requests": sum(
                 b.hot_request_count for b in self._buckets
             ),
+            # cross-machine megabatching (ARCHITECTURE §15): residency,
+            # fusion ratio (requests per fused device dispatch), and how
+            # fill windows closed (size-triggered = a full max_batch was
+            # pending; timeout = the bounded window elapsed first)
+            "megabatch": {
+                "enabled": self.megabatch,
+                "fill_window_us": self.fill_window_us,
+                "residency_cap": self.megabatch_residency,
+                "resident_machines": sum(
+                    len(b._mega_slots) for b in self._buckets
+                ),
+                "dispatches": mega_dispatches,
+                "requests": mega_requests,
+                "fusion_ratio": (
+                    round(mega_requests / mega_dispatches, 3)
+                    if mega_dispatches
+                    else None
+                ),
+                "fill_timeout_total": sum(
+                    b.fill_timeout_count for b in self._buckets
+                ),
+                "fill_size_total": sum(
+                    b.fill_size_count for b in self._buckets
+                ),
+            },
             # persistent compile cache: this engine's store-lookup counts
             # (None = cache off, the compile-on-boot mode)
             "compile_cache": (

@@ -263,6 +263,25 @@ class _ServerState:
             # O(load) against a warmed store (ARCHITECTURE §14)
             compile_cache=compile_cache,
         )
+        # cross-machine megabatching (ARCHITECTURE §15): env-resolved in
+        # the engine (GORDO_MEGABATCH / GORDO_FILL_WINDOW_US /
+        # GORDO_MEGABATCH_RESIDENCY); logged at boot so an operator can
+        # tell from the log alone which dispatch mode a generation serves
+        # with — the fill window bounds added latency under concurrency
+        megabatch = self.engine.stats()["megabatch"]
+        if megabatch["enabled"]:
+            logger.info(
+                "Cross-machine megabatching ON: fill window %d us, "
+                "%d/%d machines resident in the stacked program(s)",
+                megabatch["fill_window_us"],
+                megabatch["resident_machines"],
+                len(self.engine.machines()),
+            )
+        else:
+            logger.info(
+                "Cross-machine megabatching off (%s)",
+                "shard mode" if shard_fleet else "disabled by config",
+            )
 
     def enter(self) -> None:
         with self._cond:

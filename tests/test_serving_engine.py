@@ -421,9 +421,12 @@ def test_long_request_chunked_scoring_parity():
     np.testing.assert_allclose(
         a.total_anomaly_score, b.total_anomaly_score, atol=1e-4
     )
-    # the chunked engine never compiled a >64-row program
+    # the chunked engine never compiled a >64-row program (program keys
+    # are (rows, k) for the cold path, ("mega"|"hot", rows, k) otherwise)
     assert all(
-        rows <= 64 for bucket in chunky._buckets for (rows, _) in bucket._programs
+        key[-2] <= 64
+        for bucket in chunky._buckets
+        for key in bucket._programs
     )
 
     # flat model: zero overlap, plain row chunks

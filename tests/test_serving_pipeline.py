@@ -168,8 +168,10 @@ def test_mid_pipeline_error_surfaces_on_exactly_its_own_waiters(
     """Three in-flight dispatches; the middle one's device-to-host fetch
     fails. Its waiter — and ONLY its waiter — sees the error; the other
     dispatches complete with correct results, and the engine keeps
-    serving afterwards."""
-    engine = _engine(monkeypatch, 4, {"p1": models["p1"]})
+    serving afterwards. Megabatch off: this pins the COLD pipeline's
+    error fan-out (the fused path instead repairs fetch failures via the
+    isolated cold retry — covered in test_megabatch.py)."""
+    engine = _engine(monkeypatch, 4, {"p1": models["p1"]}, megabatch=False)
     reference = {
         rows: _bits(engine.anomaly("p1", X)) for rows, X in requests_x.items()
     }
@@ -223,8 +225,10 @@ def test_mid_pipeline_error_surfaces_on_exactly_its_own_waiters(
 def test_enqueue_time_error_surfaces_on_waiters(monkeypatch, models):
     """A dispatch that fails at ENQUEUE (program build / launch) — before
     the collector ever sees it — must also surface on its waiters, not
-    wedge the leader latch."""
-    engine = _engine(monkeypatch, 2, {"p1": models["p1"]})
+    wedge the leader latch. Megabatch off: the fused path falls back to
+    cold on enqueue failures (covered in test_megabatch.py); this pins
+    the cold path's own surface-don't-wedge contract."""
+    engine = _engine(monkeypatch, 2, {"p1": models["p1"]}, megabatch=False)
     X = np.zeros((8, 4), np.float32)
     engine.anomaly("p1", X)  # warm
     bucket, _ = engine._by_name["p1"]

@@ -18,7 +18,11 @@ Checks (ISSUE 6 acceptance):
   disagrees (the jaxlib-bump shape) reads as *stale* with the same
   fallback;
 - **a torn cache write never wedges boot**: ``.staging-*`` debris and a
-  manifest-less half-entry in the cache root are inert.
+  manifest-less half-entry in the cache root are inert;
+- **the stacked megabatch program round-trips** (ISSUE 7): a cold boot
+  writes a ``serving-mega`` entry, the warm boot's zero-fresh-compiles
+  gate covers it, and a fleet-build ``export_serving_cache`` produces a
+  cache a fresh server boots against with zero compiles and mega hits.
 
 Exit codes: 0 = all checks passed, 1 = at least one failed.
 """
@@ -63,11 +67,28 @@ def _fresh_compiles() -> int:
     return 0
 
 
+def _entry_kinds(cache_root) -> set:
+    """The program kinds stored in a cache root (from each entry's
+    KEY.json) — how the smoke asserts WHICH executables round-tripped."""
+    import glob
+
+    from gordo_components_tpu.compile_cache.store import KEY_FILE
+
+    kinds = set()
+    for key_path in glob.glob(os.path.join(cache_root, "cc-*", KEY_FILE)):
+        try:
+            with open(key_path) as fh:
+                kinds.add(json.load(fh)["program"]["kind"])
+        except Exception:
+            pass
+    return kinds
+
+
 def warm_boot_zero_compiles(models, cache_root, X, ref_bits) -> None:
     from gordo_components_tpu.compile_cache import CompileCacheStore
     from gordo_components_tpu.server.engine import ServingEngine
 
-    print("\n[1/5] warm boot is load-not-compile (and bit-identical)")
+    print("\n[1/6] warm boot is load-not-compile (and bit-identical)")
     names = sorted(models)
     # boot 1: cold cache — pays the compiles, writes executables back
     store = CompileCacheStore(cache_root)
@@ -82,6 +103,11 @@ def warm_boot_zero_compiles(models, cache_root, X, ref_bits) -> None:
           f"cold boot wrote executables back ({store.counters['write']})")
     check(all(cold_bits[n] == ref_bits[n] for n in names),
           "cached-path scores bit-identical to the cache-less engine")
+    # the fused megabatch program (ISSUE 7) joined the cache key schema:
+    # replicated boots serve through it, so its executable must be here
+    kinds = _entry_kinds(cache_root)
+    check("serving-mega" in kinds,
+          f"cold boot cached the stacked megabatch program ({sorted(kinds)})")
 
     # boot 2: warmed cache — the acceptance gate
     store = CompileCacheStore(cache_root)
@@ -116,7 +142,7 @@ def reload_and_rollback_no_recompiles(tmp) -> None:
         rollback_generation,
     )
 
-    print("\n[2/5] /reload and rollback pay no recompiles")
+    print("\n[2/6] /reload and rollback pay no recompiles")
     models_root = os.path.join(tmp, "models")
     data_config = {
         "type": "RandomDataset",
@@ -203,7 +229,7 @@ def corruption_falls_back(models, cache_root, X, ref_bits) -> None:
     from gordo_components_tpu.compile_cache.store import EXEC_FILE, TREES_FILE
     from gordo_components_tpu.server.engine import ServingEngine
 
-    print("\n[3/5] corrupt entries fall back to JIT, bit-identical, "
+    print("\n[3/6] corrupt entries fall back to JIT, bit-identical, "
           "and self-heal")
     names = sorted(models)
     for fault, filename in (("bitflip", EXEC_FILE), ("truncate", TREES_FILE)):
@@ -245,7 +271,7 @@ def fingerprint_mismatch_falls_back(models, cache_root, X, ref_bits) -> None:
     from gordo_components_tpu.server.engine import ServingEngine
     from gordo_components_tpu.store.manifest import write_manifest
 
-    print("\n[4/5] fingerprint/key mismatch reads as stale, falls back")
+    print("\n[4/6] fingerprint/key mismatch reads as stale, falls back")
     names = sorted(models)
     store = CompileCacheStore(cache_root)
     entries = [e for e in store.entries() if e["verified"]]
@@ -276,7 +302,7 @@ def torn_writes_never_wedge(models, cache_root, X) -> None:
     from gordo_components_tpu.compile_cache import CompileCacheStore
     from gordo_components_tpu.server.engine import ServingEngine
 
-    print("\n[5/5] torn cache writes never wedge boot")
+    print("\n[5/6] torn cache writes never wedge boot")
     # crash debris: a staging dir the atomic commit never renamed in, and
     # a half-entry with no manifest (a hand-copied or torn dir)
     staging = os.path.join(cache_root, ".staging-cc-dead.beef1234")
@@ -307,6 +333,54 @@ def torn_writes_never_wedge(models, cache_root, X) -> None:
           f"purge --stale removes the debris ({removed})")
 
 
+def megabatch_export_roundtrip(models, tmp) -> None:
+    """ISSUE 7 satellite: the stacked megabatch program's cache key
+    round-trips through the fleet-build export into a server boot — a
+    warmed export means the boot compiles ZERO fresh megabatch programs
+    and serves its first fused dispatch from a loaded executable."""
+    from gordo_components_tpu.compile_cache import CompileCacheStore
+    from gordo_components_tpu.compile_cache.export import (
+        export_serving_cache,
+    )
+    from gordo_components_tpu.serializer import dump, load
+    from gordo_components_tpu.server.engine import ServingEngine
+
+    print("\n[6/6] megabatch executable round-trips export -> boot")
+    cache_root = os.path.join(tmp, "export-cache")
+    # the export path works from SAVED model dirs (the fleet-build shape)
+    model_dirs = {}
+    for name in sorted(models)[:2]:
+        model_dir = os.path.join(tmp, "export-models", name)
+        os.makedirs(model_dir, exist_ok=True)
+        dump(models[name], model_dir)
+        model_dirs[name] = model_dir
+    summary = export_serving_cache(model_dirs, cache_root)
+    check(summary["cache"].get("write", 0) > 0,
+          f"export wrote executables ({summary['cache']})")
+    kinds = _entry_kinds(cache_root)
+    check("serving-mega" in kinds,
+          f"export produced a serving-mega entry ({sorted(kinds)})")
+
+    # a fresh server boot against the exported cache: load, not compile
+    store = CompileCacheStore(cache_root)
+    before = _fresh_compiles()
+    engine = ServingEngine(
+        {name: load(path) for name, path in model_dirs.items()},
+        compile_cache=store,
+    )
+    engine.warmup()
+    boot_compiles = _fresh_compiles() - before
+    check(boot_compiles == 0,
+          f"boot against the export compiled ZERO fresh megabatch "
+          f"programs (got {boot_compiles})")
+    check(store.counters["hit"] > 0,
+          f"boot loaded from the exported cache "
+          f"({store.counters['hit']} hits)")
+    check(engine.stats()["megabatch"]["enabled"],
+          "megabatching live on the exported-cache boot")
+    engine.close()
+
+
 def main() -> int:
     import tempfile
 
@@ -330,6 +404,7 @@ def main() -> int:
         corruption_falls_back(models, cache_root, X, ref_bits)
         fingerprint_mismatch_falls_back(models, cache_root, X, ref_bits)
         torn_writes_never_wedge(models, cache_root, X)
+        megabatch_export_roundtrip(models, tmp)
     if _failures:
         print(f"\nCOLDSTART SMOKE FAILED: {len(_failures)} check(s)",
               file=sys.stderr)
