@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke smoke images builder-image server-image watchman-image
+.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke smoke images builder-image server-image watchman-image
 
 test:
 	python -m pytest tests/ -q
@@ -63,10 +63,19 @@ coldstart-smoke:
 megabatch-smoke:
 	JAX_PLATFORMS=cpu python tools/megabatch_smoke.py
 
+# horizontal serving tier check: 3 real worker processes behind the
+# router — consistent-hash placement (X-Gordo-Worker echo), SIGKILL one
+# worker mid-traffic (re-route, no 5xx burst beyond the breaker budget,
+# eject + respawn), graceful SIGTERM drain (zero dropped requests), and
+# a canary → sweep generation rollout plus fleet rollback paying zero
+# fresh XLA compiles via the shared compile-cache store
+router-smoke:
+	JAX_PLATFORMS=cpu python tools/router_smoke.py
+
 # the full smoke battery: exposition + resilience + store integrity +
 # serving data plane + span attribution + cold-start economics +
-# cross-machine megabatching
-smoke: metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke
+# cross-machine megabatching + the horizontal serving tier
+smoke: metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke
 
 images: builder-image server-image watchman-image
 

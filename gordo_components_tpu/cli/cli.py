@@ -534,10 +534,16 @@ def cache_purge_cmd(store_dir, stale_only):
                    "collects further requests before dispatching the "
                    "fused batch (core-aware default; 0 disables the "
                    "wait; idle requests never wait)")
+@click.option("--worker-id", default=None, type=int,
+              envvar="GORDO_WORKER_ID",
+              help="fleet slot id when this server runs as one worker of "
+                   "a run-fleet-server tier: responses carry "
+                   "X-Gordo-Worker and /healthz reports the id so the "
+                   "router can verify placement")
 @_TRACE_DIR_OPT
 def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
                    max_inflight, faults, compile_cache_store, megabatch,
-                   fill_window_us, trace_dir):
+                   fill_window_us, worker_id, trace_dir):
     """Serve built model(s) over REST."""
     import os
 
@@ -582,14 +588,79 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
         run_server(next(iter(resolved.values())), host=host, port=port,
                    project=project, shard_fleet=shard_fleet,
                    trace_dir=trace_dir, max_inflight=max_inflight,
-                   compile_cache_store=compile_cache_store)
+                   compile_cache_store=compile_cache_store,
+                   worker_id=worker_id)
     else:
         # models_dir servers stay reload-capable (POST /reload picks up
         # machines a fleet build adds to the tree after startup)
         run_server(resolved, host=host, port=port, project=project,
                    models_root=models_dir, shard_fleet=shard_fleet,
                    trace_dir=trace_dir, max_inflight=max_inflight,
-                   compile_cache_store=compile_cache_store)
+                   compile_cache_store=compile_cache_store,
+                   worker_id=worker_id)
+
+
+@gordo.command("run-fleet-server")
+@click.option("--models-dir", required=True,
+              help="directory whose immediate subdirs are model dirs; "
+                   "every worker serves this tree and shares its "
+                   ".compile-cache store")
+@click.option("--workers", default=2, show_default=True, type=int,
+              help="worker server processes to spawn and supervise")
+@click.option("--host", default="0.0.0.0", show_default=True,
+              help="router listen address")
+@click.option("--port", default=5555, show_default=True,
+              help="router listen port")
+@click.option("--worker-base-port", default=5600, show_default=True,
+              type=int,
+              help="worker i listens on worker-base-port + i (loopback)")
+@click.option("--project", default="project", show_default=True)
+@click.option("--replicas", default=2, show_default=True, type=int,
+              help="distinct workers serving each HOT machine (cold "
+                   "machines are pinned to exactly one, keeping its "
+                   "megabatch residency and compile cache warm there)")
+@click.option("--hot-rps", default=50.0, show_default=True, type=float,
+              help="request rate at which a machine is replicated across "
+                   "--replicas workers; 0 disables rate-based promotion")
+@click.option("--probe-interval", default=2.0, show_default=True,
+              type=float,
+              help="control-plane health-probe interval in seconds "
+                   "(each tick jittered ±10% so a large fleet never "
+                   "probes in lockstep)")
+@click.option("--megabatch/--no-megabatch", default=None,
+              help="forwarded to every worker (see run-server)")
+@click.option("--max-inflight", default=None, type=int,
+              help="per-WORKER admission bound (see run-server)")
+def run_fleet_server_cmd(models_dir, workers, host, port, worker_base_port,
+                         project, replicas, hot_rps, probe_interval,
+                         megabatch, max_inflight):
+    """Horizontal serving tier: spawn and supervise WORKERS server
+    processes over one models tree, routing /prediction traffic by
+    consistent-hash machine→worker placement. Worker health probes drive
+    breaker/quarantine-based eject + respawn; POST /reload canaries one
+    worker then sweeps the rest (rolling generation adoption), and POST
+    /rollback swaps CURRENT fleet-wide before re-adopting."""
+    from ..router import run_fleet_server
+
+    worker_args = []
+    if megabatch is not None:
+        worker_args += ["--megabatch" if megabatch else "--no-megabatch"]
+    if max_inflight is not None:
+        worker_args += ["--max-inflight", str(max_inflight)]
+    if workers < 1:
+        raise click.UsageError("--workers must be >= 1")
+    run_fleet_server(
+        models_dir,
+        workers=workers,
+        host=host,
+        port=port,
+        worker_base_port=worker_base_port,
+        project=project,
+        replicas=replicas,
+        hot_rps=hot_rps,
+        probe_interval=probe_interval,
+        worker_args=worker_args,
+    )
 
 
 @gordo.command("run-watchman")

@@ -35,6 +35,7 @@ from .. import wire
 from ..observability import flightrec, spans, tracing
 from ..observability.registry import REGISTRY
 from ..resilience import deadline
+from ..resilience.admission import DRAINING_HEADER
 from ..resilience.breaker import BreakerBoard
 from .forwarders import PredictionForwarder
 from .utils import make_date_ranges
@@ -255,10 +256,17 @@ class Client:
         ``Retry-After`` hint when it exceeds our own backoff — or None when
         the remaining budget cannot cover the wait plus one more attempt
         (retrying past the caller's deadline only produces answers nobody
-        is waiting for)."""
+        is waiting for).
+
+        ``retry_after <= 0`` means "retry NOW": the draining-worker shed
+        (``X-Gordo-Draining``) sets it — the fleet is mid-rolling-restart
+        and the router will route the retry to a live worker, so the full
+        shed backoff would only stretch the restart window."""
         delay = self._backoff_delay(attempt)
         if retry_after is not None:
-            delay = max(delay, retry_after)
+            delay = min(delay, 0.05) if retry_after <= 0 else max(
+                delay, retry_after
+            )
         left = self._budget_left(started)
         if left is not None and delay >= left:
             return None
@@ -390,6 +398,18 @@ class Client:
                                 hint = self._parse_retry_after(
                                     response.headers.get("Retry-After")
                                 )
+                                if response.status == 503 and (
+                                    response.headers.get(DRAINING_HEADER)
+                                ):
+                                    # a draining worker's shed (rolling
+                                    # restart): alive, deliberate, and
+                                    # momentary — retry NOW, the router
+                                    # re-routes to a live worker
+                                    breaker.record(True)
+                                    retry_after = 0.0
+                                    last_error = "HTTP 503 (draining)"
+                                    _M_RETRIES.labels("draining").inc()
+                                    continue
                                 # flow control from a LIVE server — a 503
                                 # shed carrying Retry-After, or a 504 for
                                 # OUR expired deadline — must not count
@@ -572,6 +592,16 @@ class Client:
                 hint = self._parse_retry_after(
                     response.headers.get("Retry-After")
                 )
+                if response.status_code == 503 and response.headers.get(
+                    DRAINING_HEADER
+                ):
+                    # same draining carve-out as the async path: retry
+                    # promptly, the rolling restart is momentary
+                    breaker.record(True)
+                    retry_after = 0.0
+                    last_error = "HTTP 503 (draining)"
+                    _M_RETRIES.labels("draining").inc()
+                    continue
                 # same live-server carve-outs as the async path: 503+hint
                 # and 504 are answers, not deaths
                 breaker.record(

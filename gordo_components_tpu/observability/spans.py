@@ -38,6 +38,8 @@ from .registry import REGISTRY
 # the canonical stage names (docs/ARCHITECTURE.md §13); stage() accepts
 # any name — this tuple is the shared vocabulary, not an enum
 STAGES = (
+    "route",           # router: placement decision + worker forward
+                       # (re-route walks included)
     "admission",       # admission-gate wait (server)
     "queue_wait",      # bucket pending queue until a leader dispatches it
     "megabatch",       # leader's bounded fill window collecting concurrent

@@ -39,6 +39,13 @@ _M_ADMISSION = REGISTRY.counter(
 )
 
 
+# response header a draining server stamps on everything it answers: the
+# router re-routes marked sheds to a live worker (and never ejects the
+# drainer), and clients retry them immediately instead of backing off —
+# the restart window is deliberate and short
+DRAINING_HEADER = "X-Gordo-Draining"
+
+
 class AdmissionRejected(Exception):
     """The gate shed this request; HTTP layers translate to 503 with
     ``Retry-After: retry_after``."""
@@ -76,6 +83,7 @@ class AdmissionController:
         self._cond = threading.Condition()
         self._inflight = 0
         self._waiting = 0
+        self._closed: Optional[str] = None
 
     # -- stats ---------------------------------------------------------------
     @property
@@ -95,7 +103,41 @@ class AdmissionController:
                 "queue_depth": self._waiting,
                 "max_inflight": self.max_inflight,
                 "max_queue": self.max_queue,
+                "closed": self._closed,
             }
+
+    # -- graceful shutdown ---------------------------------------------------
+    @property
+    def closed(self) -> Optional[str]:
+        """The close reason when the gate is draining, else None."""
+        with self._cond:
+            return self._closed
+
+    def close(self, reason: str = "shutting down") -> None:
+        """Stop admitting NEW work (every later ``admit()`` sheds
+        instantly with the reason) while in-flight requests keep their
+        slots and finish — the first step of a graceful shutdown. Queued
+        waiters are woken so they shed now instead of burning their full
+        queue timeout against a gate that can never admit them."""
+        with self._cond:
+            self._closed = reason
+            self._cond.notify_all()
+
+    def reopen(self) -> None:
+        with self._cond:
+            self._closed = None
+
+    def drain(self, timeout: float) -> bool:
+        """Wait until no admitted request remains in flight (True), or
+        ``timeout`` elapsed first (False). Meaningful after close()."""
+        end = time.monotonic() + timeout
+        with self._cond:
+            while self._inflight > 0:
+                left = end - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=left)
+        return True
 
     # -- gate ----------------------------------------------------------------
     def admit(self) -> "_Admission":
@@ -106,6 +148,9 @@ class AdmissionController:
         deadline — a waiter whose caller has given up must not keep
         holding a queue slot)."""
         with self._cond:
+            if self._closed is not None:
+                _M_ADMISSION.labels("shed_closed").inc()
+                raise AdmissionRejected(self._closed, self.retry_after)
             if self._inflight < self.max_inflight:
                 self._inflight += 1
                 _M_INFLIGHT.set(self._inflight)
@@ -132,6 +177,11 @@ class AdmissionController:
             try:
                 end = time.monotonic() + budget
                 while self._inflight >= self.max_inflight:
+                    if self._closed is not None:  # close() woke us: shed
+                        _M_ADMISSION.labels("shed_closed").inc()
+                        raise AdmissionRejected(
+                            self._closed, self.retry_after
+                        )
                     left = end - time.monotonic()
                     if left <= 0:
                         _M_ADMISSION.labels("shed_timeout").inc()
@@ -152,7 +202,10 @@ class AdmissionController:
         with self._cond:
             self._inflight -= 1
             _M_INFLIGHT.set(self._inflight)
-            self._cond.notify()
+            # notify_all, not notify: queue waiters AND a drain() caller
+            # may both be parked here — a single wake-up could land on
+            # the wrong one and strand the other past its timeout
+            self._cond.notify_all()
 
 
 class _Admission:

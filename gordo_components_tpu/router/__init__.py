@@ -1,0 +1,180 @@
+"""Horizontal serving tier: router + supervised worker processes.
+
+``placement`` — consistent-hash machine→worker assignment with
+hot-machine replication; ``workers`` — worker process lifecycle;
+``router`` — the routing WSGI front; ``rollout`` — canary→sweep
+generation adoption. The control plane driving eject/respawn lives in
+``watchman.control`` (watchman promoted from prober to control plane).
+
+``build_fleet`` / ``run_fleet_server`` assemble the whole tier the way
+``gordo run-fleet-server`` does; tests and tools reuse them with
+injected worker factories.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Iterable, Optional, Sequence
+
+from ..watchman.control import ControlPlane, jittered_interval
+from .placement import HashRing, Placement
+from .rollout import RolloutManager
+from .router import FleetRouter
+from .workers import (
+    SubprocessWorker,
+    WorkerSpec,
+    WorkerSupervisor,
+    server_worker_argv,
+    worker_specs,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ControlPlane",
+    "FleetRouter",
+    "HashRing",
+    "Placement",
+    "RolloutManager",
+    "SubprocessWorker",
+    "WorkerSpec",
+    "WorkerSupervisor",
+    "assemble_fleet",
+    "jittered_interval",
+    "run_fleet_server",
+    "server_worker_argv",
+    "worker_specs",
+]
+
+
+def assemble_fleet(
+    specs: Sequence[WorkerSpec],
+    factory: Callable[[WorkerSpec], object],
+    project: str = "project",
+    models_root: Optional[str] = None,
+    replicas: int = 2,
+    hot_rps: float = 50.0,
+    hot: Iterable[str] = (),
+    probe_timeout: float = 3.0,
+    breaker_recovery: float = 10.0,
+    respawn: bool = True,
+    boot_grace: float = 60.0,
+    forward_timeout: float = 60.0,
+) -> FleetRouter:
+    """Wire supervisor + control plane + placement + router together
+    (nothing started yet — callers own start/stop ordering)."""
+    supervisor = WorkerSupervisor(specs, factory)
+    control = ControlPlane(
+        supervisor,
+        probe_timeout=probe_timeout,
+        breaker_recovery=breaker_recovery,
+        respawn=respawn,
+        boot_grace=boot_grace,
+    )
+    placement = Placement(
+        [spec.name for spec in specs],
+        replicas=replicas,
+        hot_rps=hot_rps,
+        hot=hot,
+    )
+    return FleetRouter(
+        supervisor,
+        control,
+        placement=placement,
+        project=project,
+        models_root=models_root,
+        forward_timeout=forward_timeout,
+    )
+
+
+def run_fleet_server(
+    models_dir: str,
+    workers: int = 2,
+    host: str = "0.0.0.0",
+    port: int = 5555,
+    worker_host: str = "127.0.0.1",
+    worker_base_port: int = 5600,
+    project: str = "project",
+    replicas: int = 2,
+    hot_rps: float = 50.0,
+    probe_interval: float = 2.0,
+    ready_timeout: float = 300.0,
+    worker_args: Sequence[str] = (),
+) -> None:
+    """``gordo run-fleet-server``: spawn N worker server processes over
+    one ``models_dir`` (sharing its compile-cache store), wait for them,
+    start the control plane, and serve the router. SIGTERM shuts the
+    whole tier down: the router stops routing, then every worker gets
+    its own SIGTERM (graceful drain) before the process exits — killing
+    the router must never orphan N worker processes."""
+    import signal
+    import threading
+
+    from werkzeug.serving import make_server
+
+    specs = worker_specs(workers, worker_base_port, host=worker_host)
+
+    def factory(spec: WorkerSpec) -> SubprocessWorker:
+        return SubprocessWorker(
+            spec,
+            server_worker_argv(
+                spec, models_dir, project=project, extra=worker_args
+            ),
+        )
+
+    app = assemble_fleet(
+        specs,
+        factory,
+        project=project,
+        models_root=models_dir,
+        replicas=replicas,
+        hot_rps=hot_rps,
+    )
+    supervisor, control = app.supervisor, app.control
+    supervisor.start_all()
+    # EVERYTHING past start_all runs under the teardown guard: a router
+    # that fails to come up (port already bound, wait_ready timeout)
+    # must never exit leaving N orphaned worker processes squatting
+    # their ports
+    try:
+        ready = supervisor.wait_ready(timeout=ready_timeout)
+        if not ready:
+            raise RuntimeError(
+                f"no worker became ready within {ready_timeout:.0f}s"
+            )
+        if len(ready) < workers:
+            logger.warning(
+                "Only %d/%d workers ready; control plane will repair "
+                "the rest", len(ready), workers,
+            )
+        control.start(interval=probe_interval)
+        server = make_server(host, port, app, threaded=True)
+
+        def _on_sigterm(signum, frame) -> None:
+            logger.info("SIGTERM: shutting the fleet tier down")
+            # a thread: shutdown() must not run on the serve_forever
+            # thread
+            threading.Thread(
+                target=server.shutdown, name="gordo-router-stop",
+                daemon=True,
+            ).start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            logger.debug(
+                "SIGTERM handler not installed (non-main thread)"
+            )
+        logger.info(
+            "Fleet router serving %d worker(s) on %s:%d (workers at %s)",
+            workers, host, port,
+            ", ".join(spec.base_url for spec in specs),
+        )
+        server.serve_forever()
+    finally:
+        # control FIRST: a probe loop racing the worker teardown would
+        # read every SIGTERM'd worker as dead and respawn it
+        control.stop()
+        supervisor.stop_all()
+        app.close()
+        logger.info("Fleet tier stopped")

@@ -1,0 +1,284 @@
+"""Consistent-hash machine→worker placement with hot-machine replication.
+
+Why placement instead of round-robin: every worker process owns its own
+serving engine — device-resident stacked params, per-bucket megabatch
+residency, and a warmed program set. Spraying a machine's requests across
+all workers would cold-start that machine's residency everywhere and let
+it go stale everywhere; pinning each machine to ONE worker keeps the
+compile cache and megabatch residency warm exactly where that machine's
+traffic lands. Mesh-TensorFlow frames batch splitting as one point in a
+layout space (PAPERS.md); machine→worker assignment is the same kind of
+layout axis, one level up the serving tier.
+
+The ring is the classic consistent-hash construction (SHA-1 points,
+``vnodes`` virtual nodes per worker) with the two properties the fleet
+needs:
+
+- **deterministic** — placement is a pure function of (worker names,
+  machine name, vnodes). A restarted router computes the identical table,
+  so a restart never causes fleet-wide residency churn.
+- **bounded movement** — removing a worker moves ONLY the keys that lived
+  on it (they redistribute over the survivors); adding one steals ~1/N of
+  each incumbent's keys and moves nothing between incumbents.
+
+**Hot-machine replication**: a machine whose observed request rate
+crosses ``hot_rps`` (or that is pinned hot by config) is served by its
+first ``replicas`` distinct ring workers instead of one, with requests
+rotated among them — the single-worker ceiling must not become one hot
+machine's ceiling. Replica sets are ring prefixes, so they inherit both
+properties above.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence
+
+
+def _hash64(key: str) -> int:
+    """Stable 64-bit ring coordinate. SHA-1, not ``hash()``: Python string
+    hashing is salted per process (PYTHONHASHSEED), which would scramble
+    placement on every restart — the one property this module exists to
+    prevent."""
+    return int.from_bytes(
+        hashlib.sha1(key.encode("utf-8")).digest()[:8], "big"
+    )
+
+
+class HashRing:
+    """Sorted ring of (point, worker) pairs, ``vnodes`` points per worker.
+
+    Not thread-safe by itself; :class:`Placement` wraps mutations in its
+    own lock (ring membership changes are rare — worker eject/join — and
+    lookups dominate).
+    """
+
+    def __init__(self, workers: Iterable[str] = (), vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = int(vnodes)
+        self._points: List[int] = []
+        self._owners: List[str] = []
+        self._workers: set = set()
+        for worker in workers:
+            self.add(worker)
+
+    def _worker_points(self, worker: str) -> List[int]:
+        return [_hash64(f"{worker}#{i}") for i in range(self.vnodes)]
+
+    def add(self, worker: str) -> None:
+        if worker in self._workers:
+            return
+        self._workers.add(worker)
+        for point in self._worker_points(worker):
+            at = bisect.bisect_left(self._points, point)
+            self._points.insert(at, point)
+            self._owners.insert(at, worker)
+
+    def remove(self, worker: str) -> None:
+        if worker not in self._workers:
+            return
+        self._workers.discard(worker)
+        keep = [
+            (point, owner)
+            for point, owner in zip(self._points, self._owners)
+            if owner != worker
+        ]
+        self._points = [point for point, _ in keep]
+        self._owners = [owner for _, owner in keep]
+
+    def workers(self) -> List[str]:
+        return sorted(self._workers)
+
+    def __len__(self) -> int:
+        return len(self._workers)
+
+    def primary(self, key: str) -> Optional[str]:
+        """The worker owning ``key`` — first ring point clockwise of the
+        key's hash. None on an empty ring."""
+        owners = self.preference(key, 1)
+        return owners[0] if owners else None
+
+    def preference(self, key: str, n: int) -> List[str]:
+        """The first ``n`` DISTINCT workers clockwise of ``key``'s point —
+        the replica set, and (continued past ``n``) the failover order.
+        Fewer than ``n`` workers on the ring returns them all."""
+        if not self._points:
+            return []
+        n = min(n, len(self._workers))
+        start = bisect.bisect_right(self._points, _hash64(key))
+        found: List[str] = []
+        seen: set = set()
+        for i in range(len(self._points)):
+            owner = self._owners[(start + i) % len(self._points)]
+            if owner not in seen:
+                seen.add(owner)
+                found.append(owner)
+                if len(found) == n:
+                    break
+        return found
+
+
+class _RateWindow:
+    """Two-bucket sliding-window request-rate estimate for one machine —
+    O(1) per request, no timestamp deques (a hot machine is exactly the
+    one that would make a deque expensive)."""
+
+    __slots__ = ("window_s", "started", "count", "prev_count")
+
+    def __init__(self, window_s: float, now: float):
+        self.window_s = window_s
+        self.started = now
+        self.count = 0
+        self.prev_count = 0
+
+    def _rotate(self, now: float) -> None:
+        elapsed = now - self.started
+        if elapsed >= 2 * self.window_s:
+            self.prev_count, self.count = 0, 0
+            self.started = now
+        elif elapsed >= self.window_s:
+            self.prev_count, self.count = self.count, 0
+            self.started += self.window_s
+
+    def note(self, now: float) -> None:
+        self._rotate(now)
+        self.count += 1
+
+    def rate(self, now: float) -> float:
+        self._rotate(now)
+        frac = (now - self.started) / self.window_s
+        # weight the previous full window by how little of the current
+        # one has elapsed — the standard sliding-window approximation
+        estimate = self.prev_count * (1.0 - frac) + self.count
+        return estimate / self.window_s
+
+
+class Placement:
+    """machine → ordered worker candidates, with hot-machine replication
+    and per-machine rotation among replicas.
+
+    ``replicas``: how many distinct workers serve a HOT machine (cold
+    machines always get exactly one). ``hot_rps``: observed request rate
+    (over ``hot_window_s``) at which a machine is promoted to hot; 0
+    disables rate-based promotion. ``hot``: machines pinned hot by
+    config, regardless of rate. Demotion is automatic: a pinned-free
+    machine whose rate falls below half the threshold (hysteresis — no
+    flapping at the boundary) drops back to single-worker placement.
+    """
+
+    def __init__(
+        self,
+        workers: Iterable[str] = (),
+        vnodes: int = 64,
+        replicas: int = 2,
+        hot_rps: float = 50.0,
+        hot_window_s: float = 10.0,
+        hot: Iterable[str] = (),
+        clock=time.monotonic,
+    ):
+        self.ring = HashRing(workers, vnodes=vnodes)
+        self.replicas = max(1, int(replicas))
+        self.hot_rps = float(hot_rps)
+        self.hot_window_s = float(hot_window_s)
+        self._pinned_hot = set(hot)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._rates: Dict[str, _RateWindow] = {}
+        self._hot: set = set(self._pinned_hot)
+        self._rotation: Dict[str, int] = {}
+
+    # -- membership ----------------------------------------------------------
+    def add_worker(self, worker: str) -> None:
+        with self._lock:
+            self.ring.add(worker)
+
+    def remove_worker(self, worker: str) -> None:
+        with self._lock:
+            self.ring.remove(worker)
+
+    def workers(self) -> List[str]:
+        with self._lock:
+            return self.ring.workers()
+
+    # -- hot tracking --------------------------------------------------------
+    def note_request(self, machine: str) -> None:
+        """Count one routed request toward ``machine``'s rate window and
+        re-evaluate its hot/cold standing."""
+        if self.hot_rps <= 0 and machine not in self._pinned_hot:
+            return
+        now = self._clock()
+        with self._lock:
+            window = self._rates.get(machine)
+            if window is None:
+                window = self._rates[machine] = _RateWindow(
+                    self.hot_window_s, now
+                )
+            window.note(now)
+            if self.hot_rps <= 0:
+                return
+            rate = window.rate(now)
+            if rate >= self.hot_rps:
+                self._hot.add(machine)
+            elif (
+                machine in self._hot
+                and machine not in self._pinned_hot
+                and rate < self.hot_rps / 2.0
+            ):
+                self._hot.discard(machine)
+
+    def is_hot(self, machine: str) -> bool:
+        with self._lock:
+            return machine in self._hot
+
+    def hot_machines(self) -> List[str]:
+        with self._lock:
+            return sorted(self._hot)
+
+    # -- placement -----------------------------------------------------------
+    def candidates(self, machine: str) -> List[str]:
+        """Ordered candidate workers for ``machine``: its replica set
+        (rotated per-machine so a hot machine's load spreads over its
+        replicas) followed by every remaining ring worker in preference
+        order — the failover tail a router walks when candidates are dead
+        or draining."""
+        with self._lock:
+            n_replicas = (
+                self.replicas if machine in self._hot else 1
+            )
+            order = self.ring.preference(machine, len(self.ring) or 1)
+            if not order:
+                return []
+            replica_set = order[:n_replicas]
+            tail = order[n_replicas:]
+            if len(replica_set) > 1:
+                turn = self._rotation.get(machine, 0)
+                self._rotation[machine] = (turn + 1) % len(replica_set)
+                replica_set = (
+                    replica_set[turn:] + replica_set[:turn]
+                )
+            return replica_set + tail
+
+    def replica_set(self, machine: str) -> List[str]:
+        """The UNROTATED replica set (stable view for status/tests)."""
+        with self._lock:
+            n = self.replicas if machine in self._hot else 1
+            return self.ring.preference(machine, n)
+
+    def table(self, machines: Sequence[str]) -> Dict[str, List[str]]:
+        """Deterministic placement table for a machine list — the
+        operator view ``/router/status`` serves (rotation-free)."""
+        return {machine: self.replica_set(machine) for machine in machines}
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "workers": self.ring.workers(),
+                "vnodes": self.ring.vnodes,
+                "replicas": self.replicas,
+                "hot_rps": self.hot_rps,
+                "hot_machines": sorted(self._hot),
+            }

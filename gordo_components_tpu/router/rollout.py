@@ -1,0 +1,279 @@
+"""Rolling generation adoption: canary one worker, verify, sweep.
+
+Every worker serves the same ``models_root`` tree, whose machines are
+``gen-NNNN/`` generation roots behind an atomically-swapped ``CURRENT``
+pointer (store/). A new generation (fleet rebuild, single-machine
+rebuild) is therefore ALREADY on disk everywhere the moment it commits —
+adoption is just each worker's ``POST /reload``, and the compile cache
+shared through the same tree makes each adoption O(load), zero fresh XLA
+compiles.
+
+The rollout contract:
+
+- **canary** — exactly one worker reloads first. If its reload errors or
+  it stops answering ready afterwards, the rollout ABORTS: the other
+  workers never reloaded, so the fleet keeps serving the old generation
+  (minus one canary the control plane will notice and repair). A bad
+  build costs one worker, never the fleet.
+- **sweep** — after the canary verifies, the remaining workers reload
+  one at a time. Sequential on purpose: at any instant at most one
+  worker is paying its reload, so fleet capacity never dips by more than
+  1/N, and a mid-sweep failure leaves a named, bounded set of workers on
+  each generation (reported per worker, repairable by re-POSTing).
+- **rollback** — ``CURRENT`` is swapped back once per machine root on
+  shared disk BEFORE any worker reloads: the pointer swap is atomic
+  fleet-wide (no worker can adopt the bad generation after it), and the
+  same canary→sweep adoption walks the fleet onto the restored one.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+from ..observability.registry import REGISTRY
+
+_M_ROLLOUTS = REGISTRY.counter(
+    "gordo_router_rollouts_total",
+    "Rolling generation adoptions, by kind (reload / rollback) and "
+    "outcome (complete / partial / aborted / no_workers)",
+    labels=("kind", "outcome"),
+)
+
+
+class RolloutManager:
+    """Canary → verify → sweep over a supervisor's workers.
+
+    ``verify_timeout`` bounds how long the canary gets to answer ready
+    after its reload before the rollout is aborted (a reload that wedged
+    the worker must not be swept fleet-wide)."""
+
+    def __init__(
+        self,
+        supervisor,
+        control,
+        session=None,
+        models_root: Optional[str] = None,
+        reload_timeout: float = 300.0,
+        verify_timeout: float = 30.0,
+    ):
+        self.supervisor = supervisor
+        self.control = control
+        self.models_root = models_root
+        self.reload_timeout = reload_timeout
+        self.verify_timeout = verify_timeout
+        if session is None:
+            import requests
+
+            session = requests.Session()
+        self._session = session
+        self._lock = threading.Lock()
+        # at most ONE rollout/rollback at a time: the capacity contract
+        # ("never dips more than 1/N") and the generation bookkeeping
+        # both assume the sweep is the only reload traffic — a second
+        # concurrent POST must answer "busy", not interleave
+        self._op_lock = threading.Lock()
+        self._last: Optional[Dict[str, Any]] = None
+
+    # -- worker verbs --------------------------------------------------------
+    def _reload_worker(self, name: str) -> Dict[str, Any]:
+        import requests
+
+        spec = self.supervisor.specs[name]
+        try:
+            response = self._session.post(
+                f"{spec.base_url}/reload", timeout=self.reload_timeout
+            )
+        except requests.RequestException as exc:
+            return {"ok": False, "error": repr(exc)}
+        body: Dict[str, Any] = {}
+        try:
+            parsed = response.json()
+            if isinstance(parsed, dict):
+                body = parsed
+        except ValueError:
+            pass
+        if response.status_code != 200:
+            return {
+                "ok": False,
+                "error": f"HTTP {response.status_code}: "
+                         f"{body.get('error', '')}",
+            }
+        return {"ok": True, "reload": body}
+
+    def _verify_worker(self, name: str) -> Dict[str, Any]:
+        """Post-reload verification: the worker must answer ``/healthz``
+        ready within ``verify_timeout``. Degraded-but-ready passes (a
+        pre-existing quarantined machine must not veto a fleet rollout);
+        not answering, or ready:false, fails."""
+        import requests
+
+        spec = self.supervisor.specs[name]
+        end = time.monotonic() + self.verify_timeout
+        last_error = "verify window empty"
+        while time.monotonic() < end:
+            try:
+                response = self._session.get(
+                    f"{spec.base_url}/healthz", timeout=5.0
+                )
+                body = response.json()
+                if response.status_code == 200 and body.get("ready"):
+                    return {
+                        "ok": True,
+                        "generations": (body.get("store") or {}).get(
+                            "generations"
+                        ),
+                    }
+                last_error = f"HTTP {response.status_code}: " \
+                             f"status={body.get('status')!r}"
+            except (requests.RequestException, ValueError) as exc:
+                last_error = repr(exc)
+            time.sleep(0.2)
+        return {"ok": False, "error": last_error}
+
+    def _routable_workers(self) -> List[str]:
+        return [
+            name
+            for name in sorted(self.supervisor.specs)
+            if self.control.routable(name)
+        ]
+
+    # -- rolling adoption ----------------------------------------------------
+    def rolling_reload(self, kind: str = "reload") -> Dict[str, Any]:
+        """Canary one routable worker's ``/reload``, verify it, sweep the
+        rest sequentially. Returns the per-worker outcome map; sets
+        ``aborted`` when the canary failed and the sweep never ran.
+        Concurrent rollouts are refused (``busy``), never interleaved —
+        two sweeps running at once would reload several workers
+        simultaneously and split the fleet across generations."""
+        if not self._op_lock.acquire(blocking=False):
+            _M_ROLLOUTS.labels(kind, "busy").inc()
+            return {
+                "kind": kind,
+                "aborted": True,
+                "error": "a rollout is already in progress",
+                "busy": True,
+            }
+        try:
+            return self._rolling_reload_locked(kind)
+        finally:
+            self._op_lock.release()
+
+    def _rolling_reload_locked(self, kind: str) -> Dict[str, Any]:
+        workers = self._routable_workers()
+        result: Dict[str, Any] = {
+            "kind": kind,
+            "at": time.strftime("%Y-%m-%d %H:%M:%S%z"),
+            "workers": {},
+            "aborted": False,
+        }
+        if not workers:
+            result["aborted"] = True
+            result["error"] = "no routable workers"
+            _M_ROLLOUTS.labels(kind, "no_workers").inc()
+            return self._finish(result)
+        canary, rest = workers[0], workers[1:]
+        result["canary"] = canary
+        reloaded = self._reload_worker(canary)
+        if reloaded["ok"]:
+            verified = self._verify_worker(canary)
+            reloaded["verified"] = verified
+            reloaded["ok"] = verified["ok"]
+        result["workers"][canary] = reloaded
+        if not reloaded["ok"]:
+            # the canary caught it: the sweep never runs, the fleet keeps
+            # serving the old generation. The canary itself is left to the
+            # control plane (a wedged reload reads as unreachable and gets
+            # the worker ejected + respawned against the on-disk CURRENT).
+            result["aborted"] = True
+            result["error"] = (
+                f"canary {canary} failed: "
+                f"{reloaded.get('error') or reloaded.get('verified')}"
+            )
+            logger.warning("Rollout aborted: %s", result["error"])
+            _M_ROLLOUTS.labels(kind, "aborted").inc()
+            return self._finish(result)
+        failures = 0
+        for name in rest:
+            swept = self._reload_worker(name)
+            if swept["ok"]:
+                verified = self._verify_worker(name)
+                swept["verified"] = verified
+                swept["ok"] = verified["ok"]
+            if not swept["ok"]:
+                # a sweep failure is NOT an abort: the generation is
+                # already proven by the canary, so keep walking — the
+                # failed worker is named in the result and the control
+                # plane repairs it (respawn adopts CURRENT at boot)
+                failures += 1
+                logger.warning(
+                    "Rollout sweep: worker %s failed (%s)",
+                    name, swept.get("error"),
+                )
+            result["workers"][name] = swept
+        outcome = "partial" if failures else "complete"
+        result["failures"] = failures
+        _M_ROLLOUTS.labels(kind, outcome).inc()
+        logger.info(
+            "Rollout %s %s: canary %s, %d swept, %d failed",
+            kind, outcome, canary, len(rest) - failures, failures,
+        )
+        return self._finish(result)
+
+    # -- fleet-wide rollback -------------------------------------------------
+    def rollback(self) -> Dict[str, Any]:
+        """Swap every machine root's ``CURRENT`` back one verified
+        generation (one atomic pointer swap per machine, all on shared
+        disk, BEFORE any worker reloads), then adopt via the same
+        canary→sweep. Machines without a previous verified generation are
+        reported and skipped — a partially-rollback-able fleet rolls back
+        what it can, loudly."""
+        from ..server.server import scan_models_root
+        from ..store import StoreError, rollback_generation
+        from ..store.generations import is_generation_root
+
+        if not self.models_root:
+            raise ValueError("rollback requires a models_root")
+        # the op lock covers the CURRENT swaps AND the adoption: a
+        # /reload racing the swaps could adopt a half-rolled-back tree
+        if not self._op_lock.acquire(blocking=False):
+            _M_ROLLOUTS.labels("rollback", "busy").inc()
+            return {
+                "kind": "rollback",
+                "aborted": True,
+                "error": "a rollout is already in progress",
+                "busy": True,
+            }
+        try:
+            restored: Dict[str, str] = {}
+            skipped: Dict[str, str] = {}
+            for name, path in sorted(
+                scan_models_root(self.models_root).items()
+            ):
+                if not is_generation_root(path):
+                    skipped[name] = "flat (pre-generation) artifact"
+                    continue
+                try:
+                    restored[name] = rollback_generation(path)
+                except StoreError as exc:
+                    skipped[name] = str(exc)
+            result = self._rolling_reload_locked(kind="rollback")
+            result["restored"] = restored
+            result["skipped"] = skipped
+            return self._finish(result)
+        finally:
+            self._op_lock.release()
+
+    # -- state ---------------------------------------------------------------
+    def _finish(self, result: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            self._last = result
+        return result
+
+    def last(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._last

@@ -1,0 +1,473 @@
+"""The routing front tier: one WSGI app in front of N worker processes.
+
+Every layer below this one — pipelined engine, megabatching, compile
+cache — lives inside ONE GIL-bound Python process. The router breaks
+that ceiling horizontally: it supervises N full server processes
+(``workers.py``) and forwards ``/prediction`` · ``/anomaly`` traffic by
+consistent-hash machine→worker placement (``placement.py``), so each
+machine's requests land on the worker whose megabatch residency and
+compile cache are already warm for it. Hot machines replicate across
+``replicas`` workers (requests rotate among them); everything else is
+pinned to exactly one.
+
+Failure handling is re-route, not error: a candidate that is dead,
+quarantined, circuit-open, or draining is skipped; a forward that fails
+at transport level (or lands on a draining worker's shed) moves to the
+next worker in the machine's ring preference order. The breaker board
+and quarantine ledger are SHARED with the control plane
+(``watchman.control``), so probe failures and routing failures feed the
+same circuits, and an ejected worker stops receiving traffic within one
+decision, not one probe cycle.
+
+Rolling generation adoption rides ``POST /reload``: canary one worker,
+verify it, sweep the rest (``rollout.py``); ``POST /rollback`` swaps
+every machine's ``CURRENT`` pointer once on shared disk — atomic
+fleet-wide — then runs the same canary→sweep adoption.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from werkzeug.routing import Map, Rule
+from werkzeug.wrappers import Request, Response
+
+from ..observability import exposition, flightrec, spans, tracing
+from ..observability.registry import REGISTRY
+from ..watchman.control import DRAINING_HEADER, ControlPlane
+from .placement import Placement
+from .rollout import RolloutManager
+from .workers import WorkerSupervisor
+
+logger = logging.getLogger(__name__)
+
+_M_ROUTED = REGISTRY.counter(
+    "gordo_router_requests_total",
+    "Requests routed, by worker and outcome (ok = forwarded and "
+    "answered; reroute = transport failure, moved to the next worker; "
+    "drained = worker shed while draining, moved on; skipped = candidate "
+    "not routable; short_circuit = worker circuit open)",
+    labels=("worker", "outcome"),
+)
+_M_FORWARD_SECONDS = REGISTRY.histogram(
+    "gordo_router_forward_seconds",
+    "Router→worker forward round-trip latency, by worker",
+    labels=("worker",),
+)
+_M_UNROUTABLE = REGISTRY.counter(
+    "gordo_router_unroutable_total",
+    "Requests that exhausted every worker candidate (answered 503)",
+)
+
+# end-to-end headers the worker's answer owns; everything hop-by-hop or
+# recomputed by werkzeug is dropped on the way back through the router
+_PASS_RESPONSE_HEADERS = (
+    "Content-Type",
+    "Retry-After",
+    DRAINING_HEADER,
+    "X-Gordo-Worker",
+)
+_DROP_FORWARD_HEADERS = frozenset(
+    ("host", "connection", "keep-alive", "content-length",
+     "transfer-encoding", "upgrade", "te", "trailer", "proxy-authorization")
+)
+
+_URL_MAP = Map(
+    [
+        Rule("/healthz", endpoint="healthz"),
+        Rule("/metrics", endpoint="metrics"),
+        Rule("/models", endpoint="models"),
+        Rule("/reload", endpoint="reload"),
+        Rule("/rollback", endpoint="rollback"),
+        Rule("/router/status", endpoint="status"),
+        Rule("/prediction", endpoint="score"),
+        Rule("/anomaly/prediction", endpoint="score"),
+        Rule("/gordo/v0/<project>/<machine>/<path:rest>", endpoint="machine"),
+    ]
+)
+
+
+class FleetRouter:
+    """WSGI app: consistent-hash routing over supervised workers.
+
+    ``supervisor`` owns the processes, ``control`` owns their health
+    (breakers + quarantine, shared here for routing decisions),
+    ``placement`` owns machine→worker assignment, ``rollout`` owns
+    generation adoption. ``models_root`` (the tree every worker serves)
+    anchors fleet-wide rollback.
+    """
+
+    def __init__(
+        self,
+        supervisor: WorkerSupervisor,
+        control: ControlPlane,
+        placement: Optional[Placement] = None,
+        project: str = "project",
+        models_root: Optional[str] = None,
+        forward_timeout: float = 60.0,
+        retry_after: float = 1.0,
+    ):
+        self.supervisor = supervisor
+        self.control = control
+        self.placement = placement or Placement(sorted(supervisor.specs))
+        self.project = project
+        self.models_root = models_root
+        self.forward_timeout = forward_timeout
+        self.retry_after = retry_after
+        import requests
+
+        # ONE pooled session for every forward: keep-alive connections to
+        # each worker survive across requests (a per-request session would
+        # pay a TCP handshake per score)
+        self._session = requests.Session()
+        self.rollout = RolloutManager(
+            supervisor,
+            control,
+            session=self._session,
+            models_root=models_root,
+        )
+        self._models_cache: Optional[List[str]] = None
+        self._models_lock = threading.Lock()
+        tracing.install_log_record_factory()
+
+    # -- WSGI ----------------------------------------------------------------
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        started = time.perf_counter()
+        trace_id = (
+            request.headers.get(tracing.TRACE_HEADER) or tracing.new_trace_id()
+        )
+        token = tracing.set_trace_id(trace_id)
+        timeline = None
+        timeline_token = None
+        if flightrec.RECORDER.enabled:
+            timeline, timeline_token = spans.begin(
+                trace_id, method=request.method, path=request.path
+            )
+        adapter = _URL_MAP.bind_to_environ(environ)
+        try:
+            try:
+                endpoint, args = adapter.match()
+                response = self._dispatch(request, endpoint, args)
+            except Exception as exc:
+                from werkzeug.exceptions import HTTPException
+
+                if isinstance(exc, HTTPException):
+                    response = exc.get_response(environ)
+                else:
+                    logger.exception("Router error on %s", request.path)
+                    response = _json({"error": str(exc)}, status=500)
+            response.headers[tracing.TRACE_HEADER] = trace_id
+            if timeline is not None:
+                status = response.status_code
+                timeline.meta["endpoint"] = request.path
+                timeline.finish(
+                    status=str(status),
+                    error=f"HTTP {status}" if status >= 500 else "",
+                )
+                if request.path not in ("/healthz", "/metrics"):
+                    flightrec.RECORDER.record(timeline)
+            logger.log(
+                logging.DEBUG
+                if request.path in ("/healthz", "/metrics")
+                else logging.INFO,
+                "%s %s -> %d in %.1f ms [trace=%s]",
+                request.method,
+                request.path,
+                response.status_code,
+                (time.perf_counter() - started) * 1000,
+                trace_id,
+            )
+        finally:
+            if timeline_token is not None:
+                spans.end(timeline_token)
+            tracing.reset_trace_id(token)
+        return response(environ, start_response)
+
+    # -- endpoints -----------------------------------------------------------
+    def _dispatch(self, request: Request, endpoint: str, args) -> Response:
+        if endpoint == "healthz":
+            return self._healthz()
+        if endpoint == "metrics":
+            if request.args.get("format") == "prometheus":
+                return Response(
+                    exposition.render_prometheus(REGISTRY),
+                    content_type=exposition.CONTENT_TYPE,
+                )
+            return _json(
+                {
+                    "router": self._router_stats(),
+                    "registry": REGISTRY.snapshot(),
+                }
+            )
+        if endpoint == "status":
+            return _json(self._status())
+        if endpoint == "models":
+            machines = self._machines(refresh=True)
+            if machines is None:
+                return self._unroutable("no worker could list models")
+            return _json({"project": self.project, "models": machines})
+        if endpoint == "reload":
+            if request.method != "POST":
+                return _json({"error": "POST required"}, status=405)
+            return _json(self.rollout.rolling_reload())
+        if endpoint == "rollback":
+            if request.method != "POST":
+                return _json({"error": "POST required"}, status=405)
+            if not self.models_root:
+                return _json(
+                    {"error": "router started without a models_root; "
+                              "fleet rollback has nothing to swap"},
+                    status=422,
+                )
+            return _json(self.rollout.rollback())
+        if endpoint == "score":
+            # bare single-model paths: routable only when the fleet serves
+            # exactly one machine (parity with the server's single mode)
+            machines = self._machines()
+            if machines is not None and len(machines) == 1:
+                return self._route(
+                    request,
+                    machines[0],
+                    f"/gordo/v0/{self.project}/{machines[0]}"
+                    f"{request.full_path.rstrip('?')}",
+                )
+            return _json(
+                {
+                    "error": "multiple models served; use "
+                             "/gordo/v0/<project>/<machine>/<endpoint>"
+                },
+                status=404,
+            )
+        # machine-scoped: /gordo/v0/<project>/<machine>/<rest>
+        if args.get("project") != self.project:
+            return _json(
+                {"error": f"Unknown project {args.get('project')!r}"},
+                status=404,
+            )
+        machine = args["machine"]
+        return self._route(request, machine, request.full_path.rstrip("?"))
+
+    # -- routing core --------------------------------------------------------
+    def _route(self, request: Request, machine: str, path: str) -> Response:
+        """Forward to the machine's placed worker, walking the failover
+        order on dead/draining/unreachable candidates. The whole decision
+        + forward is the timeline's ``route`` stage."""
+        self.placement.note_request(machine)
+        body = request.get_data()
+        headers = {
+            key: value
+            for key, value in request.headers.items()
+            if key.lower() not in _DROP_FORWARD_HEADERS
+        }
+        headers[tracing.TRACE_HEADER] = tracing.get_trace_id()
+        with spans.stage(
+            "route", machine=machine, hot=self.placement.is_hot(machine)
+        ):
+            candidates = self.placement.candidates(machine)
+            # TWO sweeps over the candidates before giving up: the ways
+            # every worker can fail at once (one draining + one mid-boot
+            # + a stale pooled connection on the survivor) are transient
+            # at the tens-of-milliseconds scale, so one short-delayed
+            # re-walk converts a client-visible 503 into a served
+            # request. Bounded: at most ~50ms extra, only on the path
+            # that would otherwise fail outright.
+            for sweep in range(2):
+                if sweep:
+                    time.sleep(0.05)
+                for worker_name in candidates:
+                    if not self.control.routable(worker_name):
+                        _M_ROUTED.labels(worker_name, "skipped").inc()
+                        continue
+                    breaker = self.control.breakers.get(worker_name)
+                    if not breaker.allow():
+                        _M_ROUTED.labels(
+                            worker_name, "short_circuit"
+                        ).inc()
+                        continue
+                    response = self._forward(
+                        worker_name, request.method, path, body, headers,
+                        breaker,
+                    )
+                    if response is not None:
+                        spans.event("routed", worker=worker_name)
+                        return response
+        _M_UNROUTABLE.inc()
+        return self._unroutable(
+            f"no routable worker for machine {machine!r} "
+            f"(candidates: {candidates})"
+        )
+
+    def _forward(
+        self, worker_name: str, method: str, path: str, body: bytes,
+        headers: Dict[str, str], breaker,
+    ) -> Optional[Response]:
+        """One forward attempt; None = move to the next candidate."""
+        import requests
+
+        spec = self.supervisor.specs[worker_name]
+        url = f"{spec.base_url}{path}"
+        started = time.perf_counter()
+        upstream = None
+        for retry in (False, True):
+            try:
+                upstream = self._session.request(
+                    method, url, data=body, headers=headers,
+                    timeout=self.forward_timeout,
+                )
+                break
+            except requests.RequestException as exc:
+                if not retry:
+                    # first failure is retried ONCE against the SAME
+                    # worker on a fresh connection: a stale pooled
+                    # keep-alive connection resets exactly like a dead
+                    # worker, and mis-reading it would both ding the
+                    # breaker and churn placement. Scoring POSTs are
+                    # idempotent, so the replay is safe.
+                    continue
+                # transport failure for real: feeds the SAME circuit the
+                # control plane's probes use, then the request moves on —
+                # re-route, not error.
+                breaker.record(False)
+                _M_ROUTED.labels(worker_name, "reroute").inc()
+                logger.warning(
+                    "Forward to %s failed (%r); re-routing",
+                    worker_name, exc,
+                )
+                return None
+        _M_FORWARD_SECONDS.labels(worker_name).observe(
+            time.perf_counter() - started
+        )
+        if upstream.status_code == 503 and upstream.headers.get(
+            DRAINING_HEADER
+        ):
+            # the worker is mid-drain (rolling restart): it answered — the
+            # circuit stays closed — but this request must land elsewhere
+            breaker.record(True)
+            _M_ROUTED.labels(worker_name, "drained").inc()
+            return None
+        breaker.record(True)
+        _M_ROUTED.labels(worker_name, "ok").inc()
+        response = Response(
+            upstream.content, status=upstream.status_code
+        )
+        for key in _PASS_RESPONSE_HEADERS:
+            if key in upstream.headers:
+                response.headers[key] = upstream.headers[key]
+        return response
+
+    # -- views ---------------------------------------------------------------
+    def _healthz(self) -> Response:
+        workers = {}
+        ready = 0
+        for name in sorted(self.supervisor.specs):
+            routable = self.control.routable(name)
+            last = self.control.last_probe(name)
+            workers[name] = {
+                "alive": self.supervisor.alive(name),
+                "routable": routable,
+                "state": (last or {}).get("state"),
+                "circuit": self.control.breakers.get(name).state,
+            }
+            if routable:
+                ready += 1
+        ok = ready > 0
+        return _json(
+            {
+                "ok": ok and ready == len(self.supervisor.specs),
+                "status": (
+                    "ok" if ready == len(self.supervisor.specs)
+                    else ("degraded" if ok else "down")
+                ),
+                "live": True,
+                "ready": ok,
+                "workers": workers,
+            },
+            status=200 if ok else 503,
+        )
+
+    def _router_stats(self) -> Dict[str, Any]:
+        return {
+            "project": self.project,
+            "workers": {
+                name: {
+                    "base_url": spec.base_url,
+                    "alive": self.supervisor.alive(name),
+                    "routable": self.control.routable(name),
+                }
+                for name, spec in sorted(self.supervisor.specs.items())
+            },
+            "placement": self.placement.stats(),
+            "respawns": self.supervisor.respawn_counts(),
+        }
+
+    def _status(self) -> Dict[str, Any]:
+        machines = self._machines() or []
+        return {
+            "project": self.project,
+            "control": self.control.status(),
+            "placement": self.placement.stats(),
+            "table": self.placement.table(machines),
+            "rollout": self.rollout.last(),
+        }
+
+    def _machines(self, refresh: bool = False) -> Optional[List[str]]:
+        """The fleet's machine list, proxied from the first routable
+        worker and cached (every worker serves the same tree)."""
+        with self._models_lock:
+            if self._models_cache is not None and not refresh:
+                return self._models_cache
+        import requests
+
+        for name in sorted(self.supervisor.specs):
+            if not self.control.routable(name):
+                continue
+            spec = self.supervisor.specs[name]
+            try:
+                response = self._session.get(
+                    f"{spec.base_url}/models", timeout=5.0
+                )
+                if response.status_code != 200:
+                    continue
+                models = response.json().get("models")
+            except (requests.RequestException, ValueError):
+                continue
+            if isinstance(models, list):
+                with self._models_lock:
+                    self._models_cache = sorted(models)
+                    return self._models_cache
+        with self._models_lock:
+            return self._models_cache
+
+    def _unroutable(self, message: str) -> Response:
+        return _json(
+            {"error": message},
+            status=503,
+            headers={"Retry-After": str(max(1, math.ceil(self.retry_after)))},
+        )
+
+    def close(self) -> None:
+        try:
+            self._session.close()
+        except Exception:
+            pass
+
+
+def _json(
+    payload: Dict[str, Any],
+    status: int = 200,
+    headers: Optional[Dict[str, str]] = None,
+) -> Response:
+    response = Response(
+        json.dumps(payload, default=str),
+        status=status,
+        mimetype="application/json",
+    )
+    for key, value in (headers or {}).items():
+        response.headers[key] = value
+    return response

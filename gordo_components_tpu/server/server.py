@@ -59,7 +59,11 @@ from ..models.anomaly.base import AnomalyDetectorBase
 from ..observability import exposition, flightrec, spans, tracing
 from ..observability.registry import REGISTRY
 from ..resilience import deadline, faults
-from ..resilience.admission import AdmissionController, AdmissionRejected
+from ..resilience.admission import (
+    DRAINING_HEADER,
+    AdmissionController,
+    AdmissionRejected,
+)
 from ..resilience.deadline import DeadlineExceeded
 from ..resilience.quarantine import Quarantine
 from ..serializer import dumps as serializer_dumps
@@ -323,6 +327,7 @@ class ModelServer:
         quarantine_cooldown: float = 30.0,
         drain_timeout: float = 10.0,
         compile_cache_store: Optional[str] = None,
+        worker_id: Optional[int] = None,
     ):
         """``models_root``: optional directory whose immediate subdirs are
         model dirs; enables ``POST /reload`` so machines built AFTER server
@@ -344,8 +349,19 @@ class ModelServer:
         the same root a fleet build exports into, so first boot is
         already warm. Single-dir servers without the env var run with
         the cache off (nothing anchors a sensible root).
+
+        ``worker_id``: this process's slot in a horizontal fleet (see
+        ``router/``). Default: the ``GORDO_WORKER_ID`` env var, else
+        standalone. Workers stamp every response ``X-Gordo-Worker`` and
+        report the id on ``/healthz`` so the router (and its smoke
+        tests) can verify WHICH process answered.
         """
         from ..compile_cache import resolve_store
+
+        if worker_id is None:
+            raw_worker = os.environ.get("GORDO_WORKER_ID")
+            worker_id = int(raw_worker) if raw_worker else None
+        self.worker_id = worker_id
 
         self.shard_fleet = shard_fleet
         self.compile_cache = resolve_store(
@@ -576,6 +592,36 @@ class ModelServer:
         except Exception:  # warm-up is best-effort; scoring still compiles
             logger.warning("Post-reload engine warm-up failed", exc_info=True)
 
+    def quiesce(self, drain_timeout: Optional[float] = None) -> bool:
+        """Graceful-shutdown sequence (SIGTERM → here → exit): close the
+        admission gate (new requests shed instantly, stamped with the
+        draining marker so a router re-routes them), wait for every
+        in-flight request to finish, then drain the engine's dispatch
+        pipeline. After this returns, killing the process drops ZERO
+        accepted requests. Returns False when the drain timed out (the
+        process exits anyway; stragglers are logged)."""
+        if drain_timeout is None:
+            drain_timeout = self.drain_timeout
+        self.admission.close("draining for shutdown")
+        logger.info(
+            "Draining: admission closed; waiting up to %.1fs for "
+            "in-flight requests", drain_timeout,
+        )
+        state = self._state
+        drained = state.drain(drain_timeout)
+        if not drained:
+            logger.warning(
+                "Drain timed out after %.1fs with requests still in "
+                "flight; shutting down anyway", drain_timeout,
+            )
+        try:
+            state.engine.quiesce()
+        except Exception:
+            logger.warning("Engine quiesce failed during shutdown",
+                           exc_info=True)
+        logger.info("Drain complete (clean=%s)", drained)
+        return drained
+
     # -- dispatch ------------------------------------------------------------
     def __call__(self, environ, start_response):
         request = Request(environ)
@@ -638,6 +684,16 @@ class ModelServer:
                     )
                 endpoint = "error"
             response.headers[tracing.TRACE_HEADER] = trace_id
+            if self.worker_id is not None:
+                # which fleet slot answered — the router's routing smoke
+                # (and any operator curl) verifies placement with this
+                response.headers["X-Gordo-Worker"] = str(self.worker_id)
+            if self.admission.closed is not None:
+                # draining marker on EVERYTHING this server still answers
+                # (sheds and healthz alike): the router re-routes marked
+                # 503s instead of erroring, and the control plane routes
+                # around the drainer without ejecting it
+                response.headers[DRAINING_HEADER] = "1"
             elapsed = time.perf_counter() - started
             _M_REQUEST_SECONDS.labels(endpoint).observe(elapsed)
             _M_REQUESTS.labels(endpoint, str(response.status_code)).inc()
@@ -747,14 +803,19 @@ class ModelServer:
             # operators read WHO is sick and why
             quarantined = self.quarantine.quarantined()
             suspects = self.quarantine.suspects()
-            ready = len(state.machines) > 0
+            draining = self.admission.closed is not None
+            ready = len(state.machines) > 0 and not draining
             degraded = bool(quarantined or suspects)
             return _json(
                 {
                     "ok": ready and not degraded,
-                    "status": "degraded" if degraded else "ok",
+                    "status": (
+                        "draining" if draining
+                        else ("degraded" if degraded else "ok")
+                    ),
                     "live": True,
                     "ready": ready,
+                    "worker_id": self.worker_id,
                     "quarantined": quarantined,
                     "suspect": suspects,
                     # artifact-integrity facet: every served machine passed
@@ -1233,6 +1294,7 @@ def build_app(
     max_inflight: Optional[int] = None,
     quarantine_cooldown: float = 30.0,
     compile_cache_store: Optional[str] = None,
+    worker_id: Optional[int] = None,
 ) -> ModelServer:
     """App factory (reference: ``server.build_app``)."""
     return ModelServer(
@@ -1240,6 +1302,7 @@ def build_app(
         shard_fleet=shard_fleet, max_inflight=max_inflight,
         quarantine_cooldown=quarantine_cooldown,
         compile_cache_store=compile_cache_store,
+        worker_id=worker_id,
     )
 
 
@@ -1253,6 +1316,7 @@ def run_server(
     trace_dir: Optional[str] = None,
     max_inflight: Optional[int] = None,
     compile_cache_store: Optional[str] = None,
+    worker_id: Optional[int] = None,
 ) -> None:
     """Serve with werkzeug's multithreaded server.
 
@@ -1270,15 +1334,23 @@ def run_server(
     ``trace_dir``: wrap the warm-up compiles in a ``jax.profiler`` device
     trace (the compile-heavy phase worth profiling; steady-state serving
     is better observed through ``/metrics``).
+
+    Graceful shutdown: SIGTERM (what the router's supervisor — or k8s —
+    sends) closes the admission gate, drains in-flight requests
+    (``GORDO_DRAIN_TIMEOUT`` seconds, default 10), quiesces the engine's
+    dispatch pipeline, and only then stops the listener — a
+    router-initiated worker restart drops zero accepted requests.
     """
-    from werkzeug.serving import run_simple
+    import signal
+
+    from werkzeug.serving import make_server
 
     from ..utils.profiling import device_trace
 
     app = build_app(
         model_dirs, project=project, models_root=models_root,
         shard_fleet=shard_fleet, max_inflight=max_inflight,
-        compile_cache_store=compile_cache_store,
+        compile_cache_store=compile_cache_store, worker_id=worker_id,
     )
     # warm each bucket's scoring program BEFORE accepting traffic: the
     # first request must pay dispatch (ms), not XLA compile (tens of s).
@@ -1304,4 +1376,32 @@ def run_server(
                     else " (compile cache off)"
                 ),
             )
-    run_simple(host, port, app, threaded=True)
+    server = make_server(host, port, app, threaded=True)
+    drain_timeout = float(os.environ.get("GORDO_DRAIN_TIMEOUT", "10"))
+
+    def _drain_and_stop() -> None:
+        # ordering matters: close admission (new work sheds with the
+        # draining marker, the router re-routes it) → drain in-flight →
+        # quiesce the engine → stop the listener. shutdown() last so the
+        # healthz endpoint keeps ANSWERING "draining" while we drain —
+        # a silent socket would read as a dead worker and get ejected.
+        app.quiesce(drain_timeout)
+        server.shutdown()
+
+    def _on_sigterm(signum, frame) -> None:
+        logger.info("SIGTERM: beginning graceful drain")
+        # a thread, not inline: the handler runs on the main thread,
+        # which serve_forever() below owns — quiescing there would
+        # deadlock against the very requests being drained
+        threading.Thread(
+            target=_drain_and_stop, name="gordo-drain", daemon=True
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        # not the main thread (embedded run_server): graceful shutdown
+        # is then the embedder's job via app.quiesce()
+        logger.debug("SIGTERM handler not installed (non-main thread)")
+    server.serve_forever()
+    logger.info("Server stopped")

@@ -1,3 +1,4 @@
+from .control import ControlPlane, jittered_interval
 from .server import (
     WatchmanServer,
     build_watchman_app,
@@ -7,8 +8,10 @@ from .server import (
 )
 
 __all__ = [
+    "ControlPlane",
     "WatchmanServer",
     "build_watchman_app",
+    "jittered_interval",
     "read_build_progress",
     "run_watchman",
     "watch_build_progress",

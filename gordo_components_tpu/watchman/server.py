@@ -406,7 +406,11 @@ def watch_build_progress(
     JSON progress line per interval from the manifest file(s), returning
     True once every machine is completed, False if ``max_iterations``
     elapsed first. No HTTP anywhere — this reads the same files the build
-    writes atomically."""
+    writes atomically. Ticks are jittered ±10% (control.jittered_interval)
+    so many followers over one shared filesystem don't all stat the
+    manifests on the same beat."""
+    from .control import jittered_interval
+
     i = 0
     while True:
         progress = read_build_progress(manifest_path)
@@ -416,7 +420,7 @@ def watch_build_progress(
         i += 1
         if max_iterations is not None and i >= max_iterations:
             return False
-        sleep(interval_s)
+        sleep(jittered_interval(interval_s))
 
 
 def build_watchman_app(

@@ -1,0 +1,426 @@
+#!/usr/bin/env python
+"""Router smoke: the horizontal serving tier end to end
+(``make router-smoke``).
+
+The experiment (ISSUE 8 acceptance scenario): 3 REAL worker server
+processes behind the router, one shared models tree + compile-cache
+store. A live tier must then:
+
+- route every machine's requests to its consistent-hash-placed worker
+  (verified via ``X-Gordo-Worker``),
+- survive a SIGKILL of one worker mid-traffic: requests re-route to the
+  survivors with no 5xx burst beyond the breaker budget, and the control
+  plane ejects + respawns the corpse,
+- survive a SIGTERM (graceful drain) mid-traffic with ZERO client-visible
+  errors — the drained worker sheds with the draining marker and the
+  router re-routes,
+- adopt a new generation rolling: canary one worker's ``/reload``,
+  verify, sweep the rest — with ZERO fresh XLA compiles on any worker
+  (the shared compile-cache store makes adoption O(load)),
+- roll the fleet back (``POST /rollback``): one atomic ``CURRENT`` swap
+  per machine on shared disk, then the same canary→sweep — also
+  recompile-free,
+- expose per-worker routing metrics (``gordo_router_requests_total``).
+
+Exit codes: 0 = all checks passed, 1 = at least one failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-04T00:00:00+00:00",
+    "tag_list": ["tag-a", "tag-b", "tag-c"],
+}
+MODEL_CONFIG = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                  "dims": [6], "epochs": 1,
+                                  "batch_size": 32}},
+        ]
+    }
+}
+MACHINES = ("mach-a", "mach-b", "mach-c")
+N_WORKERS = 3
+
+_failures: list = []
+
+
+def check(ok: bool, message: str) -> None:
+    marker = "ok  " if ok else "FAIL"
+    print(f"  {marker} {message}")
+    if not ok:
+        _failures.append(message)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _worker_compiles(session, base_url: str) -> float:
+    """Fresh-XLA-compile count a worker has paid, read off its /metrics
+    registry snapshot (absent series = zero compiles)."""
+    body = session.get(f"{base_url}/metrics", timeout=10).json()
+    series = (
+        body.get("registry", {})
+        .get("gordo_engine_compile_seconds", {})
+        .get("series", {})
+    )
+    return sum(entry["count"] for entry in series.values())
+
+
+def _worker_generations(session, base_url: str) -> dict:
+    body = session.get(f"{base_url}/healthz", timeout=10).json()
+    return (body.get("store") or {}).get("generations") or {}
+
+
+class _Traffic:
+    """Background scoring traffic through the router, round-robin over
+    the machines; collects every outcome for the phase gates."""
+
+    def __init__(self, base: str, n_threads: int = 4):
+        import requests
+
+        self.base = base
+        self.n_threads = n_threads
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.outcomes: list = []
+        self._threads: list = []
+        self._sessions = [requests.Session() for _ in range(n_threads)]
+
+    def _run(self, t: int) -> None:
+        payload = json.dumps({"X": [[0.1, 0.2, 0.3]] * 3})
+        headers = {"Content-Type": "application/json"}
+        session = self._sessions[t]
+        i = 0
+        while not self._stop.is_set():
+            machine = MACHINES[(t + i) % len(MACHINES)]
+            i += 1
+            try:
+                response = session.post(
+                    f"{self.base}/gordo/v0/router-smoke/{machine}"
+                    "/prediction",
+                    data=payload, headers=headers, timeout=30,
+                )
+                outcome = response.status_code
+            except Exception as exc:
+                outcome = f"EXC:{type(exc).__name__}"
+            with self._lock:
+                self.outcomes.append(outcome)
+            time.sleep(0.02)
+
+    def start(self) -> None:
+        self._threads = [
+            threading.Thread(target=self._run, args=(t,), daemon=True)
+            for t in range(self.n_threads)
+        ]
+        for thread in self._threads:
+            thread.start()
+
+    def mark(self) -> int:
+        with self._lock:
+            return len(self.outcomes)
+
+    def since(self, mark: int) -> list:
+        with self._lock:
+            return list(self.outcomes[mark:])
+
+    def stop(self) -> list:
+        self._stop.set()
+        for thread in self._threads:
+            thread.join(timeout=10)
+        for session in self._sessions:
+            session.close()
+        with self._lock:
+            return list(self.outcomes)
+
+
+def main() -> int:
+    import logging
+    import tempfile
+
+    import requests
+    from werkzeug.serving import make_server
+
+    # the router front would otherwise print one access-log line per
+    # traffic request — hundreds of lines hiding the check output
+    logging.getLogger("werkzeug").setLevel(logging.WARNING)
+
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.observability.exposition import (
+        parse_prometheus_text,
+    )
+    from gordo_components_tpu.router import (
+        SubprocessWorker,
+        assemble_fleet,
+        server_worker_argv,
+        worker_specs,
+    )
+    from gordo_components_tpu.store.generations import current_generation
+
+    session = requests.Session()
+    with tempfile.TemporaryDirectory() as tmp:
+        models_root = os.path.join(tmp, "models")
+        os.makedirs(models_root)
+        print(f"building {len(MACHINES)} throwaway machines ...",
+              file=sys.stderr)
+        for name in MACHINES:
+            provide_saved_model(
+                name, MODEL_CONFIG, DATA_CONFIG,
+                os.path.join(models_root, name),
+                evaluation_config={"cv_mode": "build_only"},
+            )
+
+        specs = worker_specs(N_WORKERS, _free_port(), host="127.0.0.1")
+        # distinct ports per slot (worker_specs assumes a contiguous
+        # range; under a shared CI host free ports aren't contiguous)
+        specs = [spec._replace(port=_free_port()) for spec in specs]
+        log_dir = os.path.join(tmp, "logs")
+        os.makedirs(log_dir)
+
+        def factory(spec):
+            log = open(
+                os.path.join(log_dir, f"{spec.name}.log"), "ab"
+            )
+            return SubprocessWorker(
+                spec,
+                server_worker_argv(
+                    spec, models_root, project="router-smoke"
+                ),
+                env={"JAX_PLATFORMS": "cpu", "GORDO_DRAIN_TIMEOUT": "10"},
+                stdout=log, stderr=log,
+            )
+
+        router = assemble_fleet(
+            specs, factory, project="router-smoke",
+            models_root=models_root,
+            breaker_recovery=3.0, boot_grace=120.0,
+        )
+        supervisor, control = router.supervisor, router.control
+        print(f"spawning {N_WORKERS} worker processes ...", file=sys.stderr)
+        supervisor.start_all()
+        ready = supervisor.wait_ready(timeout=300)
+        check(len(ready) == N_WORKERS,
+              f"all {N_WORKERS} workers became ready (got {ready})")
+        if len(ready) != N_WORKERS:
+            for name in supervisor.specs:
+                log_path = os.path.join(log_dir, f"{name}.log")
+                if os.path.exists(log_path):
+                    with open(log_path) as fh:
+                        print(f"--- {name} log tail ---\n"
+                              + "".join(fh.readlines()[-20:]),
+                              file=sys.stderr)
+            supervisor.stop_all(grace=5)
+            return 1
+        control.start(interval=0.5)
+        front = make_server("127.0.0.1", 0, router, threaded=True)
+        front_thread = threading.Thread(
+            target=front.serve_forever, daemon=True
+        )
+        front_thread.start()
+        base = f"http://127.0.0.1:{front.server_port}"
+        traffic = _Traffic(base)
+        try:
+            # [1/5] placement: sticky, verified via the worker echo
+            print("[1/5] consistent-hash placement", file=sys.stderr)
+            payload = json.dumps({"X": [[0.1, 0.2, 0.3]] * 3})
+            headers = {"Content-Type": "application/json"}
+            placed_ok = True
+            for machine in MACHINES:
+                expected = router.placement.replica_set(machine)[0]
+                expected_id = str(supervisor.specs[expected].worker_id)
+                for _ in range(3):
+                    response = session.post(
+                        f"{base}/gordo/v0/router-smoke/{machine}"
+                        "/prediction",
+                        data=payload, headers=headers, timeout=30,
+                    )
+                    placed_ok &= (
+                        response.status_code == 200
+                        and response.headers.get("X-Gordo-Worker")
+                        == expected_id
+                    )
+            check(placed_ok,
+                  "every machine scores 200 on its placed worker "
+                  "(X-Gordo-Worker echo matches the ring)")
+
+            traffic.start()
+            time.sleep(1.0)
+
+            # [2/5] SIGKILL one worker mid-traffic
+            print("[2/5] worker SIGKILL mid-traffic", file=sys.stderr)
+            victim = router.placement.replica_set(MACHINES[0])[0]
+            mark = traffic.mark()
+            respawns_before = supervisor.respawn_counts()[victim]
+            supervisor.worker(victim).kill()
+            time.sleep(4.0)
+            outcomes = traffic.since(mark)
+            bad = [o for o in outcomes if o != 200]
+            check(len(outcomes) > 20,
+                  f"traffic kept flowing through the kill "
+                  f"({len(outcomes)} requests)")
+            check(len(bad) <= 2,
+                  f"no 5xx burst beyond the breaker budget on kill "
+                  f"(bad: {bad[:5]} of {len(outcomes)})")
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if (
+                    supervisor.respawn_counts()[victim] > respawns_before
+                    and supervisor.alive(victim)
+                ):
+                    break
+                time.sleep(0.5)
+            check(supervisor.respawn_counts()[victim] > respawns_before,
+                  f"control plane respawned the killed worker {victim}")
+
+            # [3/5] graceful SIGTERM drain mid-traffic: ZERO errors
+            print("[3/5] graceful drain mid-traffic", file=sys.stderr)
+            drainee = next(
+                name for name in sorted(supervisor.specs)
+                if name != victim
+            )
+            mark = traffic.mark()
+            os.kill(supervisor.worker(drainee).pid, signal.SIGTERM)
+            time.sleep(4.0)
+            outcomes = traffic.since(mark)
+            bad = [o for o in outcomes if o != 200]
+            check(len(outcomes) > 20,
+                  f"traffic kept flowing through the drain "
+                  f"({len(outcomes)} requests)")
+            check(not bad,
+                  f"zero dropped/errored requests through the graceful "
+                  f"drain (bad: {bad[:5]})")
+            traffic.stop()
+
+            # wait for the fleet to be whole again (drained worker
+            # respawned and ready) before the rollout phase
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                if all(
+                    control.routable(name)
+                    and control.last_probe(name)
+                    and control.last_probe(name)["state"] in (
+                        "ok", "degraded",
+                    )
+                    for name in supervisor.specs
+                ):
+                    break
+                time.sleep(0.5)
+            whole = all(
+                control.routable(name) for name in supervisor.specs
+            )
+            check(whole, "fleet whole again after kill + drain "
+                         "(all workers routable)")
+
+            # [4/5] rolling generation adoption: canary → sweep, zero
+            # fresh compiles via the shared compile-cache store
+            print("[4/5] canary → sweep generation rollout",
+                  file=sys.stderr)
+            provide_saved_model(
+                MACHINES[0], MODEL_CONFIG, DATA_CONFIG,
+                os.path.join(models_root, MACHINES[0]),
+                evaluation_config={"cv_mode": "build_only"},
+            )
+            new_gen = current_generation(
+                os.path.join(models_root, MACHINES[0])
+            )
+            compiles_before = {
+                spec.name: _worker_compiles(session, spec.base_url)
+                for spec in specs
+            }
+            result = session.post(f"{base}/reload", timeout=600).json()
+            check(result.get("aborted") is False,
+                  f"rollout completed (canary {result.get('canary')})")
+            check(len(result.get("workers", {})) == N_WORKERS,
+                  "every worker reloaded in the sweep")
+            adopted = all(
+                _worker_generations(session, spec.base_url).get(
+                    MACHINES[0]
+                ) == new_gen
+                for spec in specs
+            )
+            check(adopted,
+                  f"all workers adopted {new_gen} for {MACHINES[0]}")
+            compile_deltas = {
+                spec.name: _worker_compiles(session, spec.base_url)
+                - compiles_before[spec.name]
+                for spec in specs
+            }
+            check(all(delta == 0 for delta in compile_deltas.values()),
+                  f"canary → sweep paid ZERO fresh XLA compiles "
+                  f"(deltas: {compile_deltas})")
+
+            # [5/5] fleet-wide rollback: atomic CURRENT swap + adoption,
+            # also recompile-free; router metrics present
+            print("[5/5] fleet-wide rollback + router metrics",
+                  file=sys.stderr)
+            result = session.post(f"{base}/rollback", timeout=600).json()
+            check(result.get("aborted") is False
+                  and MACHINES[0] in result.get("restored", {}),
+                  f"rollback restored {MACHINES[0]} and re-adopted "
+                  f"(restored: {sorted(result.get('restored', {}))})")
+            rolled = all(
+                _worker_generations(session, spec.base_url).get(
+                    MACHINES[0]
+                ) != new_gen
+                for spec in specs
+            )
+            check(rolled, "every worker serves the rolled-back "
+                          "generation")
+            rollback_deltas = {
+                spec.name: _worker_compiles(session, spec.base_url)
+                - compiles_before[spec.name]
+                for spec in specs
+            }
+            check(all(d == 0 for d in rollback_deltas.values()),
+                  f"rollback adoption also recompile-free "
+                  f"(deltas: {rollback_deltas})")
+            text = session.get(
+                f"{base}/metrics?format=prometheus", timeout=10
+            ).text
+            try:
+                samples = parse_prometheus_text(text)
+            except ValueError as exc:
+                check(False, f"router exposition parses ({exc})")
+            else:
+                check("gordo_router_requests_total" in samples,
+                      "per-worker routing series in the exposition")
+                check("gordo_router_worker_respawns_total" in samples,
+                      "respawn series in the exposition")
+        finally:
+            traffic.stop()
+            control.stop()
+            front.shutdown()
+            front_thread.join(timeout=5)
+            supervisor.stop_all(grace=10)
+            router.close()
+            session.close()
+
+    if _failures:
+        print(f"\nROUTER SMOKE FAILED: {len(_failures)} check(s)",
+              file=sys.stderr)
+        return 1
+    print("\nrouter smoke passed: kill re-routes, drain drops zero, "
+          "rollout pays zero compiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
