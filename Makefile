@@ -1,7 +1,16 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke smoke images builder-image server-image watchman-image
+
+# invariant linter (docs/ARCHITECTURE.md §17): lock discipline against
+# the declared hierarchy, blocking-calls-under-hot-locks, unbound
+# span seams, gordo_* metric conventions, GORDO_* knob registry +
+# generated README table sync. Pure stdlib — runs in seconds, no jax.
+# The gate is "no NEW violations" (lint_baseline.json grandfathers the
+# deliberate keeps, each with a reason).
+lint:
+	python -m gordo_components_tpu.analysis
 
 test:
 	python -m pytest tests/ -q
@@ -72,10 +81,10 @@ megabatch-smoke:
 router-smoke:
 	JAX_PLATFORMS=cpu python tools/router_smoke.py
 
-# the full smoke battery: exposition + resilience + store integrity +
-# serving data plane + span attribution + cold-start economics +
-# cross-machine megabatching + the horizontal serving tier
-smoke: metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke
+# the full smoke battery: invariant lint + exposition + resilience +
+# store integrity + serving data plane + span attribution + cold-start
+# economics + cross-machine megabatching + the horizontal serving tier
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke
 
 images: builder-image server-image watchman-image
 

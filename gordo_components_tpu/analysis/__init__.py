@@ -1,0 +1,35 @@
+"""Machine-checked invariants: the repo's concurrency and conventions
+contracts as analyzers, not prose.
+
+Eight PRs grew the seed pipeline into a threaded serving system whose
+correctness rules lived in ARCHITECTURE.md: lock ordering across the
+engine/router/resilience layers, the thread/asyncio seam rule that spans
+and log records must carry an explicit ``SpanContext`` (the PR 4 trace
+loss), ``gordo_*`` metric naming and label conventions (§7), and the
+``GORDO_*`` env-knob zoo. This package encodes those rules so
+``gordo lint`` / ``make lint`` can search the tree for violations
+(Automap's "search instead of hand-annotate", applied to our own
+annotations):
+
+- :mod:`.locks` — THE declared lock order (ranks), hot-lock set, and
+  blocking-call vocabulary, shared by the static checker and the
+  runtime validator.
+- :mod:`.lock_discipline` — static lock-order + blocking-under-hot-lock
+  checker (``# lint: allow-blocking(<reason>)`` escape hatches).
+- :mod:`.span_seam` — thread/asyncio handoffs whose far side records
+  spans or logs must capture-and-bind ``SpanContext``.
+- :mod:`.metrics_conventions` — ``gordo_<component>_<noun>_<unit>``
+  name grammar + §7 label allowlist (the grammar is also what
+  ``tools/scrape_metrics.py --require-gordo`` validates with).
+- :mod:`.knobs` / :mod:`.knob_registry` — every ``GORDO_*`` env read
+  must be declared in the central knob registry; README's knob table
+  is generated from it.
+- :mod:`.lockcheck` — the optional ``GORDO_LOCKCHECK=1`` runtime
+  validator: named locks record real acquisition orders during the
+  concurrency tests and fail on any order the declaration forbids.
+  Static analysis proposes, the runtime witness confirms.
+
+Everything here is pure stdlib (``ast``) — ``make lint`` must run in
+seconds without importing jax. Keep this ``__init__`` import-free for
+the same reason: the engine imports :mod:`.lockcheck` at module scope.
+"""

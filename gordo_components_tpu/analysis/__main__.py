@@ -1,0 +1,10 @@
+"""``python -m gordo_components_tpu.analysis`` — the jax-free lint
+entry point ``make lint`` calls (the ``gordo lint`` CLI verb delegates
+here too)."""
+
+import sys
+
+from .runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

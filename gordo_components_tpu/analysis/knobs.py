@@ -1,0 +1,181 @@
+"""THE ``GORDO_*`` env-knob registry: one declaration per knob.
+
+Every ``os.environ`` / ``os.getenv`` / click ``envvar=`` read of a
+``GORDO_*`` name anywhere in the tree must have an entry here — the
+:mod:`.knob_registry` checker enforces it — and the README knob table
+is GENERATED from this module (``python -m gordo_components_tpu.analysis
+--write-knob-table``), so the docs cannot drift from the code again.
+
+``default`` is the human-readable default (including "core-aware"
+formulas), ``parser`` the accepted value shape. Keep docs to one line:
+they become table cells.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    default: str
+    parser: str      # int | float | str | bool | path | spec
+    doc: str         # one line; becomes the README table cell
+    component: str   # serving | engine | build | store | observability |
+                     # resilience | test
+
+
+def _knob(name, default, parser, doc, component) -> Tuple[str, Knob]:
+    return name, Knob(name, default, parser, doc, component)
+
+
+KNOBS: Dict[str, Knob] = dict(
+    [
+        # -- engine / serving data plane ---------------------------------
+        _knob("GORDO_DISPATCH_DEPTH", "2 (≥4 CPUs) / 1", "int",
+              "bounded in-flight device dispatches per bucket; 1 = serial "
+              "bit-identical comparison mode", "engine"),
+        _knob("GORDO_MEGABATCH", "1", "bool",
+              "cross-machine fused dispatch (replicated engines only; "
+              "`0`/`off` disables, `--no-megabatch` on `run-server`)",
+              "engine"),
+        _knob("GORDO_FILL_WINDOW_US", "250 µs (≥4 CPUs) / 1000 µs", "int",
+              "bounded fill window a leader holds open when it observes "
+              "concurrency; `0` = drain-only fusion; `--fill-window-us` "
+              "on `run-server`", "engine"),
+        _knob("GORDO_MEGABATCH_RESIDENCY", "128", "int",
+              "machines per bucket resident in the stacked megabatch "
+              "program; fleets at/under the cap are fully resident from "
+              "boot, larger fleets earn slots hot-cache-style", "engine"),
+        _knob("GORDO_SERVE_HOT_CACHE", "16", "int",
+              "shard mode: machines keeping an unsharded hot device copy "
+              "(skips the per-dispatch cross-device gather); 0 disables",
+              "engine"),
+        # -- server admission / lifecycle --------------------------------
+        _knob("GORDO_MAX_INFLIGHT", "64", "int",
+              "admission gate: concurrent admitted requests "
+              "(`--max-inflight` on `run-server`)", "serving"),
+        _knob("GORDO_MAX_QUEUE", "32", "int",
+              "admission gate: waiters allowed behind a full gate "
+              "(micro-burst absorption)", "serving"),
+        _knob("GORDO_QUEUE_TIMEOUT", "1.0", "float",
+              "seconds a waiter queues for admission before shedding 503",
+              "serving"),
+        _knob("GORDO_DRAIN_TIMEOUT", "10", "float",
+              "graceful-shutdown budget: seconds SIGTERM waits for "
+              "in-flight requests before stopping the listener",
+              "serving"),
+        _knob("GORDO_WORKER_ID", "unset", "int",
+              "horizontal tier: this worker's slot id (stamped on "
+              "responses as `X-Gordo-Worker`; set by the router "
+              "supervisor)", "serving"),
+        # -- compile caches ----------------------------------------------
+        _knob("GORDO_COMPILE_CACHE", "~/.cache/gordo-tpu/jax-compile",
+              "path",
+              "build-side persistent XLA compilation cache directory; "
+              "`off` disables", "build"),
+        _knob("GORDO_COMPILE_CACHE_STORE",
+              "<models_root>/.compile-cache", "path",
+              "serving-side AOT executable store; `off` disables "
+              "(`--compile-cache-store` on `run-server`)", "serving"),
+        # -- resilience --------------------------------------------------
+        _knob("GORDO_FAULTS", "unset", "spec",
+              "fault-injection plan (`point:target:kind[:arg]`, "
+              "comma-separated) powering the chaos suite; `--faults` on "
+              "`run-server`", "resilience"),
+        # -- observability -----------------------------------------------
+        _knob("GORDO_FLIGHTREC", "1", "bool",
+              "always-on flight recorder; `0` disables recording "
+              "(perf-comparison escape hatch)", "observability"),
+        _knob("GORDO_FLIGHTREC_KEEP", "256", "int",
+              "flight recorder: recent-request ring size", "observability"),
+        _knob("GORDO_FLIGHTREC_SLOW_KEEP", "32", "int",
+              "flight recorder: slowest-since-boot reservoir size",
+              "observability"),
+        _knob("GORDO_FLIGHTREC_ERROR_KEEP", "64", "int",
+              "flight recorder: error-request ring size", "observability"),
+        _knob("GORDO_LOG_LEVEL", "INFO", "str",
+              "root log level (`--log-level`)", "observability"),
+        _knob("GORDO_LOG_FORMAT", "text", "str",
+              "`text` or `json` (one JSON object per record with "
+              "trace/span ids; `--log-format`)", "observability"),
+        _knob("GORDO_TRACE_DIR", "unset", "path",
+              "jax.profiler device-trace output dir for build/warmup "
+              "phases (`--trace-dir`)", "observability"),
+        _knob("GORDO_DEBUG_NANS", "0", "bool",
+              "jax_debug_nans: re-run op-by-op at the first NaN "
+              "(diagnostics only; `--debug-nans`)", "observability"),
+        # -- store -------------------------------------------------------
+        _knob("GORDO_STORE_KEEP_GENERATIONS", "3", "int",
+              "generations kept per machine after a commit prunes old "
+              "ones (always ≥ 2 so one rollback step survives)", "store"),
+        _knob("GORDO_MAX_ARTIFACT_BYTES", "2 GiB", "int",
+              "bounded artifact loads: max decompressed tar bytes a "
+              "model load will extract", "store"),
+        # -- build / multihost -------------------------------------------
+        _knob("GORDO_FORCED_CPU", "0", "bool",
+              "force the CPU backend even when an accelerator is visible "
+              "(CI / wedged-tunnel escape hatch)", "build"),
+        _knob("GORDO_COORDINATOR", "unset", "str",
+              "multihost: coordinator address for "
+              "`jax.distributed.initialize` (`--coordinator-address`)",
+              "build"),
+        _knob("GORDO_NUM_PROCESSES", "unset", "int",
+              "multihost: world size (`--num-processes`)", "build"),
+        _knob("GORDO_PROCESS_ID", "unset", "int",
+              "multihost: this process's rank (`--process-id`)", "build"),
+        _knob("GORDO_SLICE_TIMEOUT_S", "unset", "float",
+              "fleet build: per-slice collective timeout before the "
+              "straggler handling kicks in", "build"),
+        _knob("GORDO_BUILD_FETCH_RETRIES", "2", "int",
+              "fleet build: per-machine data-fetch retries before "
+              "zero-weight isolation", "build"),
+        _knob("GORDO_BUILD_FETCH_BACKOFF", "1.0", "float",
+              "fleet build: base seconds between data-fetch retries "
+              "(exponential)", "build"),
+        # -- bench -------------------------------------------------------
+        _knob("GORDO_BENCH_HISTORY", "BENCH_HISTORY.jsonl", "path",
+              "where bench.py / bench_serving.py append their history "
+              "rows (tests point it at /dev/null)", "bench"),
+        _knob("GORDO_RESET_BENCH_ANCHOR", "0", "bool",
+              "reseed the bench-regression anchor ring (after a rig "
+              "change that legitimately moved the baseline)", "bench"),
+        # -- test / validation harnesses ---------------------------------
+        _knob("GORDO_LOCKCHECK", "0", "bool",
+              "runtime lock-order validator: named locks record real "
+              "acquisition orders and fail the tests on any order the "
+              "declared hierarchy (analysis/locks.py) forbids", "test"),
+        _knob("GORDO_ISOLATE_CPU", "0", "bool",
+              "tools/tpu_isolate.py child: pin the CPU backend via "
+              "jax.config for a real local compile measurement (the axon "
+              "plugin ignores JAX_PLATFORMS)", "test"),
+        _knob("GORDO_TEST_NO_COMPILE_CACHE", "0", "bool",
+              "run the pytest suite with the persistent XLA compile "
+              "cache disabled (jaxlib segfault-isolation experiment)",
+              "test"),
+    ]
+)
+
+
+def get(name: str) -> Optional[Knob]:
+    return KNOBS.get(name)
+
+
+def render_markdown_table(component: Optional[str] = None) -> str:
+    """The README knob table (all components interleaved, sorted by
+    component then name) — regenerate with
+    ``python -m gordo_components_tpu.analysis --write-knob-table``."""
+    rows = [
+        knob for knob in KNOBS.values()
+        if component is None or knob.component == component
+    ]
+    rows.sort(key=lambda knob: (knob.component, knob.name))
+    lines = [
+        "| knob | default | meaning |",
+        "|---|---|---|",
+    ]
+    for knob in rows:
+        lines.append(f"| `{knob.name}` | `{knob.default}` | {knob.doc} |")
+    return "\n".join(lines)

@@ -1,0 +1,109 @@
+"""THE declared lock order — the one copy both halves check against.
+
+The serving system's locks form a strict hierarchy: a thread may only
+acquire a lock of HIGHER rank than every lock it already holds. Rank
+gaps are deliberate slack for future locks. The table below is the
+machine-readable twin of docs/ARCHITECTURE.md §17; the static checker
+(:mod:`.lock_discipline`) flags source-level acquisitions that violate
+it, and the runtime validator (:mod:`.lockcheck`) fails real executions
+whose observed order it forbids.
+
+Why ranks and not an edge list: a total-ish order makes every nesting
+decidable (no "we never declared that pair" ambiguity), and cycles are
+impossible by construction — any cycle must contain a rank inversion.
+
+``HOT_LOCKS`` are the request-path locks: holding one while making a
+blocking call (device fetch, HTTP, joins, sleeps, XLA compiles) stalls
+either live scoring traffic or the dispatch pipeline behind it, so the
+static checker flags those calls. Deliberate exceptions carry a
+``# lint: allow-blocking(<reason>)`` comment — the reason is mandatory.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+# lock name -> rank. Acquisition must be strictly rank-increasing per
+# thread. Locks never held together may share a rank tier spacing, but
+# no two locks that can nest may share a rank.
+LOCK_RANKS: Dict[str, int] = {
+    # -- admin / control-plane outer locks (held across whole operations)
+    "server.reload": 10,        # server.py _reload_lock: one reload at a time
+    "router.op": 15,            # rollout.py _op_lock: one rollout/rollback
+    "server.admission": 20,     # admission.py gate condition
+    "server.state_cond": 25,    # server.py _ServerState in-flight tracking
+    "router.models": 30,        # router.py cached fleet model list
+    "watchman.control": 35,     # control.py probe bookkeeping
+    "router.rollout_state": 40, # rollout.py last-result state
+    "router.workers": 45,       # workers.py supervisor slot table
+    "router.placement": 50,     # placement.py ring + hot-tracking state
+    "resilience.breaker_board": 55,  # breaker.py per-name board
+    "resilience.breaker": 60,   # breaker.py one circuit's state
+    "resilience.quarantine": 62,  # quarantine.py ledger
+    "resilience.faults": 64,    # faults.py injection plan
+    "client.io": 66,            # client.py pooled-loop lifecycle
+    # -- engine data plane (innermost: these sit under everything above
+    # via reload-time warmup and request-path scoring)
+    "engine.bucket_cond": 70,   # _Bucket._cond leader/follower latch
+    "engine.collector": 75,     # _Bucket._collector_lock handover
+    "engine.hot": 80,           # _Bucket._hot_lock shard hot cache
+    "engine.mega": 82,          # _Bucket._mega_lock residency routing
+    "engine.shard_dispatch": 90,  # process-global collective-launch lock
+}
+
+# Request-hot-path locks: blocking calls under these stall live traffic
+# (or the pipeline draining toward it). The admin locks — reload,
+# rollout op, supervisor — deliberately block for seconds and are not
+# listed.
+HOT_LOCKS = frozenset(
+    {
+        "server.admission",
+        "server.state_cond",
+        "router.models",
+        "router.placement",
+        "resilience.breaker_board",
+        "resilience.breaker",
+        "engine.bucket_cond",
+        "engine.collector",
+        "engine.hot",
+        "engine.mega",
+        "engine.shard_dispatch",
+    }
+)
+
+# (file suffix, attribute name) -> lock name: how the static checker
+# maps a ``with self._hot_lock:`` (or module-global) expression in a
+# given file onto the declared hierarchy. Attribute collisions across
+# files (every module calls its lock ``_lock``) are resolved by the
+# file suffix, which is why the mapping is keyed this way.
+LOCK_ATTRS: Dict[Tuple[str, str], str] = {
+    ("server/engine.py", "_SHARD_DISPATCH_LOCK"): "engine.shard_dispatch",
+    ("server/engine.py", "_dispatch_lock"): "engine.shard_dispatch",
+    ("server/engine.py", "_cond"): "engine.bucket_cond",
+    ("server/engine.py", "_hot_lock"): "engine.hot",
+    ("server/engine.py", "_mega_lock"): "engine.mega",
+    ("server/engine.py", "_collector_lock"): "engine.collector",
+    ("server/server.py", "_cond"): "server.state_cond",
+    ("server/server.py", "_reload_lock"): "server.reload",
+    ("resilience/admission.py", "_cond"): "server.admission",
+    ("resilience/breaker.py", "_lock"): "resilience.breaker",
+    ("resilience/quarantine.py", "_lock"): "resilience.quarantine",
+    ("resilience/faults.py", "_lock"): "resilience.faults",
+    ("router/router.py", "_models_lock"): "router.models",
+    ("router/rollout.py", "_op_lock"): "router.op",
+    ("router/rollout.py", "_lock"): "router.rollout_state",
+    ("router/placement.py", "_lock"): "router.placement",
+    ("router/workers.py", "_lock"): "router.workers",
+    ("watchman/control.py", "_lock"): "watchman.control",
+    ("client/client.py", "_io_lock"): "client.io",
+}
+
+
+def rank_of(name: str) -> int:
+    return LOCK_RANKS[name]
+
+
+def may_nest(outer: str, inner: str) -> bool:
+    """Whether acquiring ``inner`` while holding ``outer`` respects the
+    declared hierarchy (strictly increasing rank)."""
+    return LOCK_RANKS[inner] > LOCK_RANKS[outer]

@@ -1,0 +1,272 @@
+"""``gordo lint`` / ``make lint`` entry point: run every checker, apply
+the baseline, print ``file:line severity checker message`` findings.
+
+Pure stdlib and import-light on purpose — the gate must run in seconds,
+before any jax import could slow it down. Exit status: 0 = clean (no
+non-baselined findings), 1 = findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import (
+    knob_registry,
+    knobs,
+    lock_discipline,
+    metrics_conventions,
+    span_seam,
+)
+from .astscan import Module, parse_module
+from .findings import Baseline, Finding
+
+# checker -> repo-relative path prefixes it runs over
+SCOPES: Dict[str, Tuple[str, ...]] = {
+    "lock-discipline": ("gordo_components_tpu/",),
+    "span-seam": (
+        "gordo_components_tpu/server/",
+        "gordo_components_tpu/client/",
+        "gordo_components_tpu/router/",
+        "gordo_components_tpu/watchman/",
+    ),
+    "metrics-conventions": (
+        "gordo_components_tpu/", "tools/", "bench.py", "bench_serving.py",
+    ),
+    "knob-registry": (
+        "gordo_components_tpu/", "tools/", "tests/", "bench.py",
+        "bench_serving.py",
+    ),
+}
+
+KNOB_TABLE_BEGIN = "<!-- knob-table:begin (generated: make lint) -->"
+KNOB_TABLE_END = "<!-- knob-table:end -->"
+
+
+def repo_root(start: Optional[str] = None) -> str:
+    """The checkout root: the directory holding gordo_components_tpu/."""
+    here = os.path.abspath(start or os.path.dirname(__file__))
+    probe = here
+    while True:
+        if os.path.isdir(os.path.join(probe, "gordo_components_tpu")):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            return os.path.abspath(start or os.getcwd())
+        probe = parent
+
+
+def _iter_files(root: str) -> List[str]:
+    out: List[str] = []
+    for prefix in ("gordo_components_tpu", "tools", "tests"):
+        base = os.path.join(root, prefix)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [
+                d for d in dirnames
+                # lint_corpus: seeded-BAD snippets the analysis tests
+                # feed the checkers directly — not part of the tree gate
+                if d not in ("__pycache__", ".jax_compilation_cache",
+                             "lint_corpus")
+            ]
+            for filename in sorted(filenames):
+                if filename.endswith(".py"):
+                    out.append(os.path.join(dirpath, filename))
+    for single in ("bench.py", "bench_serving.py"):
+        path = os.path.join(root, single)
+        if os.path.exists(path):
+            out.append(path)
+    return out
+
+
+def _in_scope(relpath: str, checker: str) -> bool:
+    return relpath.startswith(SCOPES[checker]) or relpath in SCOPES[checker]
+
+
+def _check_knob_table(root: str) -> List[Finding]:
+    """README's knob table must equal the generated one."""
+    readme = os.path.join(root, "README.md")
+    try:
+        with open(readme, "r", encoding="utf-8") as handle:
+            text = handle.read()
+    except OSError:
+        return []
+    begin = text.find(KNOB_TABLE_BEGIN)
+    end = text.find(KNOB_TABLE_END)
+    if begin == -1 or end == -1:
+        return [
+            Finding(
+                checker="knob-registry", code="readme-table-missing",
+                file="README.md", line=1, key="knob-table",
+                message=(
+                    "README.md has no generated knob-table block "
+                    f"({KNOB_TABLE_BEGIN} ... {KNOB_TABLE_END})"
+                ),
+                hint="run: python -m gordo_components_tpu.analysis "
+                     "--write-knob-table",
+            )
+        ]
+    current = text[begin + len(KNOB_TABLE_BEGIN):end].strip()
+    expected = knobs.render_markdown_table().strip()
+    if current != expected:
+        line = text[:begin].count("\n") + 1
+        return [
+            Finding(
+                checker="knob-registry", code="readme-table-drift",
+                file="README.md", line=line, key="knob-table",
+                message=(
+                    "README knob table differs from the registry in "
+                    "analysis/knobs.py — docs drifted"
+                ),
+                hint="run: python -m gordo_components_tpu.analysis "
+                     "--write-knob-table",
+            )
+        ]
+    return []
+
+
+def write_knob_table(root: str) -> bool:
+    """Rewrite README's generated knob-table block in place."""
+    readme = os.path.join(root, "README.md")
+    with open(readme, "r", encoding="utf-8") as handle:
+        text = handle.read()
+    begin = text.find(KNOB_TABLE_BEGIN)
+    end = text.find(KNOB_TABLE_END)
+    if begin == -1 or end == -1:
+        return False
+    rendered = (
+        text[: begin + len(KNOB_TABLE_BEGIN)]
+        + "\n"
+        + knobs.render_markdown_table()
+        + "\n"
+        + text[end:]
+    )
+    with open(readme, "w", encoding="utf-8") as handle:
+        handle.write(rendered)
+    return True
+
+
+def run_lint(root: Optional[str] = None) -> List[Finding]:
+    root = root or repo_root()
+    findings: List[Finding] = []
+    mentions: Set[str] = set()
+    for path in _iter_files(root):
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        module = parse_module(path, relpath)
+        if module is None:
+            findings.append(
+                Finding(
+                    checker="lint", code="unparseable", file=relpath,
+                    line=1, key=relpath,
+                    message="file does not parse; checkers skipped it",
+                )
+            )
+            continue
+        if _in_scope(relpath, "lock-discipline"):
+            findings.extend(lock_discipline.check(module))
+        if _in_scope(relpath, "span-seam"):
+            findings.extend(span_seam.check(module))
+        if _in_scope(relpath, "metrics-conventions"):
+            findings.extend(metrics_conventions.check(module))
+        if _in_scope(relpath, "knob-registry") and (
+            relpath != "gordo_components_tpu/analysis/knobs.py"
+        ):
+            # knobs.py itself is the registry: its literals would make
+            # every registered knob count as "mentioned" (circular
+            # staleness) and can never be unregistered
+            findings.extend(knob_registry.check(module))
+            mentions |= knob_registry.collect_mentions(module)
+    # registered-but-unmentioned knobs. README PROSE counts as a
+    # mention, but the generated knob-table block must NOT: it always
+    # contains every registered knob (it is rendered FROM the
+    # registry), so counting it would make the stale check circular
+    # and dead knobs would live forever.
+    readme = os.path.join(root, "README.md")
+    try:
+        with open(readme, "r", encoding="utf-8") as handle:
+            readme_text = handle.read()
+    except OSError:
+        readme_text = ""
+    begin = readme_text.find(KNOB_TABLE_BEGIN)
+    end = readme_text.find(KNOB_TABLE_END)
+    if begin != -1 and end != -1:
+        readme_text = readme_text[:begin] + readme_text[end:]
+    # word-bounded: prose naming GORDO_COMPILE_CACHE_STORE must not
+    # also count as a mention of its prefix GORDO_COMPILE_CACHE
+    readme_mentions = set(knob_registry._KNOB_RE.findall(readme_text))
+    findings.extend(
+        knob_registry.stale_knobs(set(mentions) | readme_mentions)
+    )
+    findings.extend(_check_knob_table(root))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="gordo lint",
+        description=(
+            "Invariant linter: lock discipline, span seams, metric "
+            "conventions, knob registry (docs/ARCHITECTURE.md §17)."
+        ),
+    )
+    parser.add_argument("--root", default=None,
+                        help="checkout root (default: auto-detect)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline path (default: <root>/lint_baseline"
+                             ".json)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="grandfather every current finding into the "
+                             "baseline (reasons start as TODO — fill them "
+                             "in)")
+    parser.add_argument("--write-knob-table", action="store_true",
+                        help="regenerate README.md's knob table from "
+                             "analysis/knobs.py and exit")
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="also print findings the baseline suppresses")
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    if args.write_knob_table:
+        if not write_knob_table(root):
+            print("README.md has no knob-table markers", file=sys.stderr)
+            return 2
+        print("README.md knob table regenerated")
+        return 0
+
+    started = time.perf_counter()
+    findings = run_lint(root)
+    baseline_path = args.baseline or os.path.join(root, "lint_baseline.json")
+    baseline = Baseline.load(baseline_path)
+
+    if args.write_baseline:
+        # rebuild from CURRENT findings: existing reasons survive, new
+        # findings start as TODO, and entries whose violation is gone
+        # are pruned — a freshly written baseline always gates clean
+        baseline.entries = {
+            finding.ident: baseline.entries.get(
+                finding.ident, "TODO: justify"
+            )
+            for finding in findings
+        }
+        baseline.save(baseline_path)
+        print(f"baseline written: {len(baseline.entries)} entr(ies) in "
+              f"{baseline_path}")
+        return 0
+
+    fresh, suppressed = baseline.split(findings)
+    fresh.sort(key=lambda f: (f.file, f.line, f.checker, f.code))
+    for finding in fresh:
+        print(finding.render())
+    if args.show_baselined and suppressed:
+        print(f"-- {len(suppressed)} baselined finding(s):")
+        for finding in suppressed:
+            print(f"   {finding.render()}  "
+                  f"[baseline: {baseline.entries.get(finding.ident, '')}]")
+    elapsed = time.perf_counter() - started
+    print(
+        f"lint: {len(fresh)} finding(s), {len(suppressed)} baselined, "
+        f"{elapsed:.2f}s"
+    )
+    return 1 if fresh else 0

@@ -884,5 +884,21 @@ def client_predict_cmd(start, end, base_url, project, machines, max_interval,
     )
 
 
+@gordo.command(
+    "lint",
+    context_settings={"ignore_unknown_options": True},
+    add_help_option=False,
+)
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def lint_cmd(args):
+    """Run the invariant linter (lock discipline, span seams, metric
+    conventions, knob registry — docs/ARCHITECTURE.md §17). Delegates to
+    ``python -m gordo_components_tpu.analysis``; ``make lint`` is the
+    jax-free fast path."""
+    from ..analysis.runner import main as lint_main
+
+    sys.exit(lint_main(list(args)))
+
+
 if __name__ == "__main__":
     gordo()

@@ -32,6 +32,7 @@ import numpy as np
 import pandas as pd
 
 from .. import wire
+from ..analysis import lockcheck
 from ..observability import flightrec, spans, tracing
 from ..observability.registry import REGISTRY
 from ..resilience import deadline
@@ -100,7 +101,7 @@ class Client:
         # would tear the loop — and with it every kept-alive connection —
         # down between calls); both are created lazily on first use and
         # released by close()
-        self._io_lock = threading.Lock()
+        self._io_lock = lockcheck.named_lock("client.io")
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._loop_thread: Optional[threading.Thread] = None
         self._session = None

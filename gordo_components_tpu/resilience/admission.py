@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Optional
 
+from ..analysis import lockcheck
 from ..observability.registry import REGISTRY
 from . import deadline
 
@@ -80,7 +81,7 @@ class AdmissionController:
         self.max_queue = max(0, int(max_queue))
         self.queue_timeout = queue_timeout
         self.retry_after = retry_after
-        self._cond = threading.Condition()
+        self._cond = lockcheck.named_condition("server.admission")
         self._inflight = 0
         self._waiting = 0
         self._closed: Optional[str] = None

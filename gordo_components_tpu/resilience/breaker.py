@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..analysis import lockcheck
 from ..observability.registry import REGISTRY
 
 CLOSED = "closed"
@@ -82,7 +83,7 @@ class CircuitBreaker:
         self.min_calls = max(1, int(min_calls))
         self.recovery_time = recovery_time
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("resilience.breaker")
         self._state = CLOSED
         self._outcomes: list = []  # rolling 1/0 window, newest last
         self._opened_at = 0.0
@@ -179,7 +180,7 @@ class BreakerBoard:
     def __init__(self, **defaults):
         self._defaults = defaults
         self._breakers: Dict[str, CircuitBreaker] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("resilience.breaker_board")
 
     def get(self, name: str, **overrides) -> CircuitBreaker:
         with self._lock:

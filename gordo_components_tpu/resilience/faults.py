@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..analysis import lockcheck
 from ..observability.registry import REGISTRY
 
 logger = logging.getLogger(__name__)
@@ -78,7 +79,7 @@ class _Rule:
         )
 
 
-_lock = threading.Lock()
+_lock = lockcheck.named_lock("resilience.faults")
 _rules: List[_Rule] = []
 _configured = False  # has configure()/clear() run (beats lazy env read)
 

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ..analysis import lockcheck
 from ..observability.registry import REGISTRY
 
 _M_EVENTS = REGISTRY.counter(
@@ -47,7 +48,7 @@ class Quarantine:
     def __init__(self, cooldown: float = 30.0, clock=time.monotonic):
         self.cooldown = cooldown
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("resilience.quarantine")
         self._hard: Dict[str, Dict[str, Any]] = {}
         self._soft: Dict[str, Dict[str, Any]] = {}
 

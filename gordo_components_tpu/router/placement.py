@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence
 
+from ..analysis import lockcheck
+
 
 def _hash64(key: str) -> int:
     """Stable 64-bit ring coordinate. SHA-1, not ``hash()``: Python string
@@ -186,7 +188,7 @@ class Placement:
         self.hot_window_s = float(hot_window_s)
         self._pinned_hot = set(hot)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("router.placement")
         self._rates: Dict[str, _RateWindow] = {}
         self._hot: set = set(self._pinned_hot)
         self._rotation: Dict[str, int] = {}

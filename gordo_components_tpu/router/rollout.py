@@ -33,9 +33,10 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-logger = logging.getLogger(__name__)
-
+from ..analysis import lockcheck
 from ..observability.registry import REGISTRY
+
+logger = logging.getLogger(__name__)
 
 _M_ROLLOUTS = REGISTRY.counter(
     "gordo_router_rollouts_total",
@@ -71,12 +72,12 @@ class RolloutManager:
 
             session = requests.Session()
         self._session = session
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("router.rollout_state")
         # at most ONE rollout/rollback at a time: the capacity contract
         # ("never dips more than 1/N") and the generation bookkeeping
         # both assume the sweep is the only reload traffic — a second
         # concurrent POST must answer "busy", not interleave
-        self._op_lock = threading.Lock()
+        self._op_lock = lockcheck.named_lock("router.op")
         self._last: Optional[Dict[str, Any]] = None
 
     # -- worker verbs --------------------------------------------------------

@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional
 from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
+from ..analysis import lockcheck
 from ..observability import exposition, flightrec, spans, tracing
 from ..observability.registry import REGISTRY
 from ..watchman.control import DRAINING_HEADER, ControlPlane
@@ -132,7 +133,7 @@ class FleetRouter:
             models_root=models_root,
         )
         self._models_cache: Optional[List[str]] = None
-        self._models_lock = threading.Lock()
+        self._models_lock = lockcheck.named_lock("router.models")
         tracing.install_log_record_factory()
 
     # -- WSGI ----------------------------------------------------------------

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
 
+from ..analysis import lockcheck
 from ..observability.registry import REGISTRY
 
 logger = logging.getLogger(__name__)
@@ -176,7 +177,7 @@ class WorkerSupervisor:
             raise ValueError(f"duplicate worker names: {names}")
         self.specs = {spec.name: spec for spec in specs}
         self._factory = factory
-        self._lock = threading.Lock()
+        self._lock = lockcheck.named_lock("router.workers")
         self._workers: Dict[str, object] = {}
         self._respawns: Dict[str, int] = {name: 0 for name in self.specs}
 

@@ -79,6 +79,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import lockcheck
 from ..models.analysis import analyze_model
 from ..models.transformers import MinMaxScaler, StandardScaler
 from ..observability import spans
@@ -176,8 +177,10 @@ def _supports_donation(mesh) -> bool:
 # ONE lock per PROCESS for sharded dispatches: collective rendezvous (CPU
 # backend) aborts the process if two sharded executions interleave, and the
 # hazard spans engine GENERATIONS (a /reload warms a new engine while the
-# old one serves) — so the lock cannot live on the engine instance
-_SHARD_DISPATCH_LOCK = threading.Lock()
+# old one serves) — so the lock cannot live on the engine instance.
+# (named_lock: a plain threading.Lock unless GORDO_LOCKCHECK=1, when the
+# runtime order validator wraps it — docs/ARCHITECTURE.md §17)
+_SHARD_DISPATCH_LOCK = lockcheck.named_lock("engine.shard_dispatch")
 
 
 def _round_up_pow2(n: int, minimum: int = 1) -> int:
@@ -466,7 +469,7 @@ class _Bucket:
         # membership reads and every mutation go through this lock. Never
         # held across a device operation (the promotion gather runs
         # outside it, or routing would stall behind it).
-        self._hot_lock = threading.Lock()
+        self._hot_lock = lockcheck.named_lock("engine.hot")
         # idx -> times this machine's hot copy failed at dispatch and was
         # demoted; raises its re-promotion hit threshold exponentially so
         # a deterministically failing hot program can't oscillate
@@ -537,7 +540,7 @@ class _Bucket:
         self._mega_full = (
             self._mega_enabled and len(self.names) <= self._mega_cap
         )
-        self._mega_lock = threading.Lock()
+        self._mega_lock = lockcheck.named_lock("engine.mega")
         self._mega_slots: "OrderedDict[int, int]" = OrderedDict()
         if self._mega_full:
             self._mega_slots.update((i, i) for i in range(len(self.names)))
@@ -568,7 +571,7 @@ class _Bucket:
         # histogram, not dispatch latency (touched only under _busy / by
         # the warmup caller, like the hot-cache state above)
         self._fresh_programs: set = set()
-        self._cond = threading.Condition()
+        self._cond = lockcheck.named_condition("engine.bucket_cond")
         self._busy = False
         self._pending: Dict[int, List[_Item]] = {}
         # pipelined dispatch: the leader enqueues device executions (JAX
@@ -583,7 +586,7 @@ class _Bucket:
         # close() racing an active leader must neither strand a job
         # behind the shutdown sentinel nor leave two collectors draining
         # one queue (see _finish / close / _ensure_collector)
-        self._collector_lock = threading.Lock()
+        self._collector_lock = lockcheck.named_lock("engine.collector")
         self._retiring_collector: Optional[threading.Thread] = None
         # bounded dispatch stats (a long-lived server must not accumulate
         # per-dispatch history — cf. _Latency's keep cap)
@@ -871,7 +874,7 @@ class _Bucket:
             # invalid here, not fail live requests later. Sharded probes
             # take the collective-launch lock like any other dispatch.
             with self._dispatch_lock or contextlib.nullcontext():
-                jax.block_until_ready(loaded(*probe_args()))
+                jax.block_until_ready(loaded(*probe_args()))  # lint: allow-blocking(one-time vet of a deserialized executable; it must complete under the collective-launch lock before adoption, and runs only on boot/reload paths)
 
         loaded = self._compile_cache.get(ckey, probe=probe)
         if loaded is not None:
@@ -1243,7 +1246,7 @@ class _Bucket:
                     # either lands ahead of a shutdown sentinel (drained
                     # before the collector retires) or a fresh collector
                     # is spawned for it (discarding any stale sentinel)
-                    self._ensure_collector()
+                    self._ensure_collector()  # lint: allow-blocking(handover join: the retiring collector exits within its in-flight fetches and never takes this lock, so the join is deadlock-free and rarer than a reload)
                     self._fetch_queue.put(job)
             except BaseException as exc:
                 # a failed spawn (e.g. thread exhaustion under overload)

@@ -55,6 +55,7 @@ from werkzeug.exceptions import HTTPException, NotFound
 from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
+from ..analysis import lockcheck
 from ..models.anomaly.base import AnomalyDetectorBase
 from ..observability import exposition, flightrec, spans, tracing
 from ..observability.registry import REGISTRY
@@ -238,7 +239,7 @@ class _ServerState:
         compile_cache=None,
     ):
         self._inflight = 0
-        self._cond = threading.Condition()
+        self._cond = lockcheck.named_condition("server.state_cond")
         self.machines = machines
         self.single = (
             next(iter(machines.values())) if len(machines) == 1 else None
@@ -413,7 +414,7 @@ class ModelServer:
         # machines that sit outside models_root, or rename ones registered
         # under their metadata name rather than their dir basename)
         self._pinned = dict(machines) if models_root else {}
-        self._reload_lock = threading.Lock()
+        self._reload_lock = lockcheck.named_lock("server.reload")
         self._state = _ServerState(
             machines, shard_fleet=shard_fleet,
             compile_cache=self.compile_cache,

@@ -44,6 +44,48 @@ else:
 import numpy as np
 import pytest
 
+from gordo_components_tpu.analysis import lockcheck
+
+# Known SEED-DRIFT failures (jax 0.4.37 / jaxlib API drift, not
+# regressions — the set has been identical since the seed; see
+# README §Testing and CHANGES.md PR 6). They get a ``jax_drift`` marker
+# so tier-1 signal separates "seed drift" from real regressions
+# (compare with ``-m "not jax_drift"``) WITHOUT changing pass/fail
+# counts. EXACT test names on purpose: a fragment match would also
+# mark the healthy neighbors (e.g. test_patchtst_flash_kind_matches_
+# dense and the two ring-rejection tests PASS) and silently drop them
+# from the clean tier. tests/test_properties.py fails at collection
+# (import-time drift) and therefore cannot carry a marker.
+_JAX_DRIFT_TESTS = {
+    "test_flash_attention.py": frozenset({
+        "test_flash_matches_dense_forward",  # all parametrizations
+        "test_flash_short_seq_falls_back_to_dense",
+        "test_flash_asymmetric_blocks",
+        "test_flash_non_divisible_blocks",
+        "test_flash_matches_dense_gradients",
+        "test_flash_bfloat16_forward",
+        "test_flash_custom_scale_and_no_batch",
+    }),
+    "test_transformer.py": frozenset({
+        "test_ring_attention_matches_dense",
+        "test_ring_flash_composition_matches_dense",
+        "test_ring_attention_jit_and_grad",
+    }),
+    "test_aux.py": frozenset({
+        "test_initialize_multihost_single_process_noop",
+    }),
+    "test_cli.py": frozenset({  # slow tier
+        "test_cli_fleet_build_multihost_flags",
+    }),
+}
+
+
+def _is_jax_drift(item) -> bool:
+    names = _JAX_DRIFT_TESTS.get(item.fspath.basename)
+    if not names:
+        return False
+    return item.name.split("[", 1)[0] in names
+
 
 def pytest_collection_modifyitems(session, config, items):
     """Run the compile-heaviest modules FIRST. jaxlib 0.9.0 intermittently
@@ -84,6 +126,9 @@ def pytest_collection_modifyitems(session, config, items):
     items.sort(
         key=lambda item: 0 if item.fspath.basename in front else 1
     )
+    for item in items:
+        if _is_jax_drift(item):
+            item.add_marker(pytest.mark.jax_drift)
 
 
 _tests_since_cache_clear = 0
@@ -113,3 +158,93 @@ def pytest_runtest_teardown(item, nextitem):
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+# -- runtime lock-order validation (GORDO_LOCKCHECK=1) -----------------------
+# The named locks wrapped by analysis/lockcheck record real acquisition
+# orders while the suite exercises the concurrency paths; any order the
+# declared hierarchy (analysis/locks.py) forbids fails the test that
+# produced it — static analysis proposes, this runtime witness confirms.
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_guard():
+    if not lockcheck.enabled:
+        yield
+        return
+    before = len(lockcheck.violations())
+    yield
+    fresh = lockcheck.violations()[before:]
+    assert not fresh, (
+        "runtime lock-order violations (GORDO_LOCKCHECK):\n"
+        + "\n".join(fresh)
+    )
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _lockcheck_cycle_guard():
+    yield
+    if lockcheck.enabled:
+        problems = lockcheck.report()
+        assert not problems, (
+            "lock-order problems at session end (GORDO_LOCKCHECK):\n"
+            + "\n".join(problems)
+        )
+
+
+# -- thread hygiene ----------------------------------------------------------
+# Module-scoped leak detector for the engine/router/client concurrency
+# suites (opted in via ``pytestmark = pytest.mark.usefixtures(...)``):
+# after the module's teardown, no non-daemon thread may survive and no
+# gordo supervisor thread (bucket collectors, control plane, worker
+# supervisors, client I/O loops) may still be running. Collector threads
+# of merely-dropped engines exit via their weakref backstop within one
+# 5 s idle tick, so the check polls under a bounded deadline.
+
+
+@pytest.fixture(scope="module")
+def thread_hygiene():
+    import gc
+    import threading
+    import time as _time
+
+    before = set(threading.enumerate())
+    yield
+    gc.collect()
+
+    _GORDO_THREADS = (
+        "gordo-bucket-collector", "gordo-control-plane", "gordo-client-io",
+        "gordo-worker", "gordo-drain", "gordo-router-stop",
+    )
+
+    def offenders():
+        out = []
+        for thread in threading.enumerate():
+            if thread in before or not thread.is_alive():
+                continue
+            if not thread.daemon:
+                out.append(thread)
+            elif thread.name.startswith(_GORDO_THREADS):
+                out.append(thread)
+        return out
+
+    # a dropped (not close()d) engine's collector exits via its 5 s
+    # idle-tick weakref backstop — but under cold-cache compile load
+    # that tick can land late (observed >12 s on a loaded 2-core rig),
+    # so JOIN the stragglers under a generous deadline instead of
+    # sleep-polling a tight one; a real leak still fails, just slower
+    deadline = _time.monotonic() + 30.0
+    while True:
+        leaked = offenders()
+        if not leaked or _time.monotonic() >= deadline:
+            break
+        gc.collect()
+        for thread in leaked:
+            thread.join(timeout=max(0.1, deadline - _time.monotonic()))
+    leaked = [
+        f"{'non-daemon' if not t.daemon else 'supervisor'} {t.name!r}"
+        for t in offenders()
+    ]
+    assert not leaked, (
+        "threads leaked past module teardown: " + ", ".join(leaked)
+    )

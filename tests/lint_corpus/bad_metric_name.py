@@ -1,0 +1,35 @@
+"""Seeded-bad corpus for the metrics-conventions checker: a counter
+without ``_total``, a histogram without a unit, an unknown component,
+a label outside the §7 allowlist, and an f-string label value
+(unbounded cardinality). The last declaration is fully conventional
+and must NOT be flagged."""
+
+from gordo_components_tpu.observability.registry import REGISTRY
+
+_BAD_COUNTER = REGISTRY.counter(
+    "gordo_engine_retries",  # BAD: counter must end _total
+    "retries",
+)
+_BAD_HISTOGRAM = REGISTRY.histogram(
+    "gordo_engine_dispatch_latency",  # BAD: histogram needs a unit suffix
+    "latency",
+)
+_BAD_COMPONENT = REGISTRY.counter(
+    "gordo_flubber_requests_total",  # BAD: no such component
+    "mystery layer",
+)
+_BAD_LABEL = REGISTRY.counter(
+    "gordo_engine_oopsies_total",
+    "labelled off-list",
+    labels=("customer_id",),  # BAD: not in the §7 allowlist
+)
+_GOOD = REGISTRY.counter(
+    "gordo_engine_corpus_total",
+    "entirely conventional",
+    labels=("outcome",),
+)
+
+
+def record(trace_id: str) -> None:
+    _GOOD.labels(f"req-{trace_id}").inc()  # BAD: unbounded label value
+    _GOOD.labels("ok").inc()  # fine: closed enum value

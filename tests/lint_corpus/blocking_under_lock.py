@@ -1,0 +1,46 @@
+"""Seeded-bad corpus: blocking calls under a HOT lock, direct and one
+level down, plus an escape hatch with an empty reason (itself a
+finding) and a valid escape hatch (suppressed). Scanned under the
+pretend path gordo_components_tpu/server/engine.py."""
+
+import threading
+import time
+
+import jax
+
+
+class BadBucket:
+    def __init__(self):
+        self._hot_lock = threading.Lock()
+        self._collector = None
+        self._session = None
+
+    def fetch_under_lock(self, outputs):
+        with self._hot_lock:
+            return jax.device_get(outputs)  # BAD: device fetch under hot lock
+
+    def sleep_under_lock(self):
+        with self._hot_lock:
+            time.sleep(0.1)  # BAD: sleep under hot lock
+
+    def join_via_helper(self):
+        with self._hot_lock:
+            self._stop_collector()  # BAD: hides a join one level down
+
+    def _stop_collector(self):
+        if self._collector is not None:
+            self._collector.join()
+
+    def http_as_context_manager(self, url):
+        # blocking call spelled as a with-item: evaluates under the
+        # hot lock acquired by the first item
+        with self._hot_lock, self._session.post(url) as response:  # BAD
+            return response
+
+    def empty_reason(self, outputs):
+        with self._hot_lock:
+            return jax.device_get(outputs)  # lint: allow-blocking()
+
+    def good_reason(self, outputs):
+        with self._hot_lock:
+            return jax.device_get(outputs)  # lint: allow-blocking(corpus: deliberate, reason given)

@@ -1,0 +1,27 @@
+"""Seeded-bad corpus: a lock-order INVERSION the lock-discipline
+checker must catch. Scanned by tests/test_lint.py under the pretend
+path gordo_components_tpu/server/engine.py, so the attribute names
+below resolve to the declared engine locks (analysis/locks.py):
+``_dispatch_lock`` = engine.shard_dispatch (rank 90),
+``_hot_lock`` = engine.hot (rank 80) — acquiring the hot lock inside
+the shard lock is rank-decreasing and must be flagged."""
+
+import threading
+
+
+class BadBucket:
+    def __init__(self):
+        self._dispatch_lock = threading.Lock()
+        self._hot_lock = threading.Lock()
+        self._hot = {}
+
+    def dispatch_then_route(self, idx):
+        with self._dispatch_lock:          # rank 90 first ...
+            with self._hot_lock:           # ... then rank 80: INVERSION
+                return self._hot.get(idx)
+
+    def compact_inversion(self, idx):
+        # the multi-item form acquires left to right — same inversion,
+        # and it must be flagged exactly like the nested spelling
+        with self._dispatch_lock, self._hot_lock:
+            return self._hot.get(idx)

@@ -1,0 +1,37 @@
+"""Seeded-bad corpus: a thread seam whose target records spans AND
+logs without binding a SpanContext — the PR 4 trace-loss class the
+span-seam checker exists for. Scanned under the pretend path
+gordo_components_tpu/server/engine.py. ``well_bound`` shows the
+passing shape (capture at enqueue)."""
+
+import logging
+import threading
+
+from gordo_components_tpu.observability import spans
+
+logger = logging.getLogger(__name__)
+
+
+def _fan_out(results):
+    with spans.stage("fetch"):  # BAD: contextvar-based, nothing bound
+        for item in results:
+            logger.info("fanned out %s", item)
+
+
+def start_unbound(results):
+    thread = threading.Thread(target=_fan_out, args=(results,))
+    thread.start()
+    return thread
+
+
+def start_bound(results):
+    ctx = spans.capture()  # enqueue-side capture: the passing shape
+
+    def _bound_fan_out():
+        with spans.bind(ctx):
+            for item in results:
+                logger.info("fanned out %s", item)
+
+    thread = threading.Thread(target=_bound_fan_out)
+    thread.start()
+    return thread

@@ -1,0 +1,8 @@
+"""Seeded-bad corpus for the knob-registry checker: reads of a
+``GORDO_*`` env var that analysis/knobs.py does not declare. The
+registered read must NOT be flagged."""
+
+import os
+
+UNDECLARED = os.environ.get("GORDO_CORPUS_MYSTERY_KNOB", "7")  # BAD
+DECLARED = os.environ.get("GORDO_DISPATCH_DEPTH")  # fine: registered

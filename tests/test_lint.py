@@ -1,0 +1,286 @@
+"""The analysis framework (gordo lint, docs/ARCHITECTURE.md §17):
+every seeded-bad corpus snippet is caught by its intended checker, the
+good shapes are NOT flagged, the baseline suppress/expiry round-trip
+works, the metric-name grammar and knob registry behave, the runtime
+lock validator witnesses inversions — and the real tree lints clean
+(zero non-baselined findings), which is the repo's own gate run as a
+test."""
+
+import os
+import threading
+
+import pytest
+
+from gordo_components_tpu.analysis import (
+    knob_registry,
+    knobs,
+    lock_discipline,
+    lockcheck,
+    metrics_conventions,
+    span_seam,
+)
+from gordo_components_tpu.analysis.astscan import parse_module
+from gordo_components_tpu.analysis.findings import Baseline, Finding
+from gordo_components_tpu.analysis.runner import repo_root, run_lint
+
+CORPUS = os.path.join(os.path.dirname(__file__), "lint_corpus")
+# corpus files are scanned under a pretend engine path so their
+# attribute names resolve to the declared engine locks
+ENGINE_RELPATH = "gordo_components_tpu/server/engine.py"
+
+
+def _corpus(filename, relpath=ENGINE_RELPATH):
+    module = parse_module(os.path.join(CORPUS, filename), relpath)
+    assert module is not None, f"corpus file {filename} failed to parse"
+    return module
+
+
+# -- corpus: each snippet caught by its intended checker ---------------------
+
+
+def test_corpus_lock_inversion_caught():
+    findings = lock_discipline.check(_corpus("lock_inversion.py"))
+    inversions = {
+        f.key for f in findings if f.code == "lock-order-inversion"
+    }
+    # nested form AND the compact multi-item `with a, b:` form
+    assert any(
+        "engine.shard_dispatch->engine.hot" in key
+        and "dispatch_then_route" in key
+        for key in inversions
+    ), findings
+    assert any(
+        "engine.shard_dispatch->engine.hot" in key
+        and "compact_inversion" in key
+        for key in inversions
+    ), findings
+
+
+def test_corpus_blocking_under_lock_caught():
+    findings = lock_discipline.check(_corpus("blocking_under_lock.py"))
+    by_code = {}
+    for finding in findings:
+        by_code.setdefault(finding.code, []).append(finding)
+    blocking = by_code.get("blocking-under-lock", [])
+    # direct device fetch, direct sleep, and the join hidden one call
+    # down must all be caught; the well-reasoned escape hatch must NOT
+    keys = " | ".join(f.key for f in blocking)
+    assert "jax.device_get" in keys
+    assert "time.sleep" in keys
+    assert "_stop_collector" in keys and "join" in keys
+    # HTTP spelled as a with-item context manager still counts
+    assert "http_as_context_manager" in keys
+    assert "good_reason" not in keys
+    # the empty-reason escape hatch is itself a finding
+    assert by_code.get("empty-escape-reason"), findings
+
+
+def test_corpus_unbound_seam_caught():
+    findings = span_seam.check(_corpus("unbound_seam.py"))
+    assert any(
+        f.code == "unbound-seam" and "_fan_out" in f.key for f in findings
+    ), findings
+    # the capture-at-enqueue shape passes
+    assert not any("start_bound" in f.key for f in findings), findings
+
+
+def test_corpus_bad_metric_names_caught():
+    findings = metrics_conventions.check(
+        _corpus("bad_metric_name.py", relpath="gordo_components_tpu/x.py")
+    )
+    keys = {(f.code, f.key) for f in findings}
+    assert ("bad-metric-name", "gordo_engine_retries") in keys
+    assert ("bad-metric-name", "gordo_engine_dispatch_latency") in keys
+    assert ("bad-metric-name", "gordo_flubber_requests_total") in keys
+    assert ("unknown-label", "gordo_engine_oopsies_total:customer_id") in keys
+    assert any(code == "unbounded-label-value" for code, _ in keys)
+    # the conventional declaration and closed-enum labels() pass
+    assert not any(
+        key == "gordo_engine_corpus_total" for code, key in keys
+        if code == "bad-metric-name"
+    )
+
+
+def test_corpus_unregistered_knob_caught():
+    findings = knob_registry.check(
+        _corpus("unregistered_knob.py", relpath="tests/x.py")
+    )
+    keys = {f.key for f in findings}
+    # split literals: the blanket knob rule scans THIS file too, and the
+    # corpus knob must stay unregistered for the test to mean anything
+    assert "GORDO_CORPUS_" + "MYSTERY_KNOB" in keys
+    assert knobs.get("GORDO_DISPATCH_DEPTH") is not None
+    assert not (keys & set(knobs.KNOBS))
+
+
+# -- baseline: suppress + expiry round-trip ----------------------------------
+
+
+def _finding(key="k1"):
+    return Finding(
+        checker="c", code="x", file="f.py", line=3, key=key, message="m"
+    )
+
+
+def test_baseline_suppresses_and_expires(tmp_path):
+    path = str(tmp_path / "lint_baseline.json")
+    baseline = Baseline(path=path)
+    baseline.entries[_finding().ident] = "kept: reasons"
+    baseline.save()
+
+    reloaded = Baseline.load(path)
+    # matching finding -> suppressed, nothing fresh
+    fresh, suppressed = reloaded.split([_finding()])
+    assert not fresh
+    assert len(suppressed) == 1
+
+    # finding fixed -> the stale entry itself becomes a finding
+    fresh, suppressed = reloaded.split([])
+    assert not suppressed
+    assert len(fresh) == 1
+    assert fresh[0].code == "stale-entry"
+    assert _finding().ident in fresh[0].message
+
+    # a NEW violation is never absorbed by an unrelated entry
+    fresh, _ = reloaded.split([_finding(), _finding(key="k2")])
+    assert [f.key for f in fresh] == ["k2"]
+
+
+def test_baseline_ident_is_line_free():
+    a = _finding()
+    b = Finding(checker="c", code="x", file="f.py", line=999, key="k1",
+                message="moved")
+    assert a.ident == b.ident
+
+
+# -- grammar / registry units ------------------------------------------------
+
+
+def test_metric_name_grammar():
+    check = metrics_conventions.check_name
+    assert check("gordo_engine_requests_total", "counter") is None
+    assert check("gordo_engine_dispatch_seconds", "histogram") is None
+    assert check("gordo_engine_machines", "gauge") is None
+    # idiomatic Prometheus: unit-suffixed gauges are fine
+    assert check("gordo_build_duration_seconds", "gauge") is None
+    assert check("gordo_engine_requests", "counter") is not None
+    assert check("gordo_engine_latency", "histogram") is not None
+    assert check("gordo_engine_stuff_total", "gauge") is not None
+    assert check("engine_requests_total", "counter") is not None
+    assert check("gordo_nonsense_requests_total", "counter") is not None
+
+
+def test_family_name_strips_exposition_suffixes():
+    check = metrics_conventions.check_family_name
+    assert check("gordo_server_request_duration_seconds_count") is None
+    assert check("gordo_engine_dispatch_seconds_bucket") is None
+    assert check("gordo_mystery_thing_count") is not None
+
+
+def test_knob_registry_covers_the_lockcheck_knob():
+    assert knobs.get("GORDO_LOCKCHECK") is not None
+    table = knobs.render_markdown_table()
+    assert "| `GORDO_LOCKCHECK` |" in table
+    assert table.startswith("| knob | default | meaning |")
+
+
+# -- runtime lock validator --------------------------------------------------
+
+
+def test_lockcheck_witnesses_inversion():
+    lockcheck.reset()
+    try:
+        outer = lockcheck.TrackedLock("engine.shard_dispatch")
+        inner = lockcheck.TrackedLock("engine.hot")
+        with outer:
+            with inner:
+                pass
+        violations = lockcheck.violations()
+        assert len(violations) == 1
+        assert "engine.hot" in violations[0]
+        assert "engine.shard_dispatch" in violations[0]
+        assert ("engine.shard_dispatch", "engine.hot") in (
+            lockcheck.observed_edges()
+        )
+    finally:
+        lockcheck.reset()
+
+
+def test_lockcheck_allows_declared_order_and_condition_wait():
+    lockcheck.reset()
+    try:
+        low = lockcheck.TrackedLock("engine.collector")
+        high = lockcheck.TrackedLock("engine.shard_dispatch")
+        with low:
+            with high:
+                pass
+        # condition wait drops the lock: a notify-side acquisition
+        # during the wait must NOT read as nested under the waiter
+        cond = threading.Condition(lockcheck.TrackedLock("engine.bucket_cond"))
+        flag = {"set": False}
+
+        def notifier():
+            with lockcheck.TrackedLock("engine.shard_dispatch"):
+                pass  # unrelated higher-rank work on the other thread
+            with cond:
+                flag["set"] = True
+                cond.notify_all()
+
+        thread = threading.Thread(target=notifier)
+        with cond:
+            thread.start()
+            while not flag["set"]:
+                cond.wait(timeout=5.0)
+        thread.join(timeout=5.0)
+        assert lockcheck.violations() == []
+    finally:
+        lockcheck.reset()
+
+
+def test_lockcheck_cycle_detection():
+    cycle = lockcheck._find_cycle({("a", "b"), ("b", "c"), ("c", "a")})
+    assert cycle is not None
+    assert cycle[0] == cycle[-1]
+    assert lockcheck._find_cycle({("a", "b"), ("b", "c")}) is None
+
+
+def test_named_lock_is_plain_when_disabled(monkeypatch):
+    if lockcheck.enabled:
+        pytest.skip("GORDO_LOCKCHECK=1 run: factories return tracked locks")
+    lock = lockcheck.named_lock("engine.hot")
+    assert type(lock) is type(threading.Lock())
+
+
+def test_undeclared_lock_name_rejected():
+    with pytest.raises(ValueError, match="not declared"):
+        lockcheck.TrackedLock("engine.no_such_lock")
+
+
+def test_stale_knob_not_masked_by_generated_readme_table():
+    """The generated README knob table always contains every registered
+    knob, so it must NOT count as a 'mention' — otherwise the stale
+    check is circular and dead knobs live forever."""
+    fake = "GORDO_TEST_" + "ONLY_FAKE_KNOB"
+    knobs.KNOBS[fake] = knobs.Knob(
+        name=fake, default="0", parser="bool", doc="corpus-only",
+        component="test",
+    )
+    try:
+        findings = run_lint(repo_root())
+        assert any(
+            f.code == "stale-knob" and f.key == fake for f in findings
+        ), [f.render() for f in findings if f.checker == "knob-registry"]
+    finally:
+        del knobs.KNOBS[fake]
+
+
+# -- the real tree lints clean -----------------------------------------------
+
+
+def test_tree_is_lint_clean():
+    """The repo's own gate, as a test: zero non-baselined findings."""
+    root = repo_root()
+    findings = run_lint(root)
+    baseline = Baseline.load(os.path.join(root, "lint_baseline.json"))
+    fresh, _ = baseline.split(findings)
+    assert not fresh, "\n" + "\n".join(f.render() for f in fresh)

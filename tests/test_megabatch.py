@@ -17,6 +17,11 @@ from gordo_components_tpu.server.engine import (
     _megabatch_residency_cap,
 )
 
+# module-wide thread-hygiene gate (tests/conftest.py): after this
+# module's teardown no non-daemon thread and no gordo supervisor
+# (collector/control-plane/worker/client-io) may still be running
+pytestmark = pytest.mark.usefixtures("thread_hygiene")
+
 
 @pytest.fixture(scope="module")
 def models():

@@ -29,6 +29,11 @@ from gordo_components_tpu.router import (
     worker_specs,
 )
 
+# module-wide thread-hygiene gate (tests/conftest.py): after this
+# module's teardown no non-daemon thread and no gordo supervisor
+# (collector/control-plane/worker/client-io) may still be running
+pytestmark = pytest.mark.usefixtures("thread_hygiene")
+
 KEYS = [f"machine-{i:03d}" for i in range(200)]
 
 

@@ -12,6 +12,11 @@ import pytest
 from gordo_components_tpu.serializer import pipeline_from_definition
 from gordo_components_tpu.server.engine import ServingEngine, _dispatch_depth
 
+# module-wide thread-hygiene gate (tests/conftest.py): after this
+# module's teardown no non-daemon thread and no gordo supervisor
+# (collector/control-plane/worker/client-io) may still be running
+pytestmark = pytest.mark.usefixtures("thread_hygiene")
+
 CONFIG = {
     "DiffBasedAnomalyDetector": {
         "base_estimator": {

@@ -98,6 +98,24 @@ def validate(text: str, require_gordo: bool = False) -> int:
         if missing:
             print(f"MISSING required series: {missing}", file=sys.stderr)
             return 1
+        # every gordo_* family must fit the metrics-conventions name
+        # grammar — the SAME grammar `gordo lint` checks declarations
+        # with (gordo_components_tpu/analysis/metrics_conventions.py),
+        # so the static and live checks cannot drift apart
+        from gordo_components_tpu.analysis.metrics_conventions import (
+            check_family_name,
+        )
+
+        bad_names = [
+            error
+            for name in sorted(samples)
+            if name.startswith("gordo_")
+            and (error := check_family_name(name)) is not None
+        ]
+        if bad_names:
+            for error in bad_names:
+                print(f"BAD metric name: {error}", file=sys.stderr)
+            return 1
         if not exemplars:
             # a warm traced request just ran (--spawn) or the operator
             # asked for the full gordo contract: at least one histogram
