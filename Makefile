@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke smoke images builder-image server-image watchman-image
 
 # invariant linter (docs/ARCHITECTURE.md §17): lock discipline against
 # the declared hierarchy, blocking-calls-under-hot-locks, unbound
@@ -81,10 +81,20 @@ megabatch-smoke:
 router-smoke:
 	JAX_PLATFORMS=cpu python tools/router_smoke.py
 
+# fleet observability check: 2 real worker processes behind the router —
+# a routed request renders ONE merged two-lane Perfetto trace (router +
+# placed worker, clock-aligned, pull fallback for truncated stitches),
+# the aggregate scrape parses with worker labels + merged buckets, and
+# injected dispatch latency trips the fast-window burn-rate crossing
+# (quiet without faults)
+slo-smoke:
+	JAX_PLATFORMS=cpu python tools/slo_smoke.py
+
 # the full smoke battery: invariant lint + exposition + resilience +
 # store integrity + serving data plane + span attribution + cold-start
 # economics + cross-machine megabatching + the horizontal serving tier
-smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke
+# + the fleet observability plane (stitching / aggregation / SLO)
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke
 
 images: builder-image server-image watchman-image
 

@@ -114,6 +114,75 @@ def effective_env() -> dict:
         "wire_formats": ["json", "fast_json", "npz"],
         "npz_content_type": wire.NPZ_CONTENT_TYPE,
         "flightrec": RECORDER.enabled,
+        # the SLO engine knobs that shaped the run's slo block (§18) —
+        # resolved by the engine itself, so the history row can never
+        # record a default the engine doesn't actually use
+        "slo": _slo_knob_summary(),
+    }
+
+
+def _slo_knob_summary() -> dict:
+    from gordo_components_tpu.observability import slo as slo_engine
+
+    return slo_engine.knob_summary()
+
+
+def begin_slo_watch():
+    """An evaluator whose baseline sample predates the measured traffic,
+    so the end-of-run burn rates cover exactly this run. The bench
+    drives ``engine.anomaly`` directly (no HTTP layer), so alongside the
+    standard server objectives (which stay zero here — honest about what
+    the bench exercises) it watches an ENGINE-level latency objective
+    over the dispatch histogram the run actually feeds. None when the
+    engine is knobbed off."""
+    from gordo_components_tpu.observability import slo as slo_engine
+
+    if not slo_engine.enabled():
+        return None
+    threshold_s, target = slo_engine.latency_knobs()
+    objectives = slo_engine.server_objectives() + [
+        slo_engine.Objective(
+            name="engine-dispatch-latency",
+            kind="latency",
+            metric="gordo_engine_dispatch_seconds",
+            target=target,
+            threshold_s=threshold_s,
+            description=(
+                f"bench: {target:.0%} of device dispatches under "
+                f"{threshold_s * 1000:.0f} ms"
+            ),
+        )
+    ]
+    return slo_engine.SLOEvaluator(objectives)
+
+
+def end_slo_watch(evaluator) -> dict:
+    """Final tick + snapshot: objective attainment and fast/slow burn
+    rates at end of run — the history-row `slo` block."""
+    if evaluator is None:
+        return {"enabled": False}
+    evaluator.tick()
+    snapshot = evaluator.snapshot()
+    return {
+        "enabled": True,
+        "objectives": [
+            {
+                "name": objective["name"],
+                "target": objective["target"],
+                "attainment": objective["attainment"],
+                "good": objective["good"],
+                "total": objective["total"],
+                "burn_rates": {
+                    window: stats["burn_rate"]
+                    for window, stats in objective["windows"].items()
+                },
+                "breaches": {
+                    window: stats["breaches"]
+                    for window, stats in objective["windows"].items()
+                },
+            }
+            for objective in snapshot["objectives"]
+        ],
     }
 
 
@@ -895,6 +964,13 @@ def main() -> None:
     require_live_backend_or_cpu_fallback("bench_serving.py")
     enable_persistent_compile_cache()
 
+    # SLO watch brackets the whole run: the baseline sample lands before
+    # the first measured request, so end-of-run burn rates attribute to
+    # THIS run's traffic (guarded — the watch must never cost a run)
+    try:
+        slo_watch = begin_slo_watch()
+    except Exception:
+        slo_watch = None
     result = measure(**resolve_sizes(degraded))
     # horizontal serving tier: 1 vs N worker PROCESSES behind the router
     # at 12-thread saturation (real subprocess boots — the only block
@@ -912,6 +988,13 @@ def main() -> None:
     from gordo_components_tpu.observability.registry import REGISTRY
 
     result["metrics"] = REGISTRY.snapshot()
+    # objective attainment + burn rates at end of run (§18): the
+    # serving history now says not just how fast, but whether the run
+    # MET its declared latency/availability objectives
+    try:
+        result["slo"] = end_slo_watch(slo_watch)
+    except Exception:
+        pass
     # one attributable history row per standalone run: explicit BENCH_*
     # overrides AND the resolved knobs (dispatch depth, device, shard
     # mode, wire formats) that shaped the numbers. The whole block is
@@ -944,6 +1027,8 @@ def main() -> None:
             # saturation + per-worker fusion ratios (the GIL-escape
             # headline)
             "multi_worker": result.get("multi_worker"),
+            # objective attainment + burn rates at end of run (§18)
+            "slo": result.get("slo"),
         })
     except Exception:
         pass  # history is never worth failing an artifact over

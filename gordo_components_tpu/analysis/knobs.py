@@ -107,6 +107,44 @@ KNOBS: Dict[str, Knob] = dict(
         _knob("GORDO_DEBUG_NANS", "0", "bool",
               "jax_debug_nans: re-run op-by-op at the first NaN "
               "(diagnostics only; `--debug-nans`)", "observability"),
+        _knob("GORDO_TIMELINE_MAX_BYTES", "8192", "int",
+              "trace stitching: size cap for the worker's "
+              "`X-Gordo-Timeline` response header (past it the router "
+              "pulls the timeline from the worker instead)",
+              "observability"),
+        _knob("GORDO_ROUTER_AGGREGATE", "1", "bool",
+              "router scrape-of-scrapes: `0` makes "
+              "`/metrics?aggregate=1` serve the router registry only "
+              "(no worker fan-out scrape)", "observability"),
+        _knob("GORDO_SLO", "1", "bool",
+              "SLO engine: `0` disables evaluation (`/slo` answers "
+              "disabled, no `gordo_slo_*` series)", "observability"),
+        _knob("GORDO_SLO_LATENCY_MS", "250", "float",
+              "latency objective threshold: scoring/route requests "
+              "should finish under this many milliseconds",
+              "observability"),
+        _knob("GORDO_SLO_LATENCY_TARGET", "0.99", "float",
+              "latency objective: fraction of requests that must meet "
+              "the threshold", "observability"),
+        _knob("GORDO_SLO_AVAILABILITY_TARGET", "0.999", "float",
+              "availability objective: fraction of requests that must "
+              "not error (5xx / unroutable)", "observability"),
+        _knob("GORDO_SLO_FAST_WINDOW", "300", "float",
+              "fast burn-rate window seconds (the page-now signal)",
+              "observability"),
+        _knob("GORDO_SLO_SLOW_WINDOW", "3600", "float",
+              "slow burn-rate window seconds (the sustained-burn "
+              "signal)", "observability"),
+        _knob("GORDO_SLO_FAST_BURN", "14.4", "float",
+              "burn-rate threshold whose crossing on the fast window "
+              "fires a breach event", "observability"),
+        _knob("GORDO_SLO_SLOW_BURN", "6.0", "float",
+              "burn-rate threshold whose crossing on the slow window "
+              "fires a breach event", "observability"),
+        _knob("GORDO_SLO_EVAL_INTERVAL", "10", "float",
+              "min seconds between scrape-driven SLO evaluation ticks "
+              "(`/metrics` and `/slo` reads piggyback evaluation)",
+              "observability"),
         # -- store -------------------------------------------------------
         _knob("GORDO_STORE_KEEP_GENERATIONS", "3", "int",
               "generations kept per machine after a commit prunes old "

@@ -39,9 +39,11 @@ LOCK_RANKS: Dict[str, int] = {
     "router.placement": 50,     # placement.py ring + hot-tracking state
     "resilience.breaker_board": 55,  # breaker.py per-name board
     "resilience.breaker": 60,   # breaker.py one circuit's state
+    "router.stitch": 52,        # router.py truncated-stitch pull ledger
     "resilience.quarantine": 62,  # quarantine.py ledger
     "resilience.faults": 64,    # faults.py injection plan
     "client.io": 66,            # client.py pooled-loop lifecycle
+    "observability.slo": 68,    # slo.py evaluator history + breach state
     # -- engine data plane (innermost: these sit under everything above
     # via reload-time warmup and request-path scoring)
     "engine.bucket_cond": 70,   # _Bucket._cond leader/follower latch
@@ -61,6 +63,7 @@ HOT_LOCKS = frozenset(
         "server.state_cond",
         "router.models",
         "router.placement",
+        "router.stitch",
         "resilience.breaker_board",
         "resilience.breaker",
         "engine.bucket_cond",
@@ -90,6 +93,8 @@ LOCK_ATTRS: Dict[Tuple[str, str], str] = {
     ("resilience/quarantine.py", "_lock"): "resilience.quarantine",
     ("resilience/faults.py", "_lock"): "resilience.faults",
     ("router/router.py", "_models_lock"): "router.models",
+    ("router/router.py", "_stitch_lock"): "router.stitch",
+    ("observability/slo.py", "_lock"): "observability.slo",
     ("router/rollout.py", "_op_lock"): "router.op",
     ("router/rollout.py", "_lock"): "router.rollout_state",
     ("router/placement.py", "_lock"): "router.placement",

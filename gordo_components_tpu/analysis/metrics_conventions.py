@@ -32,16 +32,18 @@ CHECKER = "metrics-conventions"
 COMPONENTS = (
     "server", "engine", "client", "build", "builds", "fleet", "watchman",
     "router", "resilience", "store", "compile_cache", "span", "stage",
-    "drift", "lint",
+    "drift", "lint", "slo",
 )
 
 # §7 label allowlist: low-cardinality enums only. ``machine``/``worker``/
 # ``target`` are bounded by fleet/tier size — the documented exceptions.
+# ``window`` is the two-value fast/slow burn-rate window enum (§18).
 ALLOWED_LABELS = frozenset(
     {
         "endpoint", "status", "kind", "outcome", "path", "event", "phase",
         "reason", "stage", "name", "trigger", "format", "worker",
         "machine", "target", "cause", "point", "to", "where", "error",
+        "window",
     }
 )
 

@@ -844,6 +844,30 @@ def trace_dump_cmd(trace_id, base_url, output, fmt):
         click.echo(body)
 
 
+@gordo.command("slo")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+def slo_cmd(base_url):
+    """Objective attainment + burn rates from a live server's ``/slo``.
+
+    The SLO engine (ARCHITECTURE §18) evaluates declared latency and
+    availability objectives by multi-window burn rate over the
+    already-collected histograms; this verb is the operator view —
+    attainment per objective, fast/slow-window burn, breach counts, and
+    which span stage is eating the budget.
+    """
+    import requests
+
+    url = f"{base_url.rstrip('/')}/slo"
+    try:
+        response = requests.get(url, timeout=10)
+        response.raise_for_status()
+    except requests.RequestException as exc:
+        logger.error("Could not read /slo from %s: %s", base_url, exc)
+        sys.exit(1)
+    click.echo(json.dumps(response.json(), indent=2))
+
+
 @gordo.group("client")
 def client_group():
     """Bulk prediction against running servers."""

@@ -15,6 +15,15 @@ Three small modules every layer shares:
   fan-out; Chrome trace-event (Perfetto) export per trace.
 - :mod:`.flightrec` — the always-on bounded flight recorder behind
   ``/debug/requests`` (``RECORDER`` is the process instance).
+- :mod:`.stitch` — cross-process trace stitching: the worker stamps its
+  timeline onto the response (negotiated, size-capped), the router
+  merges it under its ``route`` span with clock alignment.
+- :mod:`.aggregate` — scrape-of-scrapes: merge N worker expositions
+  into one fleet exposition (counters summed, histogram buckets
+  merged, gauges per-worker-labeled, exemplars preserved).
+- :mod:`.slo` — declared latency/availability objectives evaluated by
+  multi-window burn rate over the collected histograms
+  (``gordo_slo_*`` series, ``/slo``).
 - :mod:`.logsetup` — text/JSON logging configuration for the CLI.
 """
 

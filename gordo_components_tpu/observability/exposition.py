@@ -243,11 +243,14 @@ def _parse_exemplar(line: str, lineno: int, types: Dict[str, str]):
 
 
 def parse_prometheus_text(
-    text: str, return_exemplars: bool = False
+    text: str, return_exemplars: bool = False, return_meta: bool = False
 ) -> Any:
     """Parse + validate exposition text; ``{name: [(labels, value), ...]}``
     (with ``return_exemplars=True``: ``(samples, exemplars)`` where
-    ``exemplars`` maps name → ``[(labels, exemplar_dict), ...]``).
+    ``exemplars`` maps name → ``[(labels, exemplar_dict), ...]``; with
+    ``return_meta=True``: ``(samples, exemplars, types, helps)`` — the
+    full family metadata the scrape-of-scrapes aggregator re-renders
+    from).
 
     Raises ``ValueError`` (with the offending line number) on any line
     that is neither a well-formed comment nor a well-formed sample, on a
@@ -259,6 +262,7 @@ def parse_prometheus_text(
     samples: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
     exemplars: Dict[str, List[Tuple[Dict[str, str], Dict[str, Any]]]] = {}
     types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
     for lineno, line in enumerate(text.splitlines(), start=1):
         line = line.rstrip()
         if not line:
@@ -271,6 +275,8 @@ def parse_prometheus_text(
                 raise ValueError(
                     f"line {lineno}: bad metric name in comment: {parts[2]!r}"
                 )
+            if parts[1] == "HELP":
+                helps[parts[2]] = parts[3] if len(parts) > 3 else ""
             if parts[1] == "TYPE":
                 kind = parts[3].strip() if len(parts) > 3 else ""
                 if kind not in ("counter", "gauge", "histogram", "summary",
@@ -315,6 +321,8 @@ def parse_prometheus_text(
                     f"histogram {name}: +Inf bucket {inf_buckets[key]} != "
                     f"count {count} for series {key or '(unlabeled)'}"
                 )
+    if return_meta:
+        return samples, exemplars, types, helps
     if return_exemplars:
         return samples, exemplars
     return samples

@@ -76,14 +76,17 @@ _timeline: ContextVar[Optional["Timeline"]] = ContextVar(
 
 
 class Span:
-    __slots__ = ("name", "start", "duration", "thread", "attrs")
+    __slots__ = ("name", "start", "duration", "thread", "process", "attrs")
 
     def __init__(self, name: str, start: float, duration: float,
-                 thread: str, attrs: Dict[str, Any]):
+                 thread: str, attrs: Dict[str, Any], process: str = ""):
         self.name = name
         self.start = start  # seconds since timeline start
         self.duration = duration
         self.thread = thread
+        # "" = this process; anything else is a STITCHED lane — a remote
+        # process's span merged in by the router (observability.stitch)
+        self.process = process
         self.attrs = attrs
 
 
@@ -128,10 +131,39 @@ class Timeline:
         with self._lock:
             self.spans.append(span)
 
+    def add_span_at(self, name: str, rel_start: float, duration: float,
+                    thread: str = "", process: str = "",
+                    **attrs: Any) -> None:
+        """Append a span at an already-TIMELINE-RELATIVE start — how a
+        stitched remote process's spans (whose perf_counter epoch means
+        nothing here) land in this timeline after clock alignment."""
+        if attrs:
+            attrs = {k: v for k, v in attrs.items() if v not in (None, "")}
+        span = Span(
+            name, max(0.0, rel_start), max(0.0, duration),
+            thread or threading.current_thread().name, attrs,
+            process=process,
+        )
+        with self._lock:
+            self.spans.append(span)
+
     def add_event(self, name: str, **attrs: Any) -> None:
         event = {
             "t": max(0.0, time.perf_counter() - self.started),
             "name": name,
+            **{k: v for k, v in attrs.items() if v not in (None, "")},
+        }
+        with self._lock:
+            self.events.append(event)
+
+    def add_event_at(self, name: str, rel_t: float, process: str = "",
+                     **attrs: Any) -> None:
+        """Timeline-relative point event (the stitching twin of
+        :meth:`add_span_at`)."""
+        event = {
+            "t": max(0.0, rel_t),
+            "name": name,
+            **({"process": process} if process else {}),
             **{k: v for k, v in attrs.items() if v not in (None, "")},
         }
         with self._lock:
@@ -161,9 +193,10 @@ class Timeline:
         return out
 
     # parent stages CONTAIN other stages (score wraps the whole engine
-    # call), so counting them in dominance would always blame the parent;
-    # they still appear in stage_seconds for the full picture
-    _PARENT_STAGES = frozenset({"score"})
+    # call; route wraps every stitched worker stage), so counting them in
+    # dominance would always blame the parent; they still appear in
+    # stage_seconds for the full picture
+    _PARENT_STAGES = frozenset({"score", "route"})
 
     def dominant_stage(self) -> str:
         stages = self.stage_seconds()
@@ -217,6 +250,7 @@ class Timeline:
                     "start_ms": round(span.start * 1000, 3),
                     "duration_ms": round(span.duration * 1000, 3),
                     "thread": span.thread,
+                    **({"process": span.process} if span.process else {}),
                     **span.attrs,
                 }
                 for span in spans
@@ -228,31 +262,46 @@ class Timeline:
         """Chrome trace-event JSON (the ``chrome://tracing`` / Perfetto
         format): complete (``ph: "X"``) events in microseconds, one track
         per recording thread, instant (``ph: "i"``) events for the point
-        events. ``json.dumps`` of the result is directly loadable."""
+        events. STITCHED spans (``Span.process`` set — another process's
+        timeline merged in by the router) render as their own process
+        lane (pid 2+), so one export shows router and worker side by
+        side. ``json.dumps`` of the result is directly loadable."""
         base_us = self.started_wall * 1e6
         with self._lock:
             spans = list(self.spans)
             events = list(self.events)
-        trace_events: List[Dict[str, Any]] = [
-            {
-                "ph": "M",
-                "pid": 1,
-                "name": "process_name",
-                "args": {"name": f"gordo trace {self.trace_id}"},
-            }
-        ]
-        threads = {span.thread for span in spans}
-        tids = {name: i + 1 for i, name in enumerate(sorted(threads))}
-        for name, tid in tids.items():
+        # process lanes: "" (this process) is always pid 1; every
+        # distinct stitched process label gets its own pid after it
+        remote = sorted(
+            {span.process for span in spans if span.process}
+            | {e["process"] for e in events if e.get("process")}
+        )
+        pids = {"": 1, **{name: i + 2 for i, name in enumerate(remote)}}
+        local_label = str(
+            self.meta.get("service") or f"gordo trace {self.trace_id}"
+        )
+        trace_events: List[Dict[str, Any]] = []
+        for process, pid in sorted(pids.items(), key=lambda kv: kv[1]):
             trace_events.append({
-                "ph": "M", "pid": 1, "tid": tid,
-                "name": "thread_name", "args": {"name": name},
+                "ph": "M",
+                "pid": pid,
+                "name": "process_name",
+                "args": {"name": process or local_label},
+            })
+        threads = sorted({(span.process, span.thread) for span in spans})
+        tids = {key: i + 1 for i, key in enumerate(threads)}
+        for (process, thread), tid in sorted(
+            tids.items(), key=lambda kv: kv[1]
+        ):
+            trace_events.append({
+                "ph": "M", "pid": pids[process], "tid": tid,
+                "name": "thread_name", "args": {"name": thread},
             })
         for span in spans:
             trace_events.append({
                 "ph": "X",
-                "pid": 1,
-                "tid": tids.get(span.thread, 0),
+                "pid": pids[span.process],
+                "tid": tids.get((span.process, span.thread), 0),
                 "name": span.name,
                 "cat": "stage",
                 "ts": base_us + span.start * 1e6,
@@ -260,10 +309,13 @@ class Timeline:
                 "args": dict(span.attrs),
             })
         for event in events:
-            args = {k: v for k, v in event.items() if k not in ("t", "name")}
+            args = {
+                k: v for k, v in event.items()
+                if k not in ("t", "name", "process")
+            }
             trace_events.append({
                 "ph": "i",
-                "pid": 1,
+                "pid": pids.get(event.get("process", ""), 1),
                 "tid": 0,
                 "name": event["name"],
                 "cat": "event",

@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import logging
 import math
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -38,7 +39,15 @@ from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
 from ..analysis import lockcheck
-from ..observability import exposition, flightrec, spans, tracing
+from ..observability import (
+    aggregate,
+    exposition,
+    flightrec,
+    spans,
+    stitch,
+    tracing,
+)
+from ..observability import slo as slo_engine
 from ..observability.registry import REGISTRY
 from ..watchman.control import DRAINING_HEADER, ControlPlane
 from .placement import Placement
@@ -64,6 +73,19 @@ _M_UNROUTABLE = REGISTRY.counter(
     "gordo_router_unroutable_total",
     "Requests that exhausted every worker candidate (answered 503)",
 )
+_M_STITCH = REGISTRY.counter(
+    "gordo_router_stitch_total",
+    "Cross-process trace stitching outcomes (merged = worker timeline "
+    "merged from the response header; truncated = over the size cap, "
+    "pull pending; pulled = fetched from the worker's flight recorder "
+    "on read; pull_failed / invalid = fallback misses)",
+    labels=("outcome",),
+)
+_M_AGG_SCRAPES = REGISTRY.counter(
+    "gordo_router_aggregate_scrapes_total",
+    "Scrape-of-scrapes worker fetches by worker and outcome",
+    labels=("worker", "outcome"),
+)
 
 # end-to-end headers the worker's answer owns; everything hop-by-hop or
 # recomputed by werkzeug is dropped on the way back through the router
@@ -78,14 +100,27 @@ _DROP_FORWARD_HEADERS = frozenset(
      "transfer-encoding", "upgrade", "te", "trailer", "proxy-authorization")
 )
 
+
+def _aggregate_enabled() -> bool:
+    """GORDO_ROUTER_AGGREGATE=0 turns ``?aggregate=1`` into a plain
+    router-registry scrape (workers too slow/many to fan out to)."""
+    return os.environ.get(
+        "GORDO_ROUTER_AGGREGATE", "1"
+    ).strip().lower() not in ("0", "false", "off", "no")
+
 _URL_MAP = Map(
     [
         Rule("/healthz", endpoint="healthz"),
         Rule("/metrics", endpoint="metrics"),
+        Rule("/slo", endpoint="slo"),
         Rule("/models", endpoint="models"),
         Rule("/reload", endpoint="reload"),
         Rule("/rollback", endpoint="rollback"),
         Rule("/router/status", endpoint="status"),
+        # merged (router + stitched worker) timelines — same shape as
+        # the worker's /debug/requests, served from the router's recorder
+        Rule("/debug/requests", endpoint="debug-requests"),
+        Rule("/debug/requests/<trace_id>", endpoint="debug-request"),
         Rule("/prediction", endpoint="score"),
         Rule("/anomaly/prediction", endpoint="score"),
         Rule("/gordo/v0/<project>/<machine>/<path:rest>", endpoint="machine"),
@@ -112,6 +147,7 @@ class FleetRouter:
         models_root: Optional[str] = None,
         forward_timeout: float = 60.0,
         retry_after: float = 1.0,
+        scrape_timeout: float = 5.0,
     ):
         self.supervisor = supervisor
         self.control = control
@@ -120,6 +156,10 @@ class FleetRouter:
         self.models_root = models_root
         self.forward_timeout = forward_timeout
         self.retry_after = retry_after
+        # the aggregate fan-out's PER-WORKER budget: deliberately much
+        # shorter than forward_timeout — a wedged worker must cost the
+        # fleet scrape seconds, not a Prometheus scrape-timeout blackout
+        self.scrape_timeout = scrape_timeout
         import requests
 
         # ONE pooled session for every forward: keep-alive connections to
@@ -134,6 +174,16 @@ class FleetRouter:
         )
         self._models_cache: Optional[List[str]] = None
         self._models_lock = lockcheck.named_lock("router.models")
+        # truncated-stitch pull ledger: claims a pending pull exactly
+        # once across concurrent /debug readers (never held across HTTP)
+        self._stitch_lock = lockcheck.named_lock("router.stitch")
+        # router-side SLO engine (§18): route latency + routability
+        # objectives over the router's own series, scrape-driven
+        self.slo = (
+            slo_engine.SLOEvaluator(slo_engine.router_objectives())
+            if slo_engine.enabled()
+            else None
+        )
         tracing.install_log_record_factory()
 
     # -- WSGI ----------------------------------------------------------------
@@ -148,7 +198,8 @@ class FleetRouter:
         timeline_token = None
         if flightrec.RECORDER.enabled:
             timeline, timeline_token = spans.begin(
-                trace_id, method=request.method, path=request.path
+                trace_id, method=request.method, path=request.path,
+                service="router",
             )
         adapter = _URL_MAP.bind_to_environ(environ)
         try:
@@ -171,11 +222,13 @@ class FleetRouter:
                     status=str(status),
                     error=f"HTTP {status}" if status >= 500 else "",
                 )
-                if request.path not in ("/healthz", "/metrics"):
+                if request.path not in (
+                    "/healthz", "/metrics", "/slo", "/router/status",
+                ) and not request.path.startswith("/debug/"):
                     flightrec.RECORDER.record(timeline)
             logger.log(
                 logging.DEBUG
-                if request.path in ("/healthz", "/metrics")
+                if request.path in ("/healthz", "/metrics", "/slo")
                 else logging.INFO,
                 "%s %s -> %d in %.1f ms [trace=%s]",
                 request.method,
@@ -195,9 +248,25 @@ class FleetRouter:
         if endpoint == "healthz":
             return self._healthz()
         if endpoint == "metrics":
+            if self.slo is not None:
+                self.slo.maybe_tick()
+            exemplars = request.args.get("exemplars") in ("1", "true")
             if request.args.get("format") == "prometheus":
+                if request.args.get("aggregate") in (
+                    "1", "true"
+                ) and _aggregate_enabled():
+                    # scrape-of-scrapes (§18): the fleet in ONE
+                    # exposition — worker registries merged (counters
+                    # summed, histogram buckets merged, gauges
+                    # worker-labeled) with the router's own on top
+                    return Response(
+                        self._aggregate_metrics(exemplars=exemplars),
+                        content_type=exposition.CONTENT_TYPE,
+                    )
                 return Response(
-                    exposition.render_prometheus(REGISTRY),
+                    exposition.render_prometheus(
+                        REGISTRY, exemplars=exemplars
+                    ),
                     content_type=exposition.CONTENT_TYPE,
                 )
             return _json(
@@ -206,6 +275,18 @@ class FleetRouter:
                     "registry": REGISTRY.snapshot(),
                 }
             )
+        if endpoint == "slo":
+            if self.slo is None:
+                return _json({"enabled": False})
+            self.slo.maybe_tick()
+            return _json(self.slo.snapshot(recorder=flightrec.RECORDER))
+        if endpoint == "debug-requests":
+            limit = request.args.get("limit", type=int)
+            return _json(
+                flightrec.RECORDER.summaries(limit=limit if limit else 50)
+            )
+        if endpoint == "debug-request":
+            return self._debug_request(request, args["trace_id"])
         if endpoint == "status":
             return _json(self._status())
         if endpoint == "models":
@@ -267,6 +348,10 @@ class FleetRouter:
             if key.lower() not in _DROP_FORWARD_HEADERS
         }
         headers[tracing.TRACE_HEADER] = tracing.get_trace_id()
+        if spans.current_timeline() is not None:
+            # negotiate trace stitching: the worker stamps its completed
+            # timeline on the response (size-capped) ONLY when asked
+            headers[stitch.TIMELINE_HEADER] = "1"
         with spans.stage(
             "route", machine=machine, hot=self.placement.is_hot(machine)
         ):
@@ -354,6 +439,7 @@ class FleetRouter:
             return None
         breaker.record(True)
         _M_ROUTED.labels(worker_name, "ok").inc()
+        self._stitch_response(worker_name, upstream, started)
         response = Response(
             upstream.content, status=upstream.status_code
         )
@@ -361,6 +447,158 @@ class FleetRouter:
             if key in upstream.headers:
                 response.headers[key] = upstream.headers[key]
         return response
+
+    def _stitch_response(
+        self, worker_name: str, upstream, started: float
+    ) -> None:
+        """Merge the worker's stamped timeline (or note the truncation
+        for the pull fallback) under this request's ``route`` stage."""
+        timeline = spans.current_timeline()
+        if timeline is None:
+            return
+        rel_start = max(0.0, started - timeline.started)
+        rel_end = max(rel_start, time.perf_counter() - timeline.started)
+        encoded = upstream.headers.get(stitch.TIMELINE_HEADER)
+        truncated = upstream.headers.get(stitch.TIMELINE_TRUNCATED_HEADER)
+        if encoded:
+            try:
+                remote = stitch.decode_timeline(encoded)
+            except ValueError as exc:
+                _M_STITCH.labels("invalid").inc()
+                spans.event(
+                    "stitch_invalid", worker=worker_name, error=str(exc)
+                )
+                return
+            merged = stitch.merge_remote(
+                timeline, remote, rel_start, rel_end, process=worker_name
+            )
+            _M_STITCH.labels("merged" if merged else "invalid").inc()
+        elif truncated:
+            # over the size cap: remember WHICH worker holds the full
+            # timeline so /debug/requests/<trace_id> can pull it
+            timeline.meta["stitch_pending"] = {
+                "worker": worker_name,
+                "window": [round(rel_start, 6), round(rel_end, 6)],
+            }
+            spans.event(
+                "timeline_truncated", worker=worker_name, bytes=truncated
+            )
+            _M_STITCH.labels("truncated").inc()
+
+    # -- stitched timelines ---------------------------------------------------
+    def _debug_request(self, request: Request, trace_id: str) -> Response:
+        recorded = flightrec.RECORDER.get(trace_id)
+        if recorded is None:
+            return _json(
+                {
+                    "error": (
+                        f"no recorded timeline for trace {trace_id!r} "
+                        "(rotated out of the flight recorder, or routed "
+                        "before recording was enabled)"
+                    )
+                },
+                status=404,
+            )
+        self._pull_stitch(recorded, trace_id)
+        if request.args.get("format") == "chrome":
+            return _json(recorded.to_chrome_trace())
+        return _json(recorded.to_dict())
+
+    def _pull_stitch(self, timeline, trace_id: str) -> None:
+        """Pull fallback: the worker's stamped timeline was over the
+        size cap, so fetch the full one from the worker's own flight
+        recorder and merge it now. Claimed once under the stitch lock;
+        the HTTP round-trip runs OUTSIDE it."""
+        import requests
+
+        with self._stitch_lock:
+            pending = timeline.meta.pop("stitch_pending", None)
+        if not pending:
+            return
+        worker_name = pending.get("worker", "")
+        window = pending.get("window") or [0.0, timeline.duration]
+        spec = self.supervisor.specs.get(worker_name)
+        if spec is None:
+            # worker left the slot table: permanent — say so in the meta
+            # (a one-lane trace with no explanation reads as a stitch
+            # that was never attempted)
+            timeline.meta["stitch_failed"] = (
+                f"worker {worker_name} no longer in the slot table"
+            )
+            _M_STITCH.labels("pull_failed").inc()
+            return
+        try:
+            upstream = self._session.get(
+                f"{spec.base_url}/debug/requests/{trace_id}",
+                timeout=5.0,
+            )
+        except requests.RequestException as exc:
+            # transient: put the claim back so a later read retries
+            with self._stitch_lock:
+                timeline.meta.setdefault("stitch_pending", pending)
+            _M_STITCH.labels("pull_failed").inc()
+            logger.warning(
+                "Stitch pull from %s failed (%r); will retry on next "
+                "read", worker_name, exc,
+            )
+            return
+        if upstream.status_code != 200:
+            # rotated out of the worker's recorder (or the worker
+            # restarted): permanent — stop retrying, say so in the meta
+            timeline.meta["stitch_failed"] = (
+                f"worker {worker_name} answered "
+                f"HTTP {upstream.status_code}"
+            )
+            _M_STITCH.labels("pull_failed").inc()
+            return
+        try:
+            remote = upstream.json()
+            merged = stitch.merge_remote(
+                timeline, remote,
+                float(window[0]), float(window[1]),
+                process=worker_name,
+            )
+        except (ValueError, TypeError, IndexError) as exc:
+            timeline.meta["stitch_failed"] = f"unparseable: {exc}"
+            _M_STITCH.labels("invalid").inc()
+            return
+        _M_STITCH.labels("pulled" if merged else "invalid").inc()
+
+    # -- scrape-of-scrapes ----------------------------------------------------
+    def _aggregate_metrics(self, exemplars: bool = False) -> str:
+        """One fleet exposition: every routable worker's registry merged
+        with the router's own (``observability.aggregate``). Unreachable
+        or malformed workers are named in a comment and skipped — the
+        fleet view degrades, never dies."""
+        targets = {
+            name: spec.base_url
+            for name, spec in sorted(self.supervisor.specs.items())
+            if self.control.routable(name)
+        }
+        texts, errors = aggregate.scrape_sources(
+            self._session, targets, timeout=self.scrape_timeout,
+            exemplars=exemplars,
+        )
+        for name in texts:
+            _M_AGG_SCRAPES.labels(name, "ok").inc()
+        for name in errors:
+            _M_AGG_SCRAPES.labels(name, "error").inc()
+        # the router's OWN registry renders AFTER the scrape counters
+        # above so the aggregate reports its own collection honestly
+        sources = dict(texts)
+        sources["router"] = exposition.render_prometheus(
+            REGISTRY, exemplars=exemplars
+        )
+        merged = aggregate.merge_expositions(sources, exemplars=exemplars)
+        preamble = "".join(
+            f"# aggregate: worker {name} skipped — {error}\n"
+            for name, error in sorted(errors.items())
+        )
+        skipped = "".join(
+            f"# aggregate: worker {name} not routable, skipped\n"
+            for name in sorted(set(self.supervisor.specs) - set(targets))
+        )
+        return preamble + skipped + merged
 
     # -- views ---------------------------------------------------------------
     def _healthz(self) -> Response:

@@ -57,7 +57,8 @@ from werkzeug.wrappers import Request, Response
 
 from ..analysis import lockcheck
 from ..models.anomaly.base import AnomalyDetectorBase
-from ..observability import exposition, flightrec, spans, tracing
+from ..observability import exposition, flightrec, spans, stitch, tracing
+from ..observability import slo as slo_engine
 from ..observability.registry import REGISTRY
 from ..resilience import deadline, faults
 from ..resilience.admission import (
@@ -98,6 +99,7 @@ _URL_MAP = Map(
         Rule("/healthz", endpoint="healthz"),
         Rule("/metadata", endpoint="metadata"),
         Rule("/metrics", endpoint="metrics"),
+        Rule("/slo", endpoint="slo"),
         Rule("/models", endpoint="models"),
         Rule("/reload", endpoint="reload"),
         Rule("/prediction", endpoint="prediction"),
@@ -419,6 +421,15 @@ class ModelServer:
             machines, shard_fleet=shard_fleet,
             compile_cache=self.compile_cache,
         )
+        # SLO engine (§18): declared objectives over the request
+        # histograms this server already records, evaluated by
+        # multi-window burn rate on the scrape path (/metrics and /slo
+        # reads piggyback maybe_tick — no supervisor thread)
+        self.slo = (
+            slo_engine.SLOEvaluator(slo_engine.server_objectives())
+            if slo_engine.enabled()
+            else None
+        )
         # every record emitted while serving a request carries its trace id
         # (idempotent; composes with logsetup.configure_logging)
         tracing.install_log_record_factory()
@@ -701,15 +712,31 @@ class ModelServer:
             if timeline is not None:
                 status = response.status_code
                 timeline.meta["endpoint"] = endpoint
+                if self.worker_id is not None:
+                    timeline.meta["worker"] = self.worker_id
                 timeline.finish(
                     status=str(status),
                     error=f"HTTP {status}" if status >= 500 else "",
                 )
+                # trace stitching (§18): ONLY when the caller negotiated
+                # it (the router sends X-Gordo-Timeline: 1) — plain
+                # clients never pay the header bytes. Past the size cap
+                # the truncation marker tells the router to pull the
+                # full timeline from /debug/requests/<trace_id> instead.
+                if request.headers.get(stitch.TIMELINE_HEADER):
+                    encoded, truncated = stitch.encode_timeline(timeline)
+                    if encoded is not None:
+                        response.headers[stitch.TIMELINE_HEADER] = encoded
+                    else:
+                        response.headers[
+                            stitch.TIMELINE_TRUNCATED_HEADER
+                        ] = str(truncated)
                 # probe/scrape endpoints are excluded: a watchman polling
                 # N machines would flush every scoring trace out of the
                 # ring within one poll interval
                 if endpoint not in (
-                    "healthz", "metrics", "debug-requests", "debug-request"
+                    "healthz", "metrics", "slo",
+                    "debug-requests", "debug-request",
                 ):
                     flightrec.RECORDER.record(timeline)
             # DEBUG for probe endpoints: a watchman polling N machines'
@@ -717,7 +744,7 @@ class ModelServer:
             # double steady-state log volume (werkzeug's own access line
             # already covers them); real work logs at INFO with its trace
             logger.log(
-                logging.DEBUG if endpoint in ("healthz", "metrics")
+                logging.DEBUG if endpoint in ("healthz", "metrics", "slo")
                 else logging.INFO,
                 "%s %s -> %d in %.1f ms [trace=%s]",
                 request.method,
@@ -834,7 +861,17 @@ class ModelServer:
                 },
                 status=200 if ready else 503,
             )
+        if endpoint == "slo":
+            if self.slo is None:
+                return _json({"enabled": False})
+            self.slo.maybe_tick()
+            return _json(self.slo.snapshot(recorder=flightrec.RECORDER))
         if endpoint == "metrics":
+            # scrape-driven SLO evaluation: every scrape advances the
+            # burn-rate windows (min-interval-gated), so gordo_slo_*
+            # series below are fresh without a background thread
+            if self.slo is not None:
+                self.slo.maybe_tick()
             if request.args.get("format") == "prometheus":
                 # &exemplars=1 opts into OpenMetrics-style exemplar
                 # suffixes (gordo tooling / OpenMetrics ingesters); the

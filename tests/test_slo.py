@@ -1,0 +1,765 @@
+"""Fleet observability (§18): SLO burn-rate engine, cross-process trace
+stitching, and scrape-of-scrapes aggregation.
+
+Burn-rate math runs on a FAKE clock (years of window arithmetic, zero
+sleeps); stitching and aggregation are exercised first as pure units,
+then against scripted thread-backed workers (the truncation pull
+fallback), and finally end-to-end: two REAL ModelServer workers behind
+the router, one routed request, ONE merged trace carrying both the
+router's ``route`` span and the worker's ``device_execute`` span.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+from werkzeug.serving import make_server
+from werkzeug.wrappers import Request, Response
+
+from gordo_components_tpu.observability import (
+    aggregate,
+    exposition,
+    flightrec,
+    slo,
+    spans,
+    stitch,
+    tracing,
+)
+from gordo_components_tpu.observability.registry import Registry
+from gordo_components_tpu.router import WorkerSpec, assemble_fleet
+
+pytestmark = pytest.mark.usefixtures("thread_hygiene")
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+class _ThreadWorker:
+    """Thread-backed werkzeug server satisfying the worker protocol —
+    same seam as test_router.py."""
+
+    def __init__(self, spec: WorkerSpec, app):
+        self.spec = spec
+        self._app = app
+        self._server = None
+        self._thread = None
+
+    def start(self):
+        self._server = make_server(
+            self.spec.host, self.spec.port, self._app, threaded=True
+        )
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def pid(self):
+        return None
+
+    def alive(self):
+        return self._server is not None
+
+    def terminate(self, grace: float = 5.0):
+        if self._server is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=5)
+            self._server = None
+
+    kill = terminate
+
+
+def _scoring_registry(latency_s: float, n: int = 50) -> Registry:
+    registry = Registry()
+    hist = registry.histogram(
+        "gordo_server_request_duration_seconds", "lat",
+        labels=("endpoint",),
+    )
+    counter = registry.counter(
+        "gordo_server_requests_total", "reqs",
+        labels=("endpoint", "status"),
+    )
+    for _ in range(n):
+        hist.labels("anomaly").observe(latency_s)
+        counter.labels("anomaly", "200").inc()
+    return registry
+
+
+def _fill(registry: Registry, latency_s: float, n: int,
+          status: str = "200") -> None:
+    hist = registry.histogram(
+        "gordo_server_request_duration_seconds", "lat",
+        labels=("endpoint",),
+    )
+    counter = registry.counter(
+        "gordo_server_requests_total", "reqs",
+        labels=("endpoint", "status"),
+    )
+    for _ in range(n):
+        hist.labels("anomaly").observe(latency_s)
+        counter.labels("anomaly", status).inc()
+
+
+def _evaluator(registry, clock, recorder=None, **kwargs):
+    defaults = dict(
+        fast_window=300.0, slow_window=3600.0,
+        fast_burn=14.4, slow_burn=6.0, min_interval=10.0,
+    )
+    defaults.update(kwargs)
+    return slo.SLOEvaluator(
+        slo.server_objectives(), registry=registry, clock=clock,
+        recorder=recorder or flightrec.FlightRecorder(enabled=True),
+        **defaults,
+    )
+
+
+# -- burn-rate math (fake clocks, no sleeps) ---------------------------------
+
+
+def test_burn_rate_healthy_traffic_never_crosses():
+    registry = _scoring_registry(0.010)
+    clock = [1000.0]
+    evaluator = _evaluator(registry, lambda: clock[0])
+    for _ in range(10):
+        clock[0] += 60
+        _fill(registry, 0.010, 50)
+        result = evaluator.tick()
+        assert result["crossings"] == []
+    snapshot = evaluator.snapshot()
+    latency = snapshot["objectives"][0]
+    assert latency["attainment"] == 1.0
+    assert latency["windows"]["fast"]["burn_rate"] == 0.0
+    assert latency["windows"]["fast"]["breached"] is False
+
+
+def test_burn_rate_crossing_is_edge_triggered_and_recovers():
+    registry = _scoring_registry(0.010)
+    clock = [1000.0]
+    recorder = flightrec.FlightRecorder(enabled=True)
+    evaluator = _evaluator(registry, lambda: clock[0], recorder=recorder)
+    # all traffic slow: bad ratio 1.0 / budget 0.01 = burn 100x
+    clock[0] += 60
+    _fill(registry, 0.900, 100)
+    result = evaluator.tick()
+    crossed = {(c["objective"], c["window"]) for c in result["crossings"]}
+    assert ("scoring-latency", "fast") in crossed
+    assert ("scoring-latency", "slow") in crossed
+    # the crossing landed in the flight recorder's error ring
+    errors = recorder.summaries()["errors"]
+    assert any("slo-scoring-latency" in row["trace_id"] for row in errors)
+    # still burning: edge-triggered, no NEW crossing
+    clock[0] += 60
+    _fill(registry, 0.900, 100)
+    assert evaluator.tick()["crossings"] == []
+    counts = evaluator.snapshot()["objectives"][0]["windows"]
+    assert counts["fast"]["breaches"] == 1
+    # recovery: healthy traffic pushes the fast window under threshold,
+    # and a LATER burn crosses again (a second edge)
+    for _ in range(10):
+        clock[0] += 60
+        _fill(registry, 0.010, 500)
+        evaluator.tick()
+    assert (
+        evaluator.snapshot()["objectives"][0]["windows"]["fast"]["breached"]
+        is False
+    )
+    clock[0] += 60
+    _fill(registry, 0.900, 5000)
+    crossings = evaluator.tick()["crossings"]
+    assert any(c["window"] == "fast" for c in crossings)
+    assert (
+        evaluator.snapshot()["objectives"][0]["windows"]["fast"]["breaches"]
+        == 2
+    )
+
+
+def test_burn_rate_windows_diverge():
+    """A burst that has LEFT the fast window still burns the slow one —
+    the point of evaluating two windows."""
+    registry = _scoring_registry(0.010)
+    clock = [1000.0]
+    evaluator = _evaluator(registry, lambda: clock[0], min_interval=0.0)
+    clock[0] += 60
+    _fill(registry, 0.900, 1000)  # the burst
+    evaluator.tick()
+    # 20 minutes of healthy traffic, ticking each minute: the burst ages
+    # out of the 5m fast window but stays inside the 1h slow window
+    for _ in range(20):
+        clock[0] += 60
+        _fill(registry, 0.010, 10)
+        evaluator.tick()
+    snapshot = evaluator.snapshot()["objectives"][0]["windows"]
+    assert snapshot["fast"]["burn_rate"] < 6.0
+    assert snapshot["slow"]["burn_rate"] > 6.0
+
+
+def test_latency_threshold_snaps_to_bucket_bound():
+    registry = _scoring_registry(0.010, n=1)
+    clock = [0.0]
+    evaluator = slo.SLOEvaluator(
+        [slo.Objective(
+            name="snap", kind="latency",
+            metric="gordo_server_request_duration_seconds",
+            target=0.99, threshold_s=0.2,  # between the 0.1 / 0.25 bounds
+        )],
+        registry=registry, clock=lambda: clock[0],
+        recorder=flightrec.FlightRecorder(enabled=True),
+        fast_window=300, slow_window=3600, min_interval=0,
+    )
+    assert evaluator.effective_threshold(evaluator.objectives[0]) == 0.25
+
+
+def test_availability_with_separate_bad_family():
+    """Router-style objective: good counts in one family, bad counts in
+    another (ok forwards vs unroutable 503s)."""
+    registry = Registry()
+    ok = registry.counter(
+        "gordo_router_requests_total", "routed",
+        labels=("worker", "outcome"),
+    )
+    unroutable = registry.counter(
+        "gordo_router_unroutable_total", "exhausted",
+    )
+    clock = [0.0]
+    evaluator = slo.SLOEvaluator(
+        slo.router_objectives(), registry=registry,
+        clock=lambda: clock[0],
+        recorder=flightrec.FlightRecorder(enabled=True),
+        fast_window=300, slow_window=3600,
+        fast_burn=14.4, slow_burn=6.0, min_interval=0,
+    )
+    for _ in range(999):
+        ok.labels("worker-0", "ok").inc()
+    unroutable.inc()  # 1 bad of 1000 => bad ratio 0.001 = budget => 1x
+    clock[0] += 60
+    evaluator.tick()
+    availability = next(
+        o for o in evaluator.snapshot()["objectives"]
+        if o["name"] == "route-availability"
+    )
+    assert availability["total"] == 1000
+    assert availability["good"] == 999
+    assert availability["windows"]["fast"]["burn_rate"] == pytest.approx(
+        1.0, rel=1e-6
+    )
+    assert availability["windows"]["fast"]["breached"] is False
+
+
+def test_attribution_names_the_stage_that_ate_the_budget():
+    recorder = flightrec.FlightRecorder(enabled=True)
+    for i in range(5):
+        timeline = spans.Timeline(f"t-{i}")
+        # slow requests: device_execute dominates; score is a parent
+        timeline.add_span_at("score", 0.0, 0.500, thread="h")
+        timeline.add_span_at("queue_wait", 0.0, 0.050, thread="h")
+        timeline.add_span_at("device_execute", 0.05, 0.400, thread="c")
+        timeline.finish(status="200")
+        # fake duration: finished immediately => duration ~0; use the
+        # summaries' duration_ms via started offset instead
+        timeline.started = timeline.started - 0.5
+        recorder.record(timeline)
+    objective = slo.Objective(
+        name="lat", kind="latency",
+        metric="gordo_server_request_duration_seconds",
+        target=0.99, threshold_s=0.25,
+    )
+    attribution = slo.attribute_stages(recorder, objective)
+    assert attribution["violations"] == 5
+    assert attribution["dominant_stage"] == "device_execute"
+    assert "score" not in attribution["stages"]
+    assert attribution["stages"]["device_execute"]["share"] > 0.5
+
+
+def test_slo_disabled_by_knob(monkeypatch):
+    monkeypatch.setenv("GORDO_SLO", "0")
+    assert slo.enabled() is False
+    monkeypatch.setenv("GORDO_SLO", "1")
+    assert slo.enabled() is True
+
+
+def test_maybe_tick_honors_min_interval():
+    registry = _scoring_registry(0.010)
+    clock = [0.0]
+    evaluator = _evaluator(registry, lambda: clock[0], min_interval=10.0)
+    ticks = evaluator.ticks
+    assert evaluator.maybe_tick() is False  # just baselined
+    clock[0] += 11
+    assert evaluator.maybe_tick() is True
+    assert evaluator.ticks == ticks + 1
+
+
+# -- trace stitching units ----------------------------------------------------
+
+
+def test_stitch_roundtrip_and_size_cap():
+    timeline = spans.Timeline("trace-1", endpoint="anomaly")
+    timeline.add_span_at("device_execute", 0.001, 0.040, thread="collector")
+    timeline.finish(status="200")
+    encoded, truncated = stitch.encode_timeline(timeline)
+    assert truncated is None
+    decoded = stitch.decode_timeline(encoded)
+    assert decoded["trace_id"] == "trace-1"
+    assert decoded["spans"][0]["name"] == "device_execute"
+    # a tiny cap truncates instead
+    encoded, truncated = stitch.encode_timeline(timeline, cap=16)
+    assert encoded is None and truncated > 16
+    with pytest.raises(ValueError):
+        stitch.decode_timeline("not base64 json !!!")
+
+
+def test_merge_remote_wall_clock_alignment_and_skew_clamp():
+    local = spans.Timeline("t", service="router")
+    # remote started 10ms after the router's timeline, well inside a
+    # [5ms, 80ms] forward window: wall-clock placement is used verbatim
+    remote = {
+        "started": local.started_wall + 0.010,
+        "duration_ms": 30.0,
+        "spans": [
+            {"name": "device_execute", "start_ms": 5.0,
+             "duration_ms": 20.0, "thread": "collector"},
+        ],
+        "events": [{"name": "promoted", "t": 0.002}],
+    }
+    merged = stitch.merge_remote(local, remote, 0.005, 0.080, "worker-1")
+    assert merged == 1
+    span = [s for s in local.to_dict()["spans"]
+            if s["name"] == "device_execute"][0]
+    assert span["process"] == "worker-1"
+    assert span["start_ms"] == pytest.approx(15.0, abs=1.0)
+    # skewed clock (remote an hour off): clamped into the window, never
+    # rendered outside its parent
+    skewed = spans.Timeline("t2", service="router")
+    remote_skewed = dict(remote, started=skewed.started_wall + 3600.0)
+    stitch.merge_remote(skewed, remote_skewed, 0.005, 0.080, "worker-1")
+    span = skewed.to_dict()["spans"][0]
+    start_s = span["start_ms"] / 1000.0
+    assert 0.005 <= start_s <= 0.080
+    assert start_s + span["duration_ms"] / 1000.0 <= 0.081
+
+
+def test_merged_chrome_trace_has_process_lanes_and_leaf_dominance():
+    local = spans.Timeline("t", service="router")
+    local.add_span_at("route", 0.0, 0.100, thread="handler")
+    remote = {
+        "started": local.started_wall + 0.002,
+        "duration_ms": 90.0,
+        "spans": [
+            {"name": "device_execute", "start_ms": 10.0,
+             "duration_ms": 60.0, "thread": "collector"},
+        ],
+    }
+    stitch.merge_remote(local, remote, 0.0, 0.100, "worker-0")
+    chrome = local.to_chrome_trace()
+    complete = [e for e in chrome["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in complete} == {1, 2}
+    names = {
+        e["args"]["name"]
+        for e in chrome["traceEvents"]
+        if e.get("name") == "process_name"
+    }
+    assert "worker-0" in names and "router" in names
+    # route is a parent stage once stitched: dominance names the leaf
+    assert local.dominant_stage() == "device_execute"
+
+
+# -- aggregation units --------------------------------------------------------
+
+
+def _exposed(registry, trace_id=None, exemplars=False):
+    if trace_id:
+        token = tracing.set_trace_id(trace_id)
+        try:
+            registry.histogram(
+                "gordo_server_request_duration_seconds", "lat",
+                labels=("endpoint",),
+            ).labels("anomaly").observe(0.01)
+        finally:
+            tracing.reset_trace_id(token)
+    return exposition.render_prometheus(registry, exemplars=exemplars)
+
+
+def test_aggregate_counters_sum_histograms_merge_gauges_label():
+    r1 = _scoring_registry(0.010, n=3)
+    r2 = _scoring_registry(0.020, n=7)
+    g1 = r1.gauge("gordo_router_workers_alive", "alive")
+    g1.set(1)
+    g2 = r2.gauge("gordo_router_workers_alive", "alive")
+    g2.set(2)
+    merged = aggregate.merge_expositions({
+        "worker-0": exposition.render_prometheus(r1),
+        "worker-1": exposition.render_prometheus(r2),
+    })
+    samples = exposition.parse_prometheus_text(merged)
+    # counters summed into ONE fleet series
+    assert samples["gordo_server_requests_total"] == [
+        ({"endpoint": "anomaly", "status": "200"}, 10.0)
+    ]
+    # histogram buckets merged; +Inf == count held by the validator
+    count = samples["gordo_server_request_duration_seconds_count"]
+    assert count == [({"endpoint": "anomaly"}, 10.0)]
+    buckets = dict(
+        (labels["le"], value)
+        for labels, value in
+        samples["gordo_server_request_duration_seconds_bucket"]
+    )
+    assert buckets["0.01"] == 3.0  # only r1's 3 fit the 10ms bucket
+    assert buckets["+Inf"] == 10.0
+    # gauges per-worker labeled, values intact
+    alive = dict(
+        (labels["worker"], value)
+        for labels, value in samples["gordo_router_workers_alive"]
+    )
+    assert alive == {"worker-0": 1.0, "worker-1": 2.0}
+
+
+def test_aggregate_preserves_exemplars_newest_wins():
+    r1 = _scoring_registry(0.010, n=1)
+    r2 = _scoring_registry(0.010, n=1)
+    t1 = _exposed(r1, trace_id="older", exemplars=True)
+    time.sleep(0.01)
+    t2 = _exposed(r2, trace_id="newer", exemplars=True)
+    merged = aggregate.merge_expositions(
+        {"w0": t1, "w1": t2}, exemplars=True
+    )
+    samples, exemplars = exposition.parse_prometheus_text(
+        merged, return_exemplars=True
+    )
+    rows = exemplars["gordo_server_request_duration_seconds_bucket"]
+    traces = {ex["labels"]["trace_id"] for _, ex in rows}
+    assert traces == {"newer"}
+    # exemplars strip cleanly when not requested (strict v0.0.4)
+    bare = aggregate.merge_expositions(
+        {"w0": t1, "w1": t2}, exemplars=False
+    )
+    assert " # {" not in bare
+
+
+def test_aggregate_type_conflict_skips_family_not_scrape():
+    good = "# TYPE gordo_server_requests_total counter\n" \
+           "gordo_server_requests_total 5\n"
+    conflicting = "# TYPE gordo_server_requests_total gauge\n" \
+                  "gordo_server_requests_total 7\n"
+    merged = aggregate.merge_expositions(
+        {"w0": good, "w1": conflicting}
+    )
+    assert "skipped" in merged
+    samples = exposition.parse_prometheus_text(merged)
+    assert "gordo_server_requests_total" not in samples
+
+
+def test_aggregate_rejects_malformed_input():
+    with pytest.raises(ValueError):
+        aggregate.merge_expositions({"w0": "not { exposition"})
+
+
+def test_aggregate_bucket_layout_mismatch_skips_family():
+    """Mid-rollout skew: two sources exposing DIFFERENT le sets for one
+    series cannot be summed per-bucket (non-monotone output) — the
+    family is skipped loudly, the scrape survives."""
+    a = (
+        "# TYPE gordo_server_request_duration_seconds histogram\n"
+        'gordo_server_request_duration_seconds_bucket{le="0.1"} 1\n'
+        'gordo_server_request_duration_seconds_bucket{le="+Inf"} 2\n'
+        "gordo_server_request_duration_seconds_sum 0.3\n"
+        "gordo_server_request_duration_seconds_count 2\n"
+    )
+    b = (
+        "# TYPE gordo_server_request_duration_seconds histogram\n"
+        'gordo_server_request_duration_seconds_bucket{le="0.5"} 3\n'
+        'gordo_server_request_duration_seconds_bucket{le="+Inf"} 4\n'
+        "gordo_server_request_duration_seconds_sum 0.9\n"
+        "gordo_server_request_duration_seconds_count 4\n"
+    )
+    merged = aggregate.merge_expositions({"w0": a, "w1": b})
+    assert "bucket layouts disagree" in merged
+    samples = exposition.parse_prometheus_text(merged)
+    assert "gordo_server_request_duration_seconds_bucket" not in samples
+
+
+def test_aggregate_untyped_family_passes_through_worker_labeled():
+    text = "gordo_server_custom_value 7\n"  # no # TYPE line: legal
+    merged = aggregate.merge_expositions({"w0": text})
+    samples = exposition.parse_prometheus_text(merged)
+    assert samples["gordo_server_custom_value"] == [
+        ({"worker": "w0"}, 7.0)
+    ]
+
+
+def test_attribution_excludes_traffic_outside_the_objective():
+    """A deliberately-slow /reload in the slow reservoir must not count
+    as a scoring-latency violation forever."""
+    recorder = flightrec.FlightRecorder(enabled=True)
+    slow_reload = spans.Timeline("reload-1", endpoint="reload")
+    slow_reload.add_span_at("admission", 0.0, 3.0, thread="h")
+    slow_reload.finish(status="200")
+    slow_reload.started -= 3.0
+    recorder.record(slow_reload)
+    scoring = spans.Timeline("score-1", endpoint="anomaly")
+    scoring.add_span_at("device_execute", 0.0, 0.4, thread="c")
+    scoring.finish(status="200")
+    scoring.started -= 0.4
+    recorder.record(scoring)
+    objective = slo.server_objectives()[0]  # scoring-latency
+    attribution = slo.attribute_stages(recorder, objective)
+    assert attribution["violations"] == 1
+    assert attribution["dominant_stage"] == "device_execute"
+    assert "admission" not in attribution["stages"]
+
+
+# -- truncation pull fallback (scripted workers) ------------------------------
+
+
+class _ScriptedWorkerState:
+    def __init__(self, name):
+        self.name = name
+        self.timelines = {}
+        self.debug_hits = 0
+
+
+def _scripted_app(state: _ScriptedWorkerState):
+    @Request.application
+    def app(request):
+        def reply(payload, status=200, headers=None):
+            response = Response(
+                json.dumps(payload), status=status,
+                mimetype="application/json",
+            )
+            response.headers["X-Gordo-Worker"] = state.name
+            for key, value in (headers or {}).items():
+                response.headers[key] = value
+            return response
+
+        if request.path == "/healthz":
+            return reply({"ok": True, "status": "ok", "live": True,
+                          "ready": True})
+        if request.path == "/models":
+            return reply({"models": ["mach-x"]})
+        if request.path.startswith("/debug/requests/"):
+            state.debug_hits += 1
+            trace_id = request.path.rsplit("/", 1)[1]
+            if trace_id not in state.timelines:
+                return reply({"error": "rotated"}, status=404)
+            return reply(state.timelines[trace_id])
+        # scoring: always answer truncated — the header was too big
+        trace_id = request.headers.get("X-Gordo-Trace-Id", "")
+        state.timelines[trace_id] = {
+            "trace_id": trace_id,
+            "started": time.time(),
+            "duration_ms": 8.0,
+            "spans": [
+                {"name": "device_execute", "start_ms": 1.0,
+                 "duration_ms": 5.0, "thread": "collector"},
+            ],
+            "events": [],
+        }
+        headers = {}
+        if request.headers.get(stitch.TIMELINE_HEADER):
+            headers[stitch.TIMELINE_TRUNCATED_HEADER] = "99999"
+        return reply({"worker": state.name}, headers=headers)
+
+    return app
+
+
+def test_truncated_stitch_pulls_from_worker_on_debug_read():
+    states = {}
+    specs = [
+        WorkerSpec(f"worker-{i}", i, "127.0.0.1", _free_port())
+        for i in range(2)
+    ]
+
+    def factory(spec):
+        state = states.setdefault(
+            spec.name, _ScriptedWorkerState(spec.name)
+        )
+        return _ThreadWorker(spec, _scripted_app(state))
+
+    router = assemble_fleet(specs, factory, project="proj", respawn=False)
+    router.supervisor.start_all()
+    assert len(router.supervisor.wait_ready(timeout=10)) == 2
+    server = make_server("127.0.0.1", 0, router, threaded=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    import requests as req
+
+    try:
+        response = req.post(
+            f"{base}/gordo/v0/proj/mach-x/prediction",
+            data=json.dumps({"X": [[0.0]]}),
+            headers={"Content-Type": "application/json"}, timeout=10,
+        )
+        assert response.status_code == 200
+        trace_id = response.headers["X-Gordo-Trace-Id"]
+        owner = response.headers["X-Gordo-Worker"]
+        # the routed timeline noted the truncation, not a merge
+        full = req.get(
+            f"{base}/debug/requests/{trace_id}", timeout=10
+        ).json()
+        merged_names = {s["name"] for s in full["spans"]}
+        assert "route" in merged_names
+        # the pull fallback fetched the worker's full timeline ON READ
+        assert "device_execute" in merged_names
+        worker_span = [
+            s for s in full["spans"] if s["name"] == "device_execute"
+        ][0]
+        assert worker_span["process"] == owner
+        assert states[owner].debug_hits == 1
+        # second read does NOT pull again (claimed once)
+        req.get(f"{base}/debug/requests/{trace_id}", timeout=10)
+        assert states[owner].debug_hits == 1
+        # chrome export shows two process lanes
+        chrome = req.get(
+            f"{base}/debug/requests/{trace_id}?format=chrome", timeout=10
+        ).json()
+        pids = {
+            e["pid"] for e in chrome["traceEvents"] if e.get("ph") == "X"
+        }
+        assert len(pids) >= 2
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
+        router.supervisor.stop_all()
+        router.close()
+
+
+# -- end to end: 2 real ModelServer workers -----------------------------------
+
+
+def test_e2e_two_real_workers_one_merged_trace(tmp_path_factory):
+    """The acceptance scenario: a routed request's merged trace carries
+    ONE trace id with both the router ``route`` span and the placed
+    worker's ``device_execute`` span, clock-aligned under ``route``;
+    the aggregate scrape parses with worker labels and ``gordo_slo_*``
+    present; ``/slo`` answers on router and worker."""
+    import requests as req
+
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.server import build_app
+
+    model_dir = provide_saved_model(
+        "mach-1",
+        {"Pipeline": {"steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                  "dims": [4], "epochs": 1,
+                                  "batch_size": 32}},
+        ]}},
+        {
+            "type": "RandomDataset",
+            "train_start_date": "2023-01-01T00:00:00+00:00",
+            "train_end_date": "2023-01-03T00:00:00+00:00",
+            "tag_list": ["tag-a", "tag-b", "tag-c"],
+        },
+        str(tmp_path_factory.mktemp("slo-e2e") / "mach-1"),
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    specs = [
+        WorkerSpec(f"worker-{i}", i, "127.0.0.1", _free_port())
+        for i in range(2)
+    ]
+    apps = {}
+
+    def factory(spec):
+        app = apps.get(spec.name)
+        if app is None:
+            app = apps[spec.name] = build_app(
+                {"mach-1": model_dir}, project="proj",
+                worker_id=spec.worker_id,
+            )
+        return _ThreadWorker(spec, app)
+
+    router = assemble_fleet(specs, factory, project="proj", respawn=False)
+    router.supervisor.start_all()
+    assert len(router.supervisor.wait_ready(timeout=30)) == 2
+    server = make_server("127.0.0.1", 0, router, threaded=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        owner = router.placement.replica_set("mach-1")[0]
+        response = req.post(
+            f"{base}/gordo/v0/proj/mach-1/prediction",
+            data=json.dumps({"X": [[0.1, 0.2, 0.3]] * 2}),
+            headers={"Content-Type": "application/json"}, timeout=60,
+        )
+        assert response.status_code == 200
+        trace_id = response.headers["X-Gordo-Trace-Id"]
+        # no stitched header leaks to the CLIENT of the router
+        assert stitch.TIMELINE_HEADER not in response.headers
+
+        # -- ONE merged trace on the router
+        full = req.get(
+            f"{base}/debug/requests/{trace_id}", timeout=10
+        ).json()
+        assert full["trace_id"] == trace_id
+        by_name = {}
+        for span in full["spans"]:
+            by_name.setdefault(span["name"], span)
+        assert "route" in by_name
+        assert "device_execute" in by_name
+        assert by_name["device_execute"]["process"] == owner
+        # clock-aligned: every worker span nests inside route
+        route = by_name["route"]
+        route_end = route["start_ms"] + route["duration_ms"]
+        for span in full["spans"]:
+            if span.get("process"):
+                assert span["start_ms"] >= route["start_ms"] - 2.0
+                assert (
+                    span["start_ms"] + span["duration_ms"]
+                    <= route_end + 2.0
+                )
+        # chrome export: two process lanes, worker lane named
+        chrome = req.get(
+            f"{base}/debug/requests/{trace_id}?format=chrome",
+            timeout=10,
+        ).json()
+        complete = [
+            e for e in chrome["traceEvents"] if e.get("ph") == "X"
+        ]
+        assert {e["pid"] for e in complete} == {1, 2}
+        lanes = {
+            e["args"]["name"]
+            for e in chrome["traceEvents"]
+            if e.get("name") == "process_name"
+        }
+        assert owner in lanes
+
+        # -- aggregate scrape parses, worker-labeled, slo series present
+        text = req.get(
+            f"{base}/metrics?format=prometheus&aggregate=1", timeout=30
+        ).text
+        samples = exposition.parse_prometheus_text(text)
+        assert "gordo_slo_attainment" in samples
+        assert "gordo_slo_burn_rate" in samples
+        worker_labeled = {
+            labels.get("worker")
+            for labels, _ in samples["gordo_slo_attainment"]
+        }
+        assert worker_labeled  # gauges carry per-source worker labels
+        assert "gordo_server_request_duration_seconds_bucket" in samples
+
+        # -- /slo on router and worker
+        router_slo = req.get(f"{base}/slo", timeout=10).json()
+        assert router_slo["enabled"] is True
+        names = {o["name"] for o in router_slo["objectives"]}
+        assert {"route-latency", "route-availability"} <= names
+        worker_base = router.supervisor.specs[owner].base_url
+        worker_slo = req.get(f"{worker_base}/slo", timeout=10).json()
+        assert worker_slo["enabled"] is True
+        assert {o["name"] for o in worker_slo["objectives"]} == {
+            "scoring-latency", "scoring-availability",
+        }
+        assert "scoring-latency" in worker_slo["attribution"]
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
+        router.supervisor.stop_all()
+        router.close()

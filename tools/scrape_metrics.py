@@ -36,7 +36,7 @@ REQUIRED_SERIES = (
 )
 
 
-def scrape(url: str, timeout: float = 10.0) -> str:
+def scrape(url: str, timeout: float = 10.0, aggregate: bool = False) -> str:
     import requests
 
     if "://" not in url:  # accept host:port/metrics shorthand
@@ -46,12 +46,19 @@ def scrape(url: str, timeout: float = 10.0) -> str:
         # and pretty-prints (a bare ?format=prometheus scrape is strict
         # v0.0.4 with none, for classic Prometheus parsers)
         url += "?format=prometheus&exemplars=1"
+        if aggregate:
+            # the router's scrape-of-scrapes: worker registries merged
+            # (counters summed, histogram buckets merged, gauges
+            # worker-labeled) — ARCHITECTURE §18
+            url += "&aggregate=1"
     response = requests.get(url, timeout=timeout)
     response.raise_for_status()
     return response.text
 
 
-def validate(text: str, require_gordo: bool = False) -> int:
+def validate(
+    text: str, require_gordo: bool = False, aggregated: bool = False
+) -> int:
     from gordo_components_tpu.observability.exposition import (
         parse_prometheus_text,
     )
@@ -116,13 +123,51 @@ def validate(text: str, require_gordo: bool = False) -> int:
             for error in bad_names:
                 print(f"BAD metric name: {error}", file=sys.stderr)
             return 1
+        # every label on a gordo_* series must come from the §7
+        # allowlist — the SAME set the static checker holds metric
+        # DECLARATIONS to, applied to the live exposition (this is how
+        # an aggregated scrape's added `worker` label is validated:
+        # it is a documented allowlist member, not ad-hoc)
+        from gordo_components_tpu.analysis.metrics_conventions import (
+            ALLOWED_LABELS,
+        )
+
+        exposition_only = {"le", "quantile"}
+        bad_labels = sorted({
+            f"{name}{{{label}=...}}"
+            for name in samples
+            if name.startswith("gordo_")
+            for labels, _ in samples[name]
+            for label in labels
+            if label not in ALLOWED_LABELS and label not in exposition_only
+        })
+        if bad_labels:
+            for entry in bad_labels:
+                print(f"LABEL outside the §7 allowlist: {entry}",
+                      file=sys.stderr)
+            return 1
         if not exemplars:
             # a warm traced request just ran (--spawn) or the operator
             # asked for the full gordo contract: at least one histogram
-            # bucket must link to a concrete trace
+            # bucket must link to a concrete trace — and under
+            # --aggregate this doubles as the exemplars-survive-
+            # aggregation gate (the merge keeps the newest per bucket)
             print("MISSING exemplars: no histogram bucket carries a "
                   "trace_id exemplar", file=sys.stderr)
             return 1
+        if aggregated:
+            worker_labeled = [
+                name for name in sorted(samples)
+                if name.startswith("gordo_")
+                and any("worker" in labels for labels, _ in samples[name])
+            ]
+            if not worker_labeled:
+                print("MISSING worker labels: aggregated exposition "
+                      "carries no worker-labeled gordo_* series",
+                      file=sys.stderr)
+                return 1
+            print(f"aggregated: {len(worker_labeled)} worker-labeled "
+                  "gordo_* families")
     return 0
 
 
@@ -197,6 +242,10 @@ def main() -> int:
     parser.add_argument("--require-gordo", action="store_true",
                         help="also fail when the standard gordo server "
                              "series are absent")
+    parser.add_argument("--aggregate", action="store_true",
+                        help="scrape the router's scrape-of-scrapes "
+                             "(?aggregate=1) and require worker-labeled "
+                             "series under --require-gordo")
     parser.add_argument("--timeout", type=float, default=10.0)
     args = parser.parse_args()
 
@@ -205,11 +254,16 @@ def main() -> int:
     if not args.url:
         parser.error("either a URL or --spawn is required")
     try:
-        text = scrape(args.url, timeout=args.timeout)
+        text = scrape(args.url, timeout=args.timeout,
+                      aggregate=args.aggregate)
     except Exception as exc:
         print(f"UNREACHABLE: {args.url}: {exc!r}", file=sys.stderr)
         return 2
-    return validate(text, require_gordo=args.require_gordo)
+    return validate(
+        text,
+        require_gordo=args.require_gordo,
+        aggregated=args.aggregate and args.require_gordo,
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,398 @@
+#!/usr/bin/env python
+"""SLO + stitching smoke: the fleet observability plane end to end
+(``make slo-smoke``).
+
+Two REAL worker server processes behind the router, one shared models
+tree. The experiment (ISSUE 10 acceptance):
+
+- **phase A (healthy)**: scoring traffic through the router; a routed
+  request's trace on the ROUTER must be one merged Chrome/Perfetto
+  trace with spans from BOTH processes (router ``route`` lane + the
+  placed worker's ``device_execute`` lane), clock-aligned under
+  ``route``; ``gordo trace dump`` against the router emits the same
+  JSON; the aggregate scrape (``?aggregate=1``) parses under the
+  validating parser with worker labels and merged histogram buckets;
+  ``gordo_slo_*`` series answer on router and worker; and NO burn-rate
+  crossing fires;
+- **phase B (faulted)**: the workers restart with an injected 400 ms
+  engine-dispatch latency (``GORDO_FAULTS``) and a tiny stitch size cap
+  (forcing the pull fallback). Traffic + a bounded number of
+  evaluation ticks must TRIP the fast-window burn-rate crossing — it
+  shows in ``/slo`` and as a flight-recorder event — and the truncated
+  stitch must still produce a two-lane merged trace via the pull path.
+
+Exit codes: 0 = all checks passed, 1 = at least one failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-04T00:00:00+00:00",
+    "tag_list": ["tag-a", "tag-b", "tag-c"],
+}
+MODEL_CONFIG = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                  "dims": [6], "epochs": 1,
+                                  "batch_size": 32}},
+        ]
+    }
+}
+MACHINES = ("mach-a", "mach-b")
+N_WORKERS = 2
+
+# tight SLO so phase B's injected 400 ms latency burns fast, and short
+# windows so the burn is measurable within a smoke-sized run
+SLO_ENV = {
+    "GORDO_SLO_LATENCY_MS": "150",
+    "GORDO_SLO_FAST_WINDOW": "30",
+    "GORDO_SLO_SLOW_WINDOW": "300",
+    "GORDO_SLO_EVAL_INTERVAL": "0",
+}
+
+_failures: list = []
+
+
+def check(ok: bool, message: str) -> None:
+    marker = "ok  " if ok else "FAIL"
+    print(f"  {marker} {message}")
+    if not ok:
+        _failures.append(message)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _score(session, base, machine, timeout=60):
+    return session.post(
+        f"{base}/gordo/v0/slo-smoke/{machine}/prediction",
+        data=json.dumps({"X": [[0.1, 0.2, 0.3]] * 3}),
+        headers={"Content-Type": "application/json"},
+        timeout=timeout,
+    )
+
+
+def _breaches(session, base) -> dict:
+    """{objective: fast-window breach count} from a /slo read (each
+    read is also an evaluation tick: the engine is scrape-driven)."""
+    body = session.get(f"{base}/slo", timeout=10).json()
+    return {
+        objective["name"]: objective["windows"]["fast"]["breaches"]
+        for objective in body.get("objectives", [])
+    }
+
+
+def main() -> int:
+    import logging
+    import tempfile
+
+    import requests
+    from werkzeug.serving import make_server
+
+    logging.getLogger("werkzeug").setLevel(logging.WARNING)
+    # the router's own SLO engine runs in THIS process
+    os.environ.update(SLO_ENV)
+
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.observability.exposition import (
+        parse_prometheus_text,
+    )
+    from gordo_components_tpu.router import (
+        SubprocessWorker,
+        assemble_fleet,
+        server_worker_argv,
+        worker_specs,
+    )
+
+    session = requests.Session()
+    with tempfile.TemporaryDirectory() as tmp:
+        models_root = os.path.join(tmp, "models")
+        os.makedirs(models_root)
+        print(f"building {len(MACHINES)} throwaway machines ...",
+              file=sys.stderr)
+        for name in MACHINES:
+            provide_saved_model(
+                name, MODEL_CONFIG, DATA_CONFIG,
+                os.path.join(models_root, name),
+                evaluation_config={"cv_mode": "build_only"},
+            )
+
+        specs = [
+            spec._replace(port=_free_port())
+            for spec in worker_specs(N_WORKERS, _free_port())
+        ]
+        log_dir = os.path.join(tmp, "logs")
+        os.makedirs(log_dir)
+        # mutated between phases; respawned workers pick it up
+        worker_env = {
+            "JAX_PLATFORMS": "cpu",
+            "GORDO_DRAIN_TIMEOUT": "10",
+            **SLO_ENV,
+        }
+
+        def factory(spec):
+            log = open(os.path.join(log_dir, f"{spec.name}.log"), "ab")
+            return SubprocessWorker(
+                spec,
+                server_worker_argv(spec, models_root, project="slo-smoke"),
+                env=dict(worker_env),
+                stdout=log, stderr=log,
+            )
+
+        router = assemble_fleet(
+            specs, factory, project="slo-smoke", models_root=models_root,
+            breaker_recovery=3.0, boot_grace=120.0, respawn=False,
+        )
+        supervisor = router.supervisor
+        print(f"spawning {N_WORKERS} worker processes ...", file=sys.stderr)
+        supervisor.start_all()
+        ready = supervisor.wait_ready(timeout=300)
+        check(len(ready) == N_WORKERS,
+              f"all {N_WORKERS} workers became ready (got {ready})")
+        if len(ready) != N_WORKERS:
+            supervisor.stop_all(grace=5)
+            return 1
+        front = make_server("127.0.0.1", 0, router, threaded=True)
+        front_thread = threading.Thread(
+            target=front.serve_forever, daemon=True
+        )
+        front_thread.start()
+        base = f"http://127.0.0.1:{front.server_port}"
+        try:
+            # ----- phase A: healthy -------------------------------------
+            print("[1/4] merged two-process trace on the router",
+                  file=sys.stderr)
+            for machine in MACHINES:  # warm both workers' programs
+                response = _score(session, base, machine, timeout=120)
+                check(response.status_code == 200,
+                      f"warm scoring 200 for {machine}")
+            response = _score(session, base, MACHINES[0])
+            trace_id = response.headers.get("X-Gordo-Trace-Id", "")
+            owner = response.headers.get("X-Gordo-Worker", "?")
+            check(bool(trace_id), f"trace id echoed ({trace_id})")
+            full = session.get(
+                f"{base}/debug/requests/{trace_id}", timeout=10
+            ).json()
+            names = {s["name"] for s in full.get("spans", [])}
+            check("route" in names, "router route span recorded")
+            check("device_execute" in names,
+                  f"worker device_execute span stitched in (got "
+                  f"{sorted(names)})")
+            processes = {
+                s.get("process") for s in full.get("spans", [])
+                if s.get("process")
+            }
+            check(len(processes) == 1,
+                  f"worker spans carry ONE process lane ({processes})")
+            route = next(
+                s for s in full["spans"] if s["name"] == "route"
+            )
+            route_end = route["start_ms"] + route["duration_ms"]
+            nested = all(
+                s["start_ms"] >= route["start_ms"] - 2.0
+                and s["start_ms"] + s["duration_ms"] <= route_end + 2.0
+                for s in full["spans"] if s.get("process")
+            )
+            check(nested, "stitched worker spans clock-aligned inside "
+                          "the route window")
+            chrome = session.get(
+                f"{base}/debug/requests/{trace_id}?format=chrome",
+                timeout=10,
+            ).json()
+            complete = [
+                e for e in chrome.get("traceEvents", [])
+                if e.get("ph") == "X"
+            ]
+            pids = {e["pid"] for e in complete}
+            check(len(pids) >= 2,
+                  f"chrome export has >= 2 process lanes (pids {pids})")
+
+            # the CLI verb against the ROUTER emits the same chrome JSON
+            from click.testing import CliRunner
+
+            from gordo_components_tpu.cli import gordo
+
+            try:
+                runner = CliRunner(mix_stderr=False)  # click < 8.2
+            except TypeError:
+                runner = CliRunner()
+            result = runner.invoke(
+                gordo, ["trace", "dump", trace_id, "--base-url", base],
+            )
+            check(result.exit_code == 0, "gordo trace dump exits 0")
+            try:
+                dumped = json.loads(result.stdout)
+                check(
+                    dumped.get("traceEvents") == chrome.get("traceEvents"),
+                    "gordo trace dump emits the router's merged chrome "
+                    "JSON",
+                )
+            except ValueError:
+                check(False, "gordo trace dump output is valid JSON")
+
+            print("[2/4] aggregate scrape + slo series", file=sys.stderr)
+            text = session.get(
+                f"{base}/metrics?format=prometheus&aggregate=1"
+                "&exemplars=1",
+                timeout=60,
+            ).text
+            try:
+                samples, exemplars = parse_prometheus_text(
+                    text, return_exemplars=True
+                )
+            except ValueError as exc:
+                check(False, f"aggregate exposition parses ({exc})")
+                samples, exemplars = {}, {}
+            else:
+                check(True, "aggregate exposition parses under the "
+                            "validating parser")
+            worker_values = {
+                labels.get("worker")
+                for rows in samples.values()
+                for labels, _ in rows
+                if "worker" in labels
+            }
+            check(
+                any(v and v.startswith("worker-") for v in worker_values),
+                f"worker labels present in the aggregate "
+                f"({sorted(filter(None, worker_values))[:6]})",
+            )
+            # compare the PREDICTION series only: probe endpoints keep
+            # accruing between the two reads, scoring does not
+            def _prediction_count(rows):
+                return sum(
+                    value for labels, value in rows
+                    if labels.get("endpoint") == "prediction"
+                )
+
+            fleet_count = _prediction_count(samples.get(
+                "gordo_server_request_duration_seconds_count", []
+            ))
+            per_worker = 0.0
+            for spec in specs:
+                wtext = session.get(
+                    f"{spec.base_url}/metrics?format=prometheus",
+                    timeout=10,
+                ).text
+                wsamples = parse_prometheus_text(wtext)
+                per_worker += _prediction_count(wsamples.get(
+                    "gordo_server_request_duration_seconds_count", []
+                ))
+            check(
+                fleet_count == per_worker > 0,
+                f"histogram buckets merged across workers (fleet "
+                f"{fleet_count} == sum-of-workers {per_worker})",
+            )
+            check(bool(exemplars),
+                  "exemplars survived aggregation")
+            check("gordo_slo_attainment" in samples
+                  and "gordo_slo_burn_rate" in samples,
+                  "gordo_slo_* series in the router aggregate")
+            worker_slo = session.get(
+                f"{specs[0].base_url}/slo", timeout=10
+            ).json()
+            check(worker_slo.get("enabled") is True,
+                  "/slo answers on the worker")
+
+            print("[3/4] no burn-rate crossing without faults",
+                  file=sys.stderr)
+            for _ in range(10):
+                _score(session, base, MACHINES[0])
+            for _ in range(5):  # evaluation ticks (scrape-driven)
+                _breaches(session, base)
+                _breaches(session, f"{specs[0].base_url}")
+                time.sleep(0.2)
+            healthy_router = _breaches(session, base)
+            healthy_worker = _breaches(session, specs[0].base_url)
+            check(
+                all(v == 0 for v in healthy_router.values())
+                and all(v == 0 for v in healthy_worker.values()),
+                f"zero fast-window breaches while healthy "
+                f"(router {healthy_router}, worker {healthy_worker})",
+            )
+
+            # ----- phase B: injected latency ----------------------------
+            print("[4/4] injected dispatch latency trips the fast "
+                  "burn-rate window", file=sys.stderr)
+            worker_env["GORDO_FAULTS"] = "engine-dispatch:*:latency:0.4"
+            worker_env["GORDO_TIMELINE_MAX_BYTES"] = "256"
+            for spec in specs:
+                supervisor.respawn(spec.name, cause="smoke-faults")
+            ready = supervisor.wait_ready(timeout=300)
+            check(len(ready) == N_WORKERS,
+                  f"workers respawned with faults ({ready})")
+            tripped = False
+            trace_b = ""
+            for tick in range(20):  # bounded number of evaluation ticks
+                response = _score(session, base, MACHINES[0], timeout=120)
+                if response.status_code == 200 and not trace_b:
+                    trace_b = response.headers.get("X-Gordo-Trace-Id", "")
+                worker_b = _breaches(session, specs[0].base_url)
+                router_b = _breaches(session, base)
+                if any(v > 0 for v in worker_b.values()) and any(
+                    v > 0 for v in router_b.values()
+                ):
+                    tripped = True
+                    break
+                time.sleep(0.3)
+            check(tripped,
+                  f"fast-window crossing tripped on worker AND router "
+                  f"within {tick + 1} evaluation ticks")
+            # the crossing is a flight-recorder event (error ring)
+            debug = session.get(
+                f"{base}/debug/requests", timeout=10
+            ).json()
+            slo_errors = [
+                row for row in debug.get("errors", [])
+                if str(row.get("trace_id", "")).startswith("slo-")
+            ]
+            check(bool(slo_errors),
+                  f"burn-rate crossing recorded as a flight-recorder "
+                  f"event ({[r['trace_id'] for r in slo_errors][:2]})")
+            # truncated stitch (tiny cap) still merges via the pull path
+            full = session.get(
+                f"{base}/debug/requests/{trace_b}", timeout=10
+            ).json()
+            names = {s["name"] for s in full.get("spans", [])}
+            check(
+                "device_execute" in names
+                and any(s.get("process") for s in full.get("spans", [])),
+                f"truncated stitch pulled from the worker on read "
+                f"(spans {sorted(names)})",
+            )
+        finally:
+            front.shutdown()
+            front_thread.join(timeout=5)
+            supervisor.stop_all(grace=10)
+            router.close()
+            session.close()
+
+    if _failures:
+        print(f"\nSLO SMOKE FAILED: {len(_failures)} check(s)",
+              file=sys.stderr)
+        return 1
+    print("\nslo smoke passed: one merged two-process trace, a validated "
+          "fleet scrape, and a burn-rate engine that trips on injected "
+          "latency and stays quiet without it")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
