@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke smoke images builder-image server-image watchman-image
 
 # invariant linter (docs/ARCHITECTURE.md §17): lock discipline against
 # the declared hierarchy, blocking-calls-under-hot-locks, unbound
@@ -90,11 +90,21 @@ router-smoke:
 slo-smoke:
 	JAX_PLATFORMS=cpu python tools/slo_smoke.py
 
+# precision-ladder check (§19): a mixed f32/bf16/int8 fleet scores
+# within each rung's declared parity budget of the all-f32 reference
+# (f32 bit-identical; threshold-flip drift reported), the fused
+# megabatch path never mixes dtypes, a warm boot of the quantized fleet
+# pays zero fresh XLA compiles, and --precision pins survive the
+# build → manifest → /healthz round trip
+quant-smoke:
+	JAX_PLATFORMS=cpu python tools/quant_smoke.py
+
 # the full smoke battery: invariant lint + exposition + resilience +
 # store integrity + serving data plane + span attribution + cold-start
 # economics + cross-machine megabatching + the horizontal serving tier
 # + the fleet observability plane (stitching / aggregation / SLO)
-smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke
+# + the precision ladder (parity budgets / dtype routing / warm boots)
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke
 
 images: builder-image server-image watchman-image
 

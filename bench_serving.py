@@ -41,7 +41,9 @@ block; 0 skips it), BENCH_SERVE_WARM_KB (override the derived batch-warm
 bound — see warm_batch_bound), BENCH_SERVE_XMACHINE (1 — include the
 cross-machine megabatch saturation block; 0 skips it),
 BENCH_SERVE_MULTIWORKER (1 — include the 1-vs-N worker-process router
-block; 0 skips it), BENCH_SERVE_WORKERS (2 — the N rung),
+block; 0 skips it), BENCH_SERVE_PRECISION (1 — include the
+precision-ladder f32/bf16/int8 A/B block; 0 skips it),
+BENCH_SERVE_WORKERS (2 — the N rung),
 BENCH_SERVE_MW_MACHINES (8) / BENCH_SERVE_MW_REQUESTS (40 per thread)
 — the multi-worker block's fleet and load sizes. The engine's own
 GORDO_MEGABATCH / GORDO_FILL_WINDOW_US / GORDO_MEGABATCH_RESIDENCY knobs
@@ -472,6 +474,19 @@ def measure(
         else None
     )
 
+    # -- precision ladder (ISSUE 11 / §19): the same fleet at f32, bf16,
+    # and int8, each through its own engine — 12-thread spread rps +
+    # latency per rung, parity error vs the f32 reference, and the
+    # resident-machine capacity each rung buys at fixed device memory.
+    # BENCH_SERVE_PRECISION=0 skips; replicated mode only (the ladder's
+    # residency-compounding case).
+    precision_block = None
+    if (
+        engine.mesh is None
+        and os.environ.get("BENCH_SERVE_PRECISION", "1") == "1"
+    ):
+        precision_block = measure_precision(models, X, n_requests)
+
     # -- cross-machine megabatch saturation (ISSUE 7): 12 client threads
     # SPREAD over >= 8 distinct machines — each thread walks its own
     # offset through the spread set, so concurrent dispatch windows
@@ -612,6 +627,10 @@ def measure(
         # this block's fused-dispatch delta (fusion_ratio > 1 ⇔ fewer
         # device dispatches than requests). None = BENCH_SERVE_XMACHINE=0
         "cross_machine": cross_machine,
+        # the precision ladder (§19): per-rung rps/p50/p99 at 12-thread
+        # spread, parity error vs f32, and resident-machine capacity at
+        # fixed memory. None = BENCH_SERVE_PRECISION=0 or shard mode
+        "precision": precision_block,
         # engine-resolved megabatch config + lifetime fusion counters
         "megabatch": stats["megabatch"],
         # per-format serialization cost vs the device dispatch cost above
@@ -639,6 +658,206 @@ def measure(
         # compile cache (None = BENCH_SERVE_COLDSTART=0)
         "cold_start": cold_start,
     }
+
+
+def measure_precision(models, X, n_requests: int) -> dict:
+    """The precision-ladder A/B (§19): ONE fleet served at each rung
+    (f32 / bf16 / int8) through three otherwise-identical replicated
+    engines. Per rung: 12-thread spread throughput + latency (the
+    megabatch workload, where the ladder's smaller gathers pay off),
+    the worst-machine parity error against the f32 reference on the
+    normalized total-score ruler (with its declared budget beside it),
+    and the residency economics — stacked bytes per machine and how
+    many machines fit a fixed 1 GiB of device memory at that rung, the
+    capacity half of the ladder's payoff."""
+    import jax
+
+    from gordo_components_tpu import precision as precision_mod
+    from gordo_components_tpu.server.engine import ServingEngine, _round_up_pow2
+
+    names = sorted(models)
+    spread = names[: min(max(8, 12), len(names))]
+    threads = 12
+    per_thread = max(4, n_requests // threads)
+    rounds = 3
+    gib = 1 << 30
+    rungs = ("f32", "bf16", "int8")
+    out: dict = {
+        "workers": threads, "machines": len(spread), "rounds": rounds,
+        "rungs": {},
+    }
+    engines = {
+        rung: ServingEngine(models, precisions={name: rung for name in names})
+        for rung in rungs
+    }
+    try:
+        for rung, engine in engines.items():
+            # settle: every first-dispatch compile + the fused batch
+            # shapes a 12-thread rung can coalesce (same rationale as
+            # the main saturation warm loop)
+            for _ in range(2):
+                for name in spread:
+                    engine.anomaly(name, X)
+                engine.quiesce()
+            bucket, _ = engine._by_name[spread[0]]
+            x_padded, _ = engine._prepare(bucket, X)
+            rows_padded = x_padded.shape[0]
+            kb = 1
+            while kb <= min(warm_batch_bound(engine), 16):
+                if bucket._mega_enabled:
+                    jax.block_until_ready(
+                        bucket._mega_program(rows_padded, kb)(
+                            bucket._warm_mega_stack(),
+                            np.zeros((kb,), np.int32),
+                            np.repeat(x_padded[None], kb, axis=0),
+                        )
+                    )
+                kb *= 2
+
+        def sweep(engine):
+            def one(t: int):
+                lat = []
+                for i in range(per_thread):
+                    name = spread[(t + i) % len(spread)]
+                    started = time.perf_counter()
+                    engine.anomaly(name, X)
+                    lat.append(time.perf_counter() - started)
+                return lat
+
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                list(pool.map(one, range(threads)))  # settle threads
+                started = time.perf_counter()
+                lat_lists = list(pool.map(one, range(threads)))
+            elapsed = time.perf_counter() - started
+            engine.quiesce()
+            lats = [v for lat in lat_lists for v in lat]
+            return len(lats) / elapsed, lats
+
+        # INTERLEAVED rounds (the perf_smoke overhead-gate trick): every
+        # rung sees the same box in every round, so a scheduler/GC
+        # straggler degrades one round of every rung instead of one
+        # rung's whole measurement — per-rung rps is the median round
+        rps_rounds: dict = {rung: [] for rung in rungs}
+        lat_pool: dict = {rung: [] for rung in rungs}
+        for _ in range(rounds):
+            for rung in rungs:
+                rps, lats = sweep(engines[rung])
+                rps_rounds[rung].append(rps)
+                lat_pool[rung].extend(lats)
+        # on-device cost of one fused 8-request dispatch per rung,
+        # pipelined (sync once per rep) — the rung-comparison anchor.
+        # The threaded rps above is host-overhead-bound and carries this
+        # rig's multi-x scheduler noise; this is the same pipelined-
+        # dispatch ruler as the bench's headline ``value`` metric, where
+        # the ladder's smaller weight gathers actually land. Reps are
+        # INTERLEAVED across rungs (median of 5) so box-state drift
+        # degrades one rep of every rung, never one rung's measurement.
+        k = 8
+        dispatch_setup = {}
+        for rung in rungs:
+            bucket, _ = engines[rung]._by_name[spread[0]]
+            x_padded, _ = engines[rung]._prepare(bucket, X)
+            rows_padded = x_padded.shape[0]
+            if bucket._mega_enabled:
+                program = bucket._mega_program(rows_padded, k)
+                stack = bucket._warm_mega_stack()
+            else:
+                program = bucket._program(rows_padded, k)
+                stack = bucket.stacked
+            slots = np.arange(k, dtype=np.int32)
+            xs = np.repeat(x_padded[None], k, axis=0)
+            jax.block_until_ready(program(stack, slots, xs))
+            dispatch_setup[rung] = (program, stack, slots, xs)
+        dispatch_reps: dict = {rung: [] for rung in rungs}
+        for _ in range(5):
+            for rung in rungs:
+                program, stack, slots, xs = dispatch_setup[rung]
+                n_pipe = 80
+                started = time.perf_counter()
+                outs = [program(stack, slots, xs) for _ in range(n_pipe)]
+                jax.block_until_ready(outs)
+                dispatch_reps[rung].append(
+                    (time.perf_counter() - started) / n_pipe * 1000.0
+                )
+
+        reference: dict = {}
+        for rung in rungs:
+            engine = engines[rung]
+            # parity vs the f32 reference (worst machine), on the same
+            # normalized ruler the smoke gate uses
+            worst = 0.0
+            for name in spread:
+                total = engine.anomaly(name, X).total_anomaly_score
+                if rung == "f32":
+                    reference[name] = total
+                else:
+                    worst = max(worst, precision_mod.parity_error(
+                        reference[name], total
+                    ))
+            stacked_bytes = sum(
+                int(np.asarray(leaf).nbytes)
+                for b in engine._buckets
+                for leaf in jax.tree_util.tree_leaves(b.stacked)
+            )
+            per_machine = stacked_bytes / max(1, len(names))
+            lat_ms = np.asarray(lat_pool[rung]) * 1000.0
+            out["rungs"][rung] = {
+                "device_dispatch_ms": round(
+                    float(np.median(dispatch_reps[rung])), 3
+                ),
+                "rps": round(float(np.median(rps_rounds[rung])), 1),
+                "rps_rounds": [round(r, 1) for r in rps_rounds[rung]],
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                "parity_error_vs_f32": (
+                    None if rung == "f32" else float(f"{worst:.3g}")
+                ),
+                "parity_budget": (
+                    None if rung == "f32"
+                    else precision_mod.error_budget(rung)
+                ),
+                "stacked_bytes_per_machine": int(per_machine),
+                # the residency-compounding headline: machines resident
+                # per fixed GiB of device memory at this rung
+                "machines_per_gib": int(gib / per_machine),
+            }
+    finally:
+        for engine in engines.values():
+            engine.close()
+    f32_rung = out["rungs"].get("f32") or {}
+    if f32_rung.get("device_dispatch_ms"):
+        # headline speedups ride the pipelined DEVICE dispatch (the
+        # stable ruler); the rps twin is reported per rung above for
+        # the concurrency view, noise and all
+        for rung in ("bf16", "int8"):
+            row = out["rungs"].get(rung) or {}
+            if not row:
+                continue
+            out[f"{rung}_dispatch_speedup_x"] = round(
+                f32_rung["device_dispatch_ms"] / row["device_dispatch_ms"], 3
+            )
+            # the acceptance headline: rung vs f32 at 12-thread
+            # SATURATION (median interleaved round) — where the ladder's
+            # halved/quartered weight traffic relieves the contended
+            # memory path
+            out[f"{rung}_saturation_speedup_x"] = round(
+                row["rps"] / f32_rung["rps"], 3
+            )
+            out[f"capacity_gain_{rung}_x"] = round(
+                row["machines_per_gib"] / f32_rung["machines_per_gib"], 2
+            )
+    import jax as _jax
+
+    if _jax.devices()[0].platform != "tpu":
+        out["note"] = (
+            "CPU-backend run: saturation speedups come from halved/"
+            "quartered weight traffic under 12-thread memory contention; "
+            "single-stream device_dispatch_ms carries bf16's XLA:CPU "
+            "conversion overhead instead (no bf16 compute units here — "
+            "that half of the win is a TPU anchor, like vs_baseline). "
+            "rps_rounds shows this rig's per-round scheduler noise."
+        )
+    return out
 
 
 def measure_cross_machine(engine, names, X, n_requests: int) -> dict:
@@ -1023,6 +1242,8 @@ def main() -> None:
             "cold_start": result.get("cold_start"),
             # cross-machine fused-batch stats (the megabatch headline)
             "cross_machine": result.get("cross_machine"),
+            # the precision ladder's per-rung rps/parity/capacity (§19)
+            "precision": result.get("precision"),
             # horizontal tier: 1 vs N worker processes at 12-thread
             # saturation + per-worker fusion ratios (the GIL-escape
             # headline)

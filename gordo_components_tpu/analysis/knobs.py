@@ -152,6 +152,20 @@ KNOBS: Dict[str, Knob] = dict(
         _knob("GORDO_MAX_ARTIFACT_BYTES", "2 GiB", "int",
               "bounded artifact loads: max decompressed tar bytes a "
               "model load will extract", "store"),
+        # -- precision ladder (§19) --------------------------------------
+        _knob("GORDO_PRECISION_DEFAULT", "f32", "str",
+              "build-time default rung on the serving precision ladder "
+              "(`f32`/`bf16`/`int8`); `--precision` on `build` and "
+              "`fleet-build` overrides, `--precision-map` pins per "
+              "machine", "build"),
+        _knob("GORDO_PARITY_RTOL_BF16", "0.02", "float",
+              "bf16 parity budget: max |bf16−f32| of total anomaly "
+              "scores, normalized to the mean f32 score (gated by "
+              "quant_smoke and the bench precision block)", "test"),
+        _knob("GORDO_PARITY_RTOL_INT8", "0.08", "float",
+              "int8 parity budget: same ruler as the bf16 budget, "
+              "looser — int8 trades more accuracy for 4x weight "
+              "compression", "test"),
         # -- build / multihost -------------------------------------------
         _knob("GORDO_FORCED_CPU", "0", "bool",
               "force the CPU backend even when an accelerator is visible "

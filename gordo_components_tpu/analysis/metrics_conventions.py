@@ -38,12 +38,13 @@ COMPONENTS = (
 # §7 label allowlist: low-cardinality enums only. ``machine``/``worker``/
 # ``target`` are bounded by fleet/tier size — the documented exceptions.
 # ``window`` is the two-value fast/slow burn-rate window enum (§18).
+# ``precision`` is the three-value f32/bf16/int8 ladder enum (§19).
 ALLOWED_LABELS = frozenset(
     {
         "endpoint", "status", "kind", "outcome", "path", "event", "phase",
         "reason", "stage", "name", "trigger", "format", "worker",
         "machine", "target", "cause", "point", "to", "where", "error",
-        "window",
+        "window", "precision",
     }
 )
 

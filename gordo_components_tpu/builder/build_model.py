@@ -164,30 +164,54 @@ def build_model(
     return model, build_metadata
 
 
+def cached_artifact_precision(model_dir: str) -> str:
+    """The precision a cached artifact's CURRENT generation actually
+    pins — compared against the requested rung on every cache hit (here
+    and in the fleet builder's resume scan), because the registry value
+    is the machine's shared output dir: a later re-precision build of
+    the same machine swaps CURRENT under the old key's entry. An
+    unreadable/garbage pin reads as a sentinel that matches nothing, so
+    the hit degrades to a rebuild rather than an exception."""
+    from .. import precision as precision_mod
+    from ..serializer import load_metadata
+
+    try:
+        return precision_mod.of_metadata(load_metadata(model_dir))
+    except ValueError:
+        return "<unreadable>"
+
+
 def calculate_model_key(
     name: str,
     model_config: Dict[str, Any],
     data_config: Dict[str, Any],
     gordo_version: Optional[str] = None,
     evaluation_config: Optional[Dict[str, Any]] = None,
+    precision: str = "f32",
 ) -> str:
     """md5 over (name, model config, data config, evaluation config,
     framework version) — the cache identity. Any change in any config or the
     framework version produces a new key; identical configs always hash
     identically (sorted-key JSON). ``evaluation_config`` participates so a
-    cached build_only artifact is never returned for a full_build request."""
-    payload = json.dumps(
-        {
-            "name": name,
-            "model_config": model_config,
-            "data_config": data_config,
-            "evaluation_config": evaluation_config or {},
-            "gordo_version": gordo_version or __version__,
-        },
-        sort_keys=True,
-        default=str,
-    )
-    return hashlib.md5(payload.encode()).hexdigest()
+    cached build_only artifact is never returned for a full_build request.
+
+    ``precision`` (§19) participates the same way — a cached f32 artifact
+    must never satisfy a ``--precision int8`` build, whose artifact
+    carries the quantized sidecar and a different manifest pin. The f32
+    default is deliberately EXCLUDED from the payload so every pre-ladder
+    cache key (and registry entry) stays valid."""
+    payload = {
+        "name": name,
+        "model_config": model_config,
+        "data_config": data_config,
+        "evaluation_config": evaluation_config or {},
+        "gordo_version": gordo_version or __version__,
+    }
+    if precision != "f32":
+        payload["precision"] = precision
+    return hashlib.md5(
+        json.dumps(payload, sort_keys=True, default=str).encode()
+    ).hexdigest()
 
 
 def provide_saved_model(
@@ -199,6 +223,7 @@ def provide_saved_model(
     model_register_dir: Optional[str] = None,
     replace_cache: bool = False,
     evaluation_config: Optional[Dict[str, Any]] = None,
+    precision: Optional[str] = None,
 ) -> str:
     """Idempotent build: returns the model dir, reusing a cached build when
     the config hash is registered and the artifact still VERIFIES — a
@@ -208,14 +233,24 @@ def provide_saved_model(
     The artifact lands as a new ``gen-NNNN/`` generation under
     ``output_dir`` with the ``CURRENT`` pointer swapped atomically
     (``store/``): a crash mid-build leaves any previous generation
-    serving, and ``gordo rollback`` can restore it after a bad build."""
+    serving, and ``gordo rollback`` can restore it after a bad build.
+
+    ``precision`` pins this machine's rung on the precision ladder (§19)
+    into the artifact's build metadata (``gordo build --precision``;
+    default resolves ``GORDO_PRECISION_DEFAULT`` → f32). Training always
+    runs f32 — precision shapes the SERVING artifact: the metadata pin
+    the engine reads, plus the quantized int8 sidecar when applicable."""
+    from .. import precision as precision_mod
+
+    precision = precision_mod.resolve_default(precision)
     if (evaluation_config or {}).get("cv_mode") == "cross_val_only":
         raise ValueError(
             "cv_mode='cross_val_only' skips the final fit and produces no "
             "servable artifact; use build_model() directly for evaluation runs"
         )
     cache_key = calculate_model_key(
-        name, model_config, data_config, evaluation_config=evaluation_config
+        name, model_config, data_config, evaluation_config=evaluation_config,
+        precision=precision,
     )
     if model_register_dir and not replace_cache:
         # get_value already resolves dangling pointers to None — the
@@ -227,17 +262,31 @@ def provide_saved_model(
                 # stay O(stats), not re-hash GBs — load() does the full
                 # hash when the artifact is actually deserialized
                 verify_artifact(resolve_artifact_dir(cached), deep=False)
+                cached_precision = cached_artifact_precision(cached)
             except StoreError as exc:
                 logger.warning(
                     "Cached artifact for %r fails verification (%s); "
                     "rebuilding", name, exc,
                 )
             else:
-                logger.info(
-                    "Model %r cache hit (key %s) -> %s", name, cache_key, cached
-                )
-                _M_BUILDS.labels("cached").inc()
-                return cached
+                if cached_precision != precision:
+                    # the registry value is the SHARED output dir, whose
+                    # CURRENT generation may meanwhile carry another
+                    # rung (a later re-precision build of the same
+                    # machine swapped it): a key hit alone must not
+                    # resurrect the other rung's artifact (§19)
+                    logger.warning(
+                        "Cached artifact for %r serves precision %s but "
+                        "this build pins %s; rebuilding",
+                        name, cached_precision, precision,
+                    )
+                else:
+                    logger.info(
+                        "Model %r cache hit (key %s) -> %s",
+                        name, cache_key, cached,
+                    )
+                    _M_BUILDS.labels("cached").inc()
+                    return cached
     if model_register_dir and replace_cache:
         disk_registry.delete_key(model_register_dir, cache_key)
 
@@ -245,10 +294,13 @@ def provide_saved_model(
         name, model_config, data_config, metadata, evaluation_config
     )
     build_metadata["model"]["cache_key"] = cache_key
+    # the manifest pin every serving layer reads (engine bucket dtype,
+    # /healthz facet, compile-cache key); validated again on load
+    build_metadata["precision"] = precision
     commit_generation(
         output_dir,
         lambda staging: write_artifact_files(
-            model, staging, metadata=build_metadata
+            model, staging, metadata=build_metadata, precision=precision
         ),
         name=name,
     )

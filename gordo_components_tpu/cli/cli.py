@@ -24,6 +24,8 @@ from typing import Optional
 import click
 import yaml
 
+from ..precision import PRECISIONS as _PRECISIONS
+
 EXIT_CONFIG = 64
 EXIT_DATA = 66
 # EX_SOFTWARE: a deterministic device-side failure (HBM OOM, invalid XLA
@@ -173,11 +175,18 @@ _TRACE_DIR_OPT = click.option(
               type=click.Choice(["full_build", "cross_val_only", "build_only"]))
 @click.option("--n-splits", default=3, show_default=True)
 @click.option("--print-cv-scores", is_flag=True, default=False)
+@click.option("--precision", default=None,
+              type=click.Choice(list(_PRECISIONS)),
+              help="this machine's rung on the serving precision ladder "
+                   "(ARCHITECTURE §19): pinned into the artifact's build "
+                   "metadata and validated on load; int8 also commits the "
+                   "quantized weights + per-tensor scales beside state.npz. "
+                   "Default: GORDO_PRECISION_DEFAULT, else f32")
 @_COMPILE_CACHE_OPT
 @_TRACE_DIR_OPT
 def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
-              metadata, cv_mode, n_splits, print_cv_scores, compile_cache_dir,
-              trace_dir):
+              metadata, cv_mode, n_splits, print_cv_scores, precision,
+              compile_cache_dir, trace_dir):
     """Build one machine's model (idempotent via the config-hash cache)."""
     from ..builder import provide_saved_model
     from ..dataset.dataset import InsufficientDataError
@@ -198,6 +207,7 @@ def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
                 metadata=user_meta,
                 model_register_dir=model_register_dir,
                 evaluation_config={"cv_mode": cv_mode, "n_splits": n_splits},
+                precision=precision,
             )
     except InsufficientDataError as exc:
         logger.error("Data error building %r: %s", name, exc)
@@ -240,6 +250,18 @@ def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
               type=int, help="multi-host: total process count")
 @click.option("--process-id", envvar="GORDO_PROCESS_ID", default=None,
               type=int, help="multi-host: this host's process index")
+@click.option("--precision", "precision_default", default=None,
+              type=click.Choice(list(_PRECISIONS)),
+              help="fleet-wide default rung on the serving precision "
+                   "ladder (§19); per-machine overrides via "
+                   "--precision-map. Default: GORDO_PRECISION_DEFAULT, "
+                   "else f32")
+@click.option("--precision-map", default=None,
+              help="per-machine precision pins: 'name=prec,name=prec' "
+                   "pairs or a YAML file mapping machine names to "
+                   "f32/bf16/int8; unmapped machines take --precision. "
+                   "Accuracy-sensitive machines stay f32 while the long "
+                   "tail drops precision")
 @click.option("--serving-cache/--no-serving-cache", default=True,
               show_default=True,
               help="after the build, export AOT-serialized SERVING "
@@ -252,8 +274,9 @@ def build_cmd(name, model_config, data_config, output_dir, model_register_dir,
 @_TRACE_DIR_OPT
 def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
                     n_splits, seed, slice_size, coordinator_address,
-                    num_processes, process_id, serving_cache,
-                    compile_cache_dir, trace_dir):
+                    num_processes, process_id, precision_default,
+                    precision_map, serving_cache, compile_cache_dir,
+                    trace_dir):
     """Build an entire fleet: machines are bucketed and trained as vmapped
     programs sharded over the device mesh. With ``--coordinator-address``
     (or on a TPU pod with autodetectable cluster metadata plus explicit
@@ -304,6 +327,8 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
                 "--n-devices is ignored in multi-host mode: the global "
                 "fleet mesh spans every device of every process"
             )
+        from ..precision import parse_precision_map
+
         mesh = global_fleet_mesh() if multihost else fleet_mesh(n_devices)
         results = build_fleet(
             machines,
@@ -314,6 +339,8 @@ def fleet_build_cmd(machine_config, output_dir, model_register_dir, n_devices,
             n_splits=n_splits,
             profile_dir=trace_dir,
             slice_size=slice_size or None,
+            precision_default=precision_default,
+            precision_map=parse_precision_map(precision_map),
         )
     except InsufficientDataError as exc:
         logger.error("Data error in fleet build: %s", exc)

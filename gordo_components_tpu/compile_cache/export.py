@@ -46,17 +46,34 @@ def export_serving_cache(
     uses). ``shard_fleet``: warm the mesh-sharded engine variant instead
     (must match how the server will boot — sharding is part of the key).
     """
-    from ..serializer import load
+    from .. import precision as precision_mod
+    from ..serializer import load, load_metadata
     from ..server.engine import ServingEngine
+    from ..store.generations import resolve_artifact_dir
     from .store import CompileCacheStore
 
     started = time.perf_counter()
     models: Dict[str, Any] = {}
     skipped: Dict[str, str] = {}
+    precisions: Dict[str, str] = {}
+    quantized: Dict[str, Any] = {}
     for name, model_dir in sorted(model_dirs.items()):
         try:
             models[name] = load(model_dir)
+            # §19: warm each machine at its manifest-pinned precision —
+            # a bf16 fleet whose export warmed f32 variants would pay
+            # full compiles at boot, defeating the export
+            precisions[name] = precision_mod.of_metadata(
+                load_metadata(model_dir)
+            )
+            if precisions[name] == "int8":
+                pair = precision_mod.load_quantized(
+                    resolve_artifact_dir(model_dir)
+                )
+                if pair is not None:
+                    quantized[name] = pair
         except Exception as exc:
+            models.pop(name, None)
             skipped[name] = f"{type(exc).__name__}: {exc}"
     if not models:
         return {"buckets": 0, "machines": 0, "skipped": skipped}
@@ -67,7 +84,10 @@ def export_serving_cache(
 
         mesh = fleet_mesh()
     store = CompileCacheStore(cache_root)
-    engine = ServingEngine(models, mesh=mesh, compile_cache=store)
+    engine = ServingEngine(
+        models, mesh=mesh, compile_cache=store,
+        precisions=precisions, quantized=quantized,
+    )
     try:
         buckets = engine.warmup(rows)
     finally:

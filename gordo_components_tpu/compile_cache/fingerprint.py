@@ -11,7 +11,11 @@ carries two halves:
   / ``serving-hot`` / ``serving-mega`` for the fused megabatch program,
   which also carries its resident-stack height — plus architecture
   signature, stacked machine count, shape bucket ``(rows, k)``,
-  sharding/donation config — see ``server/engine.py``), and
+  sharding/donation config, and the bucket's ``precision`` rung
+  (f32/bf16/int8 — §19: each rung's executable operates on different
+  stacked dtypes, so the variants cache independently and flipping a
+  machine's precision is a clean miss, never a stale hit) — see
+  ``server/engine.py``), and
 - the **backend fingerprint** computed here (jax + jaxlib versions,
   platform, device kind, topology, host ISA).
 

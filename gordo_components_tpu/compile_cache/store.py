@@ -245,6 +245,13 @@ class CompileCacheStore:
                 record["program"] = key.get("program")
                 record["backend"] = key.get("backend")
                 record["current"] = key.get("backend") == current_backend
+                # §19: the precision rung this executable was compiled
+                # for, surfaced top-level so `gordo cache list` makes a
+                # mixed-precision cache auditable at a glance (pre-ladder
+                # entries carry no field and read f32)
+                record["precision"] = (key.get("program") or {}).get(
+                    "precision", "f32"
+                )
             except Exception:
                 record.setdefault("error", "KEY.json unreadable")
                 record["current"] = False

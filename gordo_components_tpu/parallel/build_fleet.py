@@ -36,7 +36,12 @@ import jax
 import numpy as np
 
 from .. import __version__
-from ..builder.build_model import _dataset_from_config, calculate_model_key
+from .. import precision as precision_mod
+from ..builder.build_model import (
+    _dataset_from_config,
+    cached_artifact_precision,
+    calculate_model_key,
+)
 from ..models.analysis import Analyzed as _Analyzed
 from ..models.analysis import analyze_model as _analyze_model
 from ..models.transformers import MinMaxScaler, StandardScaler
@@ -926,8 +931,18 @@ def build_fleet(
     slice_size: Optional[int] = 256,
     fetch_retries: Optional[int] = None,
     fetch_backoff: Optional[float] = None,
+    precision_default: Optional[str] = None,
+    precision_map: Optional[Dict[str, str]] = None,
 ) -> Dict[str, str]:
     """Build every machine; returns ``{name: model_dir}``.
+
+    **Precision ladder (§19)**: ``precision_map`` pins individual
+    machines to a rung (f32/bf16/int8); everything else takes
+    ``precision_default`` (flag → ``GORDO_PRECISION_DEFAULT`` → f32).
+    Training always runs f32 — precision shapes each machine's SERVING
+    artifact: the metadata pin, the int8 quantized sidecar, and the
+    cache key (so re-precisioning a machine rebuilds its artifact
+    rather than resurrecting the old rung's).
 
     **Per-machine failure isolation**: a machine whose data fetch fails
     (after ``fetch_retries`` backed-off retries — defaults from
@@ -987,6 +1002,24 @@ def build_fleet(
         fetch_retries = int(os.environ.get(FETCH_RETRIES_ENV, "2"))
     if fetch_backoff is None:
         fetch_backoff = float(os.environ.get(FETCH_BACKOFF_ENV, "0.5"))
+    # precision resolution: per-machine map beats the fleet default; every
+    # value validated HERE (including map entries naming no machine in
+    # this fleet — a typo'd name must fail the build, not silently build
+    # that machine f32)
+    fleet_precision = precision_mod.resolve_default(precision_default)
+    precision_map = {
+        name: precision_mod.validate(value)
+        for name, value in (precision_map or {}).items()
+    }
+    known = {machine.name for machine in machines}
+    unknown = sorted(set(precision_map) - known)
+    if unknown:
+        raise ValueError(
+            f"--precision-map names machines not in this fleet: {unknown}"
+        )
+
+    def precision_of(name: str) -> str:
+        return precision_map.get(name, fleet_precision)
     multihost = jax.process_count() > 1
     if multihost:
         if mesh is None:
@@ -1033,6 +1066,10 @@ def build_fleet(
             machine.model_config,
             machine.data_config,
             evaluation_config=evaluation_config,
+            # §19: re-precisioning a machine is a cache miss — a cached
+            # f32 artifact must not satisfy an int8 build (and vice
+            # versa); f32 keeps every pre-ladder key valid
+            precision=precision_of(machine.name),
         )
         cached: Optional[str] = None
         if model_register_dir:
@@ -1066,13 +1103,26 @@ def build_fleet(
                 )
                 journal_counts["torn"] += 1
             else:
-                logger.info(
-                    "Fleet cache hit for %r -> %s", machine.name, cached
-                )
-                results[machine.name] = cached
-                journal_counts["resumed"] += 1
-                _M_FLEET_MACHINES.labels("cached").inc()
-                continue
+                cached_precision = cached_artifact_precision(cached)
+                if cached_precision != precision_of(machine.name):
+                    # registry/journal values are the machine's SHARED
+                    # output dir — a later re-precision build swapped
+                    # CURRENT under this key's entry, so a hit alone
+                    # must not resurrect the other rung (§19)
+                    logger.warning(
+                        "Fleet resume: artifact for %r serves precision "
+                        "%s but this build pins %s; rebuilding",
+                        machine.name, cached_precision,
+                        precision_of(machine.name),
+                    )
+                else:
+                    logger.info(
+                        "Fleet cache hit for %r -> %s", machine.name, cached
+                    )
+                    results[machine.name] = cached
+                    journal_counts["resumed"] += 1
+                    _M_FLEET_MACHINES.labels("cached").inc()
+                    continue
         pending.append((machine, cache_key, eff_splits, eff_cv_parallel))
     if ignored_eval:
         sample = dict(list(ignored_eval.items())[:5])
@@ -1388,6 +1438,8 @@ def build_fleet(
                             "dataset": item["dataset_metadata"],
                             "build_duration_s": amortized,
                             "user_defined": dict(machine.metadata),
+                            # §19: the manifest pin the serving layers read
+                            "precision": precision_of(machine.name),
                         }
                         # WAL first, then the atomic generation commit,
                         # then registry + committed record: a crash at any
@@ -1404,7 +1456,8 @@ def build_fleet(
                         commit_generation(
                             model_dir,
                             lambda staging: write_artifact_files(
-                                model, staging, metadata=metadata
+                                model, staging, metadata=metadata,
+                                precision=precision_of(machine.name),
                             ),
                             name=machine.name,
                         )

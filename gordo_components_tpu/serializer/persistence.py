@@ -121,12 +121,24 @@ def _write_state_npz(path: str, arrays: Dict[str, np.ndarray]) -> None:
 
 
 def write_artifact_files(
-    obj: Any, dest_dir: str, metadata: Optional[Dict[str, Any]] = None
+    obj: Any,
+    dest_dir: str,
+    metadata: Optional[Dict[str, Any]] = None,
+    precision: Optional[str] = None,
 ) -> None:
     """Write the raw artifact files (NO atomicity, NO manifest) into an
     existing directory — the writer the store's staged commits wrap. Only
     :func:`dump` and ``store.commit_generation`` callers should use this
-    directly."""
+    directly.
+
+    ``precision``: the machine's rung on the precision ladder (§19).
+    ``"int8"`` additionally writes ``quant_int8.npz`` — the per-tensor
+    quantized weights + scales — beside ``state.npz``, through the same
+    staged commit, so the manifest hashes it like every other artifact
+    file. The f32 state file is always written untouched (the host path
+    and any future re-precision build read it)."""
+    from .. import precision as precision_mod
+
     definition = pipeline_into_definition(obj)
     with open(os.path.join(dest_dir, DEFINITION_FILE), "w") as fh:
         json.dump(definition, fh, indent=2)
@@ -135,6 +147,12 @@ def write_artifact_files(
     _write_state_npz(os.path.join(dest_dir, STATE_FILE), arrays)
     with open(os.path.join(dest_dir, STATE_META_FILE), "w") as fh:
         json.dump(scalars, fh, indent=2, sort_keys=True)
+    if precision_mod.validate(precision) == "int8":
+        quant = precision_mod.quantized_arrays_for(obj)
+        if quant is not None:
+            _write_state_npz(
+                os.path.join(dest_dir, precision_mod.QUANT_INT8_FILE), quant
+            )
     if metadata is not None:
         with open(os.path.join(dest_dir, METADATA_FILE), "w") as fh:
             json.dump(metadata, fh, indent=2, default=str)

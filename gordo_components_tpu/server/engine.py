@@ -79,6 +79,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import precision as precision_mod
 from ..analysis import lockcheck
 from ..models.analysis import analyze_model
 from ..models.transformers import MinMaxScaler, StandardScaler
@@ -156,6 +157,13 @@ _M_FILL_OCCUPANCY = REGISTRY.histogram(
     "Pending requests at fill-window close, as a fraction of max_batch",
     buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
 )
+_M_PRECISION = REGISTRY.counter(
+    "gordo_engine_precision_total",
+    "Requests scored on device by the serving bucket's numeric precision "
+    "(f32 / bf16 / int8 — the per-machine precision ladder, "
+    "ARCHITECTURE §19); a mixed fleet shows its downgraded tail here",
+    labels=("precision",),
+)
 _M_MEGA_EVENTS = REGISTRY.counter(
     "gordo_engine_megabatch_events_total",
     "Megabatch residency + repair lifecycle: promote, evict, demote, "
@@ -164,6 +172,23 @@ _M_MEGA_EVENTS = REGISTRY.counter(
     "retry_isolated (fetch failure rescored one request at a time)",
     labels=("event",),
 )
+
+
+def _sidecar_matches(q_tree, params) -> bool:
+    """Whether a stored int8 sidecar's quantized tree can stand in for
+    ``params``: same treedef AND same per-leaf shapes (dtypes are BY
+    DESIGN different — int8 vs f32)."""
+    if jax.tree_util.tree_structure(q_tree) != jax.tree_util.tree_structure(
+        params
+    ):
+        return False
+    return all(
+        np.shape(q) == np.shape(p)
+        for q, p in zip(
+            jax.tree_util.tree_leaves(q_tree),
+            jax.tree_util.tree_leaves(params),
+        )
+    )
 
 
 def _supports_donation(mesh) -> bool:
@@ -308,6 +333,9 @@ class _MachineEntry:
     # input-column index of each target tag — identity arange(F) for
     # reconstruction configs; a subset/permutation for target_tag_list ones
     tcols: np.ndarray = None
+    # int8 machines only: per-tensor dequantization scales, same treedef
+    # as params (which then holds the int8-quantized weights)
+    params_scale: Any = None
 
 
 class _Item:
@@ -430,8 +458,16 @@ class _Bucket:
         megabatch: bool = False,
         fill_window_s: float = 0.0,
         mega_cap: int = 0,
+        precision: str = "f32",
     ):
         self.apply_fn = apply_fn
+        # this bucket's rung on the precision ladder (ARCHITECTURE §19).
+        # Precision joins the architecture signature upstream, so every
+        # bucket is dtype-HOMOGENEOUS by construction: its stacked tree,
+        # hot copies, and megabatch resident stack all carry one weight
+        # dtype — the fused path can never mix dtypes, and a mixed-
+        # precision fleet's residency simply partitions by bucket.
+        self.precision = precision
         # persistent compile cache (compile_cache.CompileCacheStore or
         # None): with a store, _program/_hot_program consult it before
         # JIT-compiling and write AOT-serialized executables back on miss
@@ -518,6 +554,16 @@ class _Bucket:
                 [np.asarray(e.tcols, np.int32) for e in entries]
             ),
         }
+        if entries[0].params_scale is not None:
+            # int8 bucket: the per-tensor dequantization scales ride the
+            # stacked tree (same machine axis, gathered in lockstep with
+            # the quantized weights), so every downstream tree_map —
+            # avatars, hot gathers, the mega resident stack — carries
+            # them automatically
+            stacked["params_scale"] = jax.tree_util.tree_map(
+                lambda *leaves: np.stack(leaves),
+                *[e.params_scale for e in entries],
+            )
         self.stacked = (
             jax.device_put(stacked)
             if self._fleet_sharding is None
@@ -597,20 +643,40 @@ class _Bucket:
     # -- compiled programs ---------------------------------------------------
     def _machine_score_fn(self):
         """The per-machine scoring math, closed over this bucket's
-        architecture — shared by the stacked (gather-by-idx) program and
-        the hot-cache (unsharded machine tree) program so they cannot
-        drift numerically."""
+        architecture AND precision — shared by the stacked
+        (gather-by-idx), hot-cache, and megabatch programs so the three
+        cannot drift numerically. Precision variants (§19): f32 is the
+        untouched original closure, bit for bit; bf16 runs the network
+        forward pass in bfloat16 (weights already live as bf16 in the
+        stacked tree) and casts predictions back to f32, so scaler
+        affines, residuals, error scaling, and the L2 all stay f32;
+        int8 keeps weights quantized ON DEVICE and dequantizes into f32
+        inside the program (per-tensor scales gathered alongside), so
+        accumulation is full f32 while the resident weight bytes are a
+        quarter of f32's."""
         L, la, apply_fn = self.lookback, self.lookahead, self.apply_fn
+        precision = self.precision
 
         def machine_score(machine, x):
+            if precision == "int8":
+                params = jax.tree_util.tree_map(
+                    lambda q, s: q.astype(jnp.float32) * s,
+                    machine["params"], machine["params_scale"],
+                )
+            else:
+                params = machine["params"]
             xs = x * machine["sx"].scale + machine["sx"].offset
             if la is None:
                 inputs = xs
             else:
                 inputs = windowing.sliding_windows(xs, L, la)
+            if precision == "bf16":
+                inputs = inputs.astype(jnp.bfloat16)
             pred = apply_fn(
-                {"params": machine["params"]}, inputs, deterministic=True
+                {"params": params}, inputs, deterministic=True
             )
+            if precision == "bf16":
+                pred = pred.astype(jnp.float32)
             pred_raw = (pred - machine["sy"].offset) / machine["sy"].scale
             x_tail = x[x.shape[0] - pred_raw.shape[0] :]
             # residuals score against the machine's TARGET columns of the
@@ -849,6 +915,11 @@ class _Bucket:
             "batch": k,
             "mesh": list(self.mesh.devices.shape) if self.mesh else None,
             "donate": self._donate,
+            # the precision ladder (§19): a bf16/int8 variant compiles a
+            # different program over different stacked dtypes, so each
+            # rung caches independently — flipping a machine's precision
+            # is a clean miss, never a stale hit of the other variant
+            "precision": self.precision,
         }
         if kind == "mega":
             # the resident stack's machine-axis length is part of the
@@ -953,6 +1024,15 @@ class _Bucket:
         pending queue is drained, so followers for other row-buckets never
         queue behind a device-to-host copy."""
         item = _Item(idx, x, m_valid)
+        if self.precision != "f32":
+            # §19: a request served on a downgraded rung says so in its
+            # own timeline — an operator reading a trace can tell whether
+            # the scores behind it were bf16/int8 without cross-checking
+            # the manifest
+            spans.event_into(
+                item.ctx, "precision_downgraded",
+                precision=self.precision, machine=self.names[idx],
+            )
         rows = x.shape[0]
         is_leader = False
         queued = time.perf_counter()
@@ -1867,6 +1947,7 @@ class _Bucket:
             self.mega_request_count += k
         self.max_batch_seen = max(self.max_batch_seen, k)
         _M_REQUESTS.labels(path).inc(k)
+        _M_PRECISION.labels(self.precision).inc(k)
         _M_DISPATCH_BATCH.observe(k)
 
     @staticmethod
@@ -1986,6 +2067,8 @@ class ServingEngine:
         megabatch: Optional[bool] = None,
         fill_window_us: Optional[int] = None,
         megabatch_residency: Optional[int] = None,
+        precisions: Optional[Dict[str, str]] = None,
+        quantized: Optional[Dict[str, Tuple[Any, Any]]] = None,
     ):
         self.mesh = mesh
         # cross-machine megabatching (ARCHITECTURE §15): replicated mode
@@ -2034,6 +2117,14 @@ class ServingEngine:
         self._buckets: List[_Bucket] = []
         self.skipped: Dict[str, str] = {}
         target_cols = target_cols or {}
+        # per-machine precision ladder (§19): each machine's manifest-
+        # pinned precision (validated below — an unknown value skips the
+        # machine to the host path, which always serves f32). ``quantized``
+        # optionally carries build-time int8 (q_tree, scale_tree) pairs
+        # loaded from the artifact's quant_int8.npz; machines without one
+        # quantize on the fly with the identical deterministic formula.
+        precisions = precisions or {}
+        quantized = quantized or {}
 
         groups: Dict[str, List[Tuple[Any, _MachineEntry]]] = {}
         for name, model in models.items():
@@ -2089,14 +2180,52 @@ class ServingEngine:
                     es = _identity(n_targets)
                 else:
                     es = _affine(detector.scaler, n_targets)
+                prec = precision_mod.validate(precisions.get(name))
+                params = jax.device_get(est.params_)
+                params_scale = None
+                if prec == "bf16":
+                    # weights live as bf16 on host AND device (half the
+                    # stacked bytes); the closure computes the forward
+                    # pass in bf16 and everything else in f32
+                    params = jax.tree_util.tree_map(
+                        lambda a: np.asarray(a, dtype=jnp.bfloat16), params
+                    )
+                elif prec == "int8":
+                    pair = quantized.get(name)
+                    if pair is not None and not _sidecar_matches(
+                        pair[0], params
+                    ):
+                        # treedef AND per-leaf shapes: a stale sidecar
+                        # whose structure matches but whose leaves were
+                        # shaped by an older retrain must fall back to
+                        # on-the-fly quantization here — trusted, it
+                        # would blow up np.stack in _Bucket.__init__
+                        # and take the whole boot down with it
+                        logger.warning(
+                            "Machine %r: stored int8 sidecar disagrees "
+                            "with the model params (tree or leaf "
+                            "shapes); quantizing on the fly instead",
+                            name,
+                        )
+                        pair = None
+                    if pair is None:
+                        pair = precision_mod.quantize_tree_int8(params)
+                    params, params_scale = pair
+                    params = jax.tree_util.tree_map(
+                        lambda a: np.asarray(a, np.int8), params
+                    )
+                    params_scale = jax.tree_util.tree_map(
+                        lambda s: np.asarray(s, np.float32), params_scale
+                    )
                 entry = _MachineEntry(
                     name=name,
-                    params=jax.device_get(est.params_),
+                    params=params,
                     sx=_affine(analyzed.input_scaler, n_features),
                     sy=_affine(analyzed.target_scaler, n_targets),
                     es=es,
                     has_detector=detector is not None,
                     tcols=tcols,
+                    params_scale=params_scale,
                 )
             except (ValueError, AttributeError, TypeError) as exc:
                 logger.info("Serving engine skips %r: %s", name, exc)
@@ -2110,6 +2239,11 @@ class ServingEngine:
                     "T": n_targets,
                     "L": est.lookback_window,
                     "la": est.lookahead,
+                    # precision partitions the fleet into dtype-homogeneous
+                    # buckets (§19): machines sharing an architecture at
+                    # DIFFERENT rungs stack into different trees, so no
+                    # program — cold, hot, or fused — ever mixes dtypes
+                    "precision": prec,
                 },
                 sort_keys=True,
                 default=str,
@@ -2132,6 +2266,7 @@ class ServingEngine:
                 megabatch=self.megabatch,
                 fill_window_s=self.fill_window_us / 1e6,
                 mega_cap=self.megabatch_residency,
+                precision=json.loads(sig)["precision"],
             )
             self._buckets.append(bucket)
             for i, (_, entry) in enumerate(members):
@@ -2303,6 +2438,15 @@ class ServingEngine:
     def stats(self) -> Dict[str, Any]:
         mega_dispatches = sum(b.mega_dispatch_count for b in self._buckets)
         mega_requests = sum(b.mega_request_count for b in self._buckets)
+        prec_machines: Dict[str, int] = {}
+        prec_requests: Dict[str, int] = {}
+        for b in self._buckets:
+            prec_machines[b.precision] = (
+                prec_machines.get(b.precision, 0) + len(b.names)
+            )
+            prec_requests[b.precision] = (
+                prec_requests.get(b.precision, 0) + b.request_count
+            )
         return {
             "machines": len(self._by_name),
             "buckets": len(self._buckets),
@@ -2352,6 +2496,13 @@ class ServingEngine:
                 "fill_size_total": sum(
                     b.fill_size_count for b in self._buckets
                 ),
+            },
+            # the precision ladder (§19): machines and served requests by
+            # numeric rung — a mixed fleet's f32/bf16/int8 split at a
+            # glance (the prometheus twin is gordo_engine_precision_total)
+            "precision": {
+                "machines": dict(sorted(prec_machines.items())),
+                "requests": dict(sorted(prec_requests.items())),
             },
             # persistent compile cache: this engine's store-lookup counts
             # (None = cache off, the compile-on-boot mode)

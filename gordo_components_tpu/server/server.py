@@ -55,6 +55,7 @@ from werkzeug.exceptions import HTTPException, NotFound
 from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
+from .. import precision as precision_mod
 from ..analysis import lockcheck
 from ..models.anomaly.base import AnomalyDetectorBase
 from ..observability import exposition, flightrec, spans, stitch, tracing
@@ -160,6 +161,18 @@ class _Machine:
         self.generation = store_generations.current_generation(model_dir)
         self.model = load(model_dir)
         self.metadata = load_metadata(model_dir)
+        # the precision ladder (§19): the artifact's manifest-pinned
+        # precision, VALIDATED here — an unknown value raises, so the
+        # machine quarantines instead of silently serving f32. int8
+        # artifacts carry their quantized weights + scales as a
+        # manifest-hashed sidecar; absent (e.g. hand-adopted artifact),
+        # the engine quantizes on the fly with the identical formula.
+        self.precision = precision_mod.of_metadata(self.metadata)
+        self.quantized = None
+        if self.precision == "int8":
+            self.quantized = precision_mod.load_quantized(
+                store_generations.resolve_artifact_dir(model_dir)
+            )
 
     @property
     def tag_list(self) -> Optional[List[str]]:
@@ -263,6 +276,18 @@ class _ServerState:
                 name: machine.target_columns
                 for name, machine in machines.items()
             },
+            # per-machine precision ladder (§19): the manifest-pinned
+            # rung each machine serves at, plus any build-time int8
+            # weights/scales loaded from its quant_int8.npz sidecar
+            precisions={
+                name: machine.precision
+                for name, machine in machines.items()
+            },
+            quantized={
+                name: machine.quantized
+                for name, machine in machines.items()
+                if machine.quantized is not None
+            },
             mesh=mesh,
             # persistent compile cache: warmup (and every later program
             # build) loads AOT executables instead of compiling, so
@@ -288,6 +313,14 @@ class _ServerState:
             logger.info(
                 "Cross-machine megabatching off (%s)",
                 "shard mode" if shard_fleet else "disabled by config",
+            )
+        ladder = self.engine.stats()["precision"]["machines"]
+        if set(ladder) - {"f32"}:
+            # only mixed/downgraded fleets log the split — an all-f32
+            # boot reads exactly as before the ladder existed
+            logger.info(
+                "Precision ladder: %s",
+                ", ".join(f"{k}={v}" for k, v in sorted(ladder.items())),
             )
 
     def enter(self) -> None:
@@ -823,6 +856,9 @@ class ModelServer:
                         "status": "ok",
                         "generation": served.generation,
                         "verified": True,
+                        # §19: which rung of the precision ladder this
+                        # machine's scores come from (manifest-pinned)
+                        "precision": served.precision,
                     }
                 )
             # fleet health is TRI-STATE: live (process answers), ready (at
@@ -855,6 +891,12 @@ class ModelServer:
                         "unverified": sorted(self._quarantined_dirs),
                         "generations": {
                             name: machine.generation
+                            for name, machine in sorted(state.machines.items())
+                        },
+                        # §19: each machine's manifest-pinned precision —
+                        # a mixed fleet is auditable from one curl
+                        "precisions": {
+                            name: machine.precision
                             for name, machine in sorted(state.machines.items())
                         },
                     },

@@ -156,13 +156,17 @@ def test_corrupt_entry_falls_back_and_self_heals(fitted_models, tmp_path):
     seed.close()
 
     store = CompileCacheStore(root)
-    entry = store.entries()[0]["name"]
-    target = os.path.join(root, entry, EXEC_FILE)
-    with open(target, "r+b") as fh:
-        data = bytearray(fh.read())
-        data[10] ^= 0xFF
-        fh.seek(0)
-        fh.write(data)
+    # corrupt EVERY entry (not just the name-sorted first): which entry
+    # hashes first shifts whenever the key schema grows a field, and the
+    # fallback assertion needs a corrupted entry the warmup actually
+    # looks up
+    for entry in store.entries():
+        target = os.path.join(root, entry["name"], EXEC_FILE)
+        with open(target, "r+b") as fh:
+            data = bytearray(fh.read())
+            data[10] ^= 0xFF
+            fh.seek(0)
+            fh.write(data)
     fallback = ServingEngine(models, compile_cache=store)
     fallback.warmup()  # must not raise — never-fatal contract
     assert store.counters["invalid"] > 0
@@ -225,6 +229,66 @@ def test_purge_and_entries(tmp_path, fitted_models):
     removed = store.purge()
     assert sorted(removed) == sorted(e["name"] for e in entries)
     assert store.entries() == []
+
+
+# -- precision key variants (§19) -------------------------------------------
+def test_two_precisions_cache_as_two_entries(fitted_models, tmp_path):
+    """One machine built at two rungs yields two independent cc-<sha>
+    entries: the precision field partitions the key space."""
+    models, X = fitted_models
+    root = str(tmp_path / "cc")
+    f32 = ServingEngine(models, compile_cache=CompileCacheStore(root))
+    f32.warmup()
+    f32.close()
+    store = CompileCacheStore(root)
+    f32_names = {e["name"] for e in store.entries()}
+    assert all(e["precision"] == "f32" for e in store.entries())
+    bf16 = ServingEngine(
+        models, compile_cache=store,
+        precisions={name: "bf16" for name in models},
+    )
+    bf16.warmup()
+    bf16.close()
+    entries = CompileCacheStore(root).entries()
+    bf16_names = {e["name"] for e in entries if e["precision"] == "bf16"}
+    assert bf16_names and not (bf16_names & f32_names)
+    assert {e["precision"] for e in entries} == {"f32", "bf16"}
+
+
+def test_precision_flip_is_clean_miss_never_stale_hit(fitted_models, tmp_path):
+    """Flipping a machine's precision against an existing store is a
+    clean MISS + JIT fallback — never a hit (or stale read) of the other
+    variant's binary."""
+    models, X = fitted_models
+    root = str(tmp_path / "cc")
+    seed = ServingEngine(models, compile_cache=CompileCacheStore(root))
+    seed.warmup()
+    ref = {n: _bits(seed.anomaly(n, X)) for n in sorted(models)}
+    seed.close()
+
+    store = CompileCacheStore(root)
+    flipped = ServingEngine(
+        models, compile_cache=store,
+        precisions={name: "int8" for name in models},
+    )
+    before = _fresh_compiles()
+    flipped.warmup()
+    # the f32 entries never satisfied an int8 lookup: every int8 program
+    # missed (then compiled + wrote back); nothing read stale or invalid
+    assert store.counters["miss"] > 0
+    assert store.counters["hit"] == 0
+    assert store.counters["stale"] == store.counters["invalid"] == 0
+    assert _fresh_compiles() - before > 0  # honest JIT/AOT fallback
+    flipped.close()
+    # and the f32 variant still hits untouched afterwards, bit-identical
+    store2 = CompileCacheStore(root)
+    back = ServingEngine(models, compile_cache=store2)
+    before = _fresh_compiles()
+    back.warmup()
+    assert _fresh_compiles() - before == 0
+    assert store2.counters["hit"] > 0
+    assert {n: _bits(back.anomaly(n, X)) for n in sorted(models)} == ref
+    back.close()
 
 
 # -- server wiring ----------------------------------------------------------

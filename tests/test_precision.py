@@ -1,0 +1,375 @@
+"""The per-machine precision ladder (ISSUE 11, ARCHITECTURE §19):
+manifest-pinned f32/bf16/int8 scoring with parity budgets, per-precision
+buckets, quantized int8 sidecars, and precision-aware observability."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gordo_components_tpu import precision as precision_mod
+from gordo_components_tpu.serializer import pipeline_from_definition
+from gordo_components_tpu.server.engine import ServingEngine
+
+
+def _config():
+    return {
+        "DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "Pipeline": {
+                    "steps": [
+                        "MinMaxScaler",
+                        {"DenseAutoEncoder": {
+                            "kind": "feedforward_hourglass",
+                            "epochs": 1, "batch_size": 32,
+                        }},
+                    ]
+                }
+            }
+        }
+    }
+
+
+@pytest.fixture(scope="module")
+def fitted_models():
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(160, 4)).astype(np.float32) * 3 + 5
+    models = {}
+    for i in range(2):
+        model = pipeline_from_definition(_config())
+        model.cross_validate(X, n_splits=2)
+        model.fit(X)
+        models[f"p{i}"] = model
+    return models, X
+
+
+def _bits(result):
+    return tuple(
+        np.asarray(a).tobytes()
+        for a in (result.model_input, result.model_output,
+                  result.tag_anomaly_scores, result.total_anomaly_score)
+    )
+
+
+# -- the precision vocabulary ------------------------------------------------
+def test_validate_accepts_the_ladder_and_rejects_everything_else():
+    assert precision_mod.validate(None) == "f32"
+    assert precision_mod.validate("") == "f32"
+    assert precision_mod.validate(" BF16 ") == "bf16"
+    for rung in precision_mod.PRECISIONS:
+        assert precision_mod.validate(rung) == rung
+    with pytest.raises(ValueError, match="unknown precision"):
+        precision_mod.validate("fp4")
+    with pytest.raises(ValueError):
+        precision_mod.of_metadata({"precision": "float64"})
+    assert precision_mod.of_metadata({}) == "f32"
+    assert precision_mod.of_metadata(None) == "f32"
+
+
+def test_resolve_default_env_and_flag(monkeypatch):
+    monkeypatch.delenv("GORDO_PRECISION_DEFAULT", raising=False)
+    assert precision_mod.resolve_default() == "f32"
+    monkeypatch.setenv("GORDO_PRECISION_DEFAULT", "bf16")
+    assert precision_mod.resolve_default() == "bf16"
+    assert precision_mod.resolve_default("int8") == "int8"  # flag wins
+    monkeypatch.setenv("GORDO_PRECISION_DEFAULT", "garbage")
+    with pytest.raises(ValueError):
+        precision_mod.resolve_default()
+
+
+def test_error_budget_defaults_and_overrides(monkeypatch):
+    monkeypatch.delenv("GORDO_PARITY_RTOL_BF16", raising=False)
+    assert precision_mod.error_budget("f32") == 0.0
+    assert 0 < precision_mod.error_budget("bf16") < precision_mod.error_budget("int8")
+    monkeypatch.setenv("GORDO_PARITY_RTOL_BF16", "0.5")
+    assert precision_mod.error_budget("bf16") == 0.5
+    monkeypatch.setenv("GORDO_PARITY_RTOL_BF16", "not-a-float")
+    assert precision_mod.error_budget("bf16") == 0.02  # warn + default
+
+
+def test_parse_precision_map_pairs_and_errors(tmp_path):
+    assert precision_mod.parse_precision_map(None) == {}
+    assert precision_mod.parse_precision_map("a=bf16, b=int8;c=f32") == {
+        "a": "bf16", "b": "int8", "c": "f32"
+    }
+    with pytest.raises(ValueError, match="name=precision"):
+        precision_mod.parse_precision_map("justaname")
+    with pytest.raises(ValueError, match="unknown precision"):
+        precision_mod.parse_precision_map("a=fp8")
+    yaml_path = tmp_path / "map.yaml"
+    yaml_path.write_text("m1: bf16\nm2: int8\n")
+    assert precision_mod.parse_precision_map(str(yaml_path)) == {
+        "m1": "bf16", "m2": "int8"
+    }
+
+
+# -- int8 quantization -------------------------------------------------------
+def test_int8_quantization_roundtrip_and_determinism():
+    rng = np.random.default_rng(3)
+    tree = {"dense": {"kernel": rng.normal(size=(8, 4)).astype(np.float32),
+                      "bias": rng.normal(size=(4,)).astype(np.float32)},
+            "zeros": np.zeros((3,), np.float32)}
+    q1, s1 = precision_mod.quantize_tree_int8(tree)
+    q2, s2 = precision_mod.quantize_tree_int8(tree)
+    # deterministic: build-time and serve-time quantization agree exactly
+    assert q1["dense"]["kernel"].tobytes() == q2["dense"]["kernel"].tobytes()
+    assert q1["dense"]["kernel"].dtype == np.int8
+    deq = precision_mod.dequantize_tree_int8(q1, s1)
+    kernel = tree["dense"]["kernel"]
+    # per-tensor symmetric: error bounded by half a quantization step
+    assert np.max(np.abs(deq["dense"]["kernel"] - kernel)) <= (
+        np.max(np.abs(kernel)) / 127.0 * 0.5 + 1e-7
+    )
+    # all-zero tensors quantize cleanly (scale falls back to 1.0)
+    assert np.all(q1["zeros"] == 0) and float(s1["zeros"]) == 1.0
+    assert s2["dense"]["kernel"] == s1["dense"]["kernel"]
+
+
+# -- engine parity + partitioning --------------------------------------------
+def test_mixed_precision_engine_meets_budgets(fitted_models):
+    models, X = fitted_models
+    reference = ServingEngine(models)
+    ref = {n: reference.anomaly(n, X) for n in sorted(models)}
+    reference.close()
+    engine = ServingEngine(
+        models, precisions={"p0": "f32", "p1": "bf16"}
+    )
+    # f32 stays bit-identical; bf16 within its declared budget
+    assert _bits(engine.anomaly("p0", X)) == _bits(ref["p0"])
+    err = precision_mod.parity_error(
+        ref["p1"].total_anomaly_score,
+        engine.anomaly("p1", X).total_anomaly_score,
+    )
+    assert 0 < err <= precision_mod.error_budget("bf16")
+    # one architecture at two rungs = two dtype-homogeneous buckets
+    assert len(engine._buckets) == 2
+    assert sorted(b.precision for b in engine._buckets) == ["bf16", "f32"]
+    ladder = engine.stats()["precision"]
+    assert ladder["machines"] == {"bf16": 1, "f32": 1}
+    assert ladder["requests"] == {"bf16": 1, "f32": 1}
+    engine.close()
+
+
+def test_int8_engine_within_budget_and_uses_sidecar_pair(fitted_models):
+    import jax
+
+    models, X = fitted_models
+    reference = ServingEngine(models)
+    ref = reference.anomaly("p0", X)
+    reference.close()
+    # build-time pair, fed through the quantized= path (what _Machine
+    # loads from quant_int8.npz)
+    from gordo_components_tpu.models.analysis import analyze_model
+
+    params = jax.device_get(analyze_model(models["p0"]).estimator.params_)
+    pair = precision_mod.quantize_tree_int8(params)
+    engine = ServingEngine(
+        models, precisions={"p0": "int8", "p1": "f32"},
+        quantized={"p0": pair},
+    )
+    scored = engine.anomaly("p0", X)
+    err = precision_mod.parity_error(
+        ref.total_anomaly_score, scored.total_anomaly_score
+    )
+    assert 0 < err <= precision_mod.error_budget("int8")
+    bucket, _ = engine._by_name["p0"]
+    assert bucket.precision == "int8"
+    leaves = jax.tree_util.tree_leaves(bucket.stacked["params"])
+    assert all(np.asarray(a).dtype == np.int8 for a in leaves)
+    assert "params_scale" in bucket.stacked
+    # on-the-fly quantization (no sidecar) produces identical scores —
+    # the formula is deterministic
+    fly = ServingEngine(models, precisions={"p0": "int8", "p1": "f32"})
+    assert _bits(fly.anomaly("p0", X)) == _bits(scored)
+    fly.close()
+    engine.close()
+
+
+def test_invalid_precision_skips_machine_to_host_path(fitted_models):
+    models, X = fitted_models
+    engine = ServingEngine(models, precisions={"p0": "fp4"})
+    assert not engine.can_score("p0")  # skipped, host path serves it
+    assert "unknown precision" in engine.skipped["p0"]
+    assert engine.can_score("p1")
+    engine.close()
+
+
+def test_precision_counter_and_downgrade_event(fitted_models):
+    from gordo_components_tpu.observability.registry import REGISTRY
+
+    def counter_value(precision):
+        for metric in REGISTRY.metrics():
+            if metric.name == "gordo_engine_precision_total":
+                return metric.collect().get((precision,), 0)
+        return 0
+
+    models, X = fitted_models
+    engine = ServingEngine(models, precisions={"p0": "bf16", "p1": "bf16"})
+    before = counter_value("bf16")
+    engine.anomaly("p0", X)
+    engine.quiesce()
+    assert counter_value("bf16") == before + 1
+    engine.close()
+
+
+# -- store / artifact pinning ------------------------------------------------
+_DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-03T00:00:00+00:00",
+    "tag_list": ["pa", "pb", "pc"],
+}
+_MODEL_CONFIG = {
+    "DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "Pipeline": {
+                "steps": [
+                    "MinMaxScaler",
+                    {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                          "dims": [4], "epochs": 1,
+                                          "batch_size": 32}},
+                ]
+            }
+        }
+    }
+}
+
+
+def test_int8_build_commits_sidecar_and_serves(tmp_path):
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.serializer import load_metadata
+    from gordo_components_tpu.server.server import _Machine
+    from gordo_components_tpu.store.generations import resolve_artifact_dir
+    from gordo_components_tpu.store.manifest import read_manifest
+
+    model_dir = provide_saved_model(
+        "m-q", _MODEL_CONFIG, _DATA_CONFIG, str(tmp_path / "m-q"),
+        evaluation_config={"cv_mode": "build_only"}, precision="int8",
+    )
+    assert load_metadata(model_dir)["precision"] == "int8"
+    artifact = resolve_artifact_dir(model_dir)
+    # the sidecar is a first-class artifact file: present AND hashed by
+    # the manifest (a torn/tampered copy fails verification like any
+    # other file)
+    manifest = read_manifest(artifact)
+    assert precision_mod.QUANT_INT8_FILE in manifest["files"]
+    pair = precision_mod.load_quantized(artifact)
+    assert pair is not None
+    machine = _Machine("m-q", model_dir)
+    assert machine.precision == "int8"
+    assert machine.quantized is not None
+
+
+def test_registry_hit_never_resurrects_other_rung(tmp_path):
+    """The registry value is the machine's SHARED output dir: after a
+    re-precision build swaps CURRENT, the old rung's still-registered
+    key must rebuild, not serve the other rung's generation."""
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.serializer import load_metadata
+
+    registry = str(tmp_path / "registry")
+    output = str(tmp_path / "m-rr")
+    provide_saved_model(
+        "m-rr", _MODEL_CONFIG, _DATA_CONFIG, output,
+        model_register_dir=registry,
+        evaluation_config={"cv_mode": "build_only"}, precision="f32",
+    )
+    assert load_metadata(output).get("precision", "f32") == "f32"
+    provide_saved_model(
+        "m-rr", _MODEL_CONFIG, _DATA_CONFIG, output,
+        model_register_dir=registry,
+        evaluation_config={"cv_mode": "build_only"}, precision="int8",
+    )
+    assert load_metadata(output)["precision"] == "int8"  # CURRENT swapped
+    # the f32 key is still registered and its artifact dir VERIFIES —
+    # but its CURRENT generation now pins int8: must rebuild as f32
+    provide_saved_model(
+        "m-rr", _MODEL_CONFIG, _DATA_CONFIG, output,
+        model_register_dir=registry,
+        evaluation_config={"cv_mode": "build_only"}, precision="f32",
+    )
+    assert load_metadata(output)["precision"] == "f32"
+
+
+def test_shape_mismatched_sidecar_falls_back_to_fly(fitted_models):
+    """A sidecar whose treedef matches but whose leaf shapes belong to
+    an older retrain must be rejected at entry construction (on-the-fly
+    quantization instead) — trusted, it would crash the whole engine
+    boot inside np.stack."""
+    import jax
+
+    from gordo_components_tpu.models.analysis import analyze_model
+
+    models, X = fitted_models
+    params = jax.device_get(analyze_model(models["p0"]).estimator.params_)
+    q_tree, s_tree = precision_mod.quantize_tree_int8(params)
+    bad_q = jax.tree_util.tree_map(
+        lambda q: np.zeros(tuple(d + 1 for d in q.shape), np.int8), q_tree
+    )
+    engine = ServingEngine(
+        models, precisions={"p0": "int8", "p1": "f32"},
+        quantized={"p0": (bad_q, s_tree)},
+    )
+    assert engine.can_score("p0")  # boot survived; fly-quantized
+    ref = ServingEngine(models, precisions={"p0": "int8", "p1": "f32"})
+    assert _bits(engine.anomaly("p0", X)) == _bits(ref.anomaly("p0", X))
+    ref.close()
+    engine.close()
+
+
+def test_precision_changes_build_cache_key():
+    from gordo_components_tpu.builder.build_model import calculate_model_key
+
+    base = calculate_model_key("m", _MODEL_CONFIG, _DATA_CONFIG)
+    assert base == calculate_model_key(
+        "m", _MODEL_CONFIG, _DATA_CONFIG, precision="f32"
+    )  # f32 keeps every pre-ladder key (and registry entry) valid
+    assert base != calculate_model_key(
+        "m", _MODEL_CONFIG, _DATA_CONFIG, precision="bf16"
+    )
+    assert calculate_model_key(
+        "m", _MODEL_CONFIG, _DATA_CONFIG, precision="bf16"
+    ) != calculate_model_key(
+        "m", _MODEL_CONFIG, _DATA_CONFIG, precision="int8"
+    )
+
+
+def test_server_surfaces_precision_on_healthz(tmp_path):
+    from werkzeug.test import Client as TestClient
+
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.server import build_app
+
+    model_dir = provide_saved_model(
+        "m-h", _MODEL_CONFIG, _DATA_CONFIG, str(tmp_path / "m-h"),
+        evaluation_config={"cv_mode": "build_only"}, precision="bf16",
+    )
+    client = TestClient(build_app({"m-h": model_dir}, project="proj"))
+    scoped = client.get("/gordo/v0/proj/m-h/healthz").get_json()
+    assert scoped["precision"] == "bf16"
+    fleet = client.get("/healthz").get_json()
+    assert fleet["store"]["precisions"] == {"m-h": "bf16"}
+    X = (np.random.default_rng(2).normal(size=(48, 3)) * 2 + 4).tolist()
+    response = client.post(
+        "/gordo/v0/proj/m-h/anomaly/prediction",
+        data=json.dumps({"X": X}), content_type="application/json",
+    )
+    assert response.status_code == 200
+
+
+def test_fleet_build_precision_map_validates_names(fitted_models):
+    from gordo_components_tpu.parallel import build_fleet
+    from gordo_components_tpu.parallel.build_fleet import FleetMachineConfig
+
+    machines = [
+        FleetMachineConfig(
+            name="known", model_config=_MODEL_CONFIG,
+            data_config=_DATA_CONFIG,
+        )
+    ]
+    with pytest.raises(ValueError, match="not in this fleet"):
+        build_fleet(
+            machines, "/nonexistent-output",
+            precision_map={"typo-name": "bf16"},
+        )

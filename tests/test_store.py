@@ -554,7 +554,7 @@ def test_server_quarantines_corrupt_generation_and_reload_recovers(tmp_path):
     ok_body = client.get("/gordo/v0/proj/m-ok/healthz").get_json()
     assert ok_body == {
         "ok": True, "status": "ok", "generation": "gen-0001",
-        "verified": True,
+        "verified": True, "precision": "f32",
     }
     assert client.get("/gordo/v0/proj/m-bad/healthz").status_code == 503
 
