@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke smoke images builder-image server-image watchman-image
 
 # invariant linter (docs/ARCHITECTURE.md §17): lock discipline against
 # the declared hierarchy, blocking-calls-under-hot-locks, unbound
@@ -99,12 +99,23 @@ slo-smoke:
 quant-smoke:
 	JAX_PLATFORMS=cpu python tools/quant_smoke.py
 
+# closed-loop autopilot check (§20): scripted-signal convergence under
+# a step load change (bounded ticks, ≤1 direction flip per window —
+# the oscillation guard), injected dispatch latency driving a journaled
+# downscale on a real server (flight-recorder event + gordo_autopilot_*
+# series + runtime kill switch), and the elastic tier retiring a worker
+# on sustained idle (drain-before-retire, ZERO dropped requests) and
+# spawning one on sustained burn, with /autopilot ↔ CLI parity
+autopilot-smoke:
+	JAX_PLATFORMS=cpu python tools/autopilot_smoke.py
+
 # the full smoke battery: invariant lint + exposition + resilience +
 # store integrity + serving data plane + span attribution + cold-start
 # economics + cross-machine megabatching + the horizontal serving tier
 # + the fleet observability plane (stitching / aggregation / SLO)
 # + the precision ladder (parity budgets / dtype routing / warm boots)
-smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke
+# + the closed-loop autopilot (convergence / journal / elastic tier)
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke
 
 images: builder-image server-image watchman-image
 

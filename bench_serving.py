@@ -45,7 +45,10 @@ block; 0 skips it), BENCH_SERVE_PRECISION (1 — include the
 precision-ladder f32/bf16/int8 A/B block; 0 skips it),
 BENCH_SERVE_WORKERS (2 — the N rung),
 BENCH_SERVE_MW_MACHINES (8) / BENCH_SERVE_MW_REQUESTS (40 per thread)
-— the multi-worker block's fleet and load sizes. The engine's own
+— the multi-worker block's fleet and load sizes,
+BENCH_SERVE_AUTOPILOT (1 — include the closed-loop autopilot A/B under
+the shifting ramp→spike→idle mix; 0 skips it) /
+BENCH_SERVE_AP_MACHINES (8 — that block's fleet size). The engine's own
 GORDO_MEGABATCH / GORDO_FILL_WINDOW_US / GORDO_MEGABATCH_RESIDENCY knobs
 apply as in production (ARCHITECTURE §15).
 """
@@ -1119,6 +1122,207 @@ def measure_multi_worker() -> dict:
     return out
 
 
+def measure_autopilot() -> dict:
+    """Closed-loop autopilot A/B (ISSUE 12 acceptance): the SAME shifting
+    load mix — ramp → spike → idle — driven twice over identical fresh
+    engines, once at the hand-set defaults and once with the autopilot
+    ticking. The controller reads real signals (an engine-dispatch SLO
+    evaluator + the flight recorder's span shares) and turns the real
+    actuators (dispatch depth, fill window) through
+    ``engine.apply_tuning``; nothing is scripted. Reported per phase:
+    rps / p50 / p99 and client-side SLO attainment (fraction of requests
+    under the latency objective's threshold — computed from the same
+    latency samples, so both modes share one ruler). Headlines:
+    ``spike_rps_x`` (autopilot ÷ defaults, >1 = faster) and
+    ``spike_p99_x`` (defaults ÷ autopilot, >1 = tighter tail) on the
+    spike phase — the phase static configuration leaves on the table.
+    ``BENCH_SERVE_AUTOPILOT=0`` skips the block."""
+    from gordo_components_tpu.autopilot import (
+        AIMD,
+        Actuator,
+        Autopilot,
+        SignalReader,
+        Thresholds,
+    )
+    from gordo_components_tpu.autopilot import policy as ap_policy
+    from gordo_components_tpu.observability import slo as slo_engine
+    from gordo_components_tpu.observability import spans
+    from gordo_components_tpu.observability.flightrec import RECORDER
+    from gordo_components_tpu.server.engine import ServingEngine
+
+    n_machines = int(os.environ.get("BENCH_SERVE_AP_MACHINES", "8"))
+    rows, tags = 64, 6
+    phases = (
+        ("ramp", 4, 2.5),
+        ("spike", 12, 5.0),
+        ("idle", 1, 1.5),
+    )
+    threshold_s, _target = slo_engine.latency_knobs()
+    models = build_models(n_machines, rows, tags)
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(rows, tags)).astype(np.float32) * 2 + 4
+
+    def run_mode(autopilot_on: bool) -> dict:
+        engine = ServingEngine(models)
+        names = engine.machines()
+        for name in names:  # warm compiles out of the measured window
+            engine.anomaly(name, X)
+        engine.quiesce()
+        RECORDER.clear()
+        pilot = None
+        if autopilot_on:
+            evaluator = slo_engine.SLOEvaluator(
+                [
+                    slo_engine.Objective(
+                        name="bench-dispatch",
+                        kind="latency",
+                        metric="gordo_engine_dispatch_seconds",
+                        target=0.99,
+                        threshold_s=threshold_s,
+                    )
+                ],
+                fast_window=5.0, slow_window=30.0, min_interval=0.0,
+            )
+            # aggressive settling constants: the bench's phases are
+            # seconds long, production's are minutes (the knobs)
+            thresholds = Thresholds(burn_high=1.0, burn_low=0.25)
+            reader = SignalReader(
+                slo=evaluator, recorder=RECORDER,
+                engine_stats=engine.stats,
+            )
+            tuning = engine.current_tuning
+            aimd = AIMD(step=0.5, backoff=0.5)
+            pilot = Autopilot(
+                reader,
+                [
+                    Actuator(
+                        name="dispatch_depth",
+                        read=lambda: tuning()["dispatch_depth"],
+                        apply=lambda v: engine.apply_tuning(
+                            dispatch_depth=v
+                        ),
+                        decide=ap_policy.depth_rule(thresholds),
+                        bounds=ap_policy.Bounds(1, 8),
+                        aimd=aimd, cooldown=0.6, confirm=2,
+                    ),
+                    Actuator(
+                        name="fill_window",
+                        read=lambda: tuning()["fill_window_us"],
+                        apply=lambda v: engine.apply_tuning(
+                            fill_window_us=v
+                        ),
+                        decide=ap_policy.fill_rule(thresholds),
+                        bounds=ap_policy.Bounds(0, 4000),
+                        aimd=aimd, cooldown=0.6, confirm=2,
+                    ),
+                ],
+                role="bench", min_interval=0.2, enabled=True,
+            )
+
+        def one(t: int, stop_at: float) -> list:
+            lat = []
+            i = 0
+            while time.perf_counter() < stop_at:
+                name = names[(t + i) % len(names)]
+                i += 1
+                timeline, token = spans.begin(
+                    f"bench-ap-{t}-{i}", endpoint="anomaly"
+                )
+                started = time.perf_counter()
+                try:
+                    engine.anomaly(name, X)
+                    lat.append(time.perf_counter() - started)
+                finally:
+                    timeline.finish(status="200")
+                    spans.end(token)
+                    RECORDER.record(timeline)
+            return lat
+
+        # the controller is scrape-driven in production; here a ticker
+        # thread stands in for the scraper so evaluation runs DURING the
+        # phases (pool.map blocks the driver thread)
+        import threading
+
+        ticker_stop = threading.Event()
+        ticker_thread = None
+        if pilot is not None:
+            def ticker():
+                while not ticker_stop.is_set():
+                    try:
+                        pilot.maybe_tick()
+                    except Exception:
+                        pass
+                    ticker_stop.wait(0.1)
+
+            ticker_thread = threading.Thread(
+                target=ticker, name="bench-ap-ticker", daemon=True
+            )
+            ticker_thread.start()
+
+        out: dict = {}
+        try:
+            for phase_name, threads, seconds in phases:
+                stop_at = time.perf_counter() + seconds
+                started = time.perf_counter()
+                with ThreadPoolExecutor(max_workers=threads) as pool:
+                    lat_lists = list(
+                        pool.map(
+                            lambda t: one(t, stop_at), range(threads)
+                        )
+                    )
+                elapsed = time.perf_counter() - started
+                lat = np.asarray(
+                    [v for lst in lat_lists for v in lst]
+                )
+                out[phase_name] = {
+                    "requests": int(lat.size),
+                    "rps": round(lat.size / elapsed, 1),
+                    "p50_ms": round(
+                        float(np.percentile(lat, 50)) * 1000, 3
+                    ) if lat.size else None,
+                    "p99_ms": round(
+                        float(np.percentile(lat, 99)) * 1000, 3
+                    ) if lat.size else None,
+                    "slo_attainment": round(
+                        float((lat <= threshold_s).mean()), 4
+                    ) if lat.size else None,
+                }
+        finally:
+            ticker_stop.set()
+            if ticker_thread is not None:
+                ticker_thread.join(timeout=5)
+            out["final_tuning"] = engine.current_tuning()
+            if pilot is not None:
+                out["decisions"] = pilot.snapshot()["decisions"]
+            engine.close()
+        return out
+
+    out: dict = {
+        "machines": n_machines,
+        "request_shape": [rows, tags],
+        "phases": [
+            {"name": name, "threads": threads, "seconds": seconds}
+            for name, threads, seconds in phases
+        ],
+        "slo_threshold_ms": round(threshold_s * 1000, 1),
+        "modes": {},
+    }
+    out["modes"]["defaults"] = run_mode(False)
+    out["modes"]["autopilot"] = run_mode(True)
+    spike_a = out["modes"]["autopilot"].get("spike") or {}
+    spike_d = out["modes"]["defaults"].get("spike") or {}
+    if spike_a.get("rps") and spike_d.get("rps"):
+        out["spike_rps_x"] = round(spike_a["rps"] / spike_d["rps"], 3)
+    if spike_a.get("p99_ms") and spike_d.get("p99_ms"):
+        out["spike_p99_x"] = round(
+            spike_d["p99_ms"] / spike_a["p99_ms"], 3
+        )
+    out["autopilot_wins"] = bool(
+        out.get("spike_rps_x", 0) > 1.0 or out.get("spike_p99_x", 0) > 1.0
+    )
+    return out
+
+
 def measure_cold_start(models, rows: int, tags: int) -> dict:
     """Boot the serving engine twice against ONE throwaway compile-cache
     root and report each boot's warmup wall time, first-request latency,
@@ -1197,6 +1401,11 @@ def main() -> None:
     # skips it)
     if os.environ.get("BENCH_SERVE_MULTIWORKER", "1") == "1":
         result["multi_worker"] = measure_multi_worker()
+    # closed-loop autopilot A/B: the shifting ramp→spike→idle mix at
+    # hand-set defaults vs with the controller turning depth/fill live
+    # (ISSUE 12; BENCH_SERVE_AUTOPILOT=0 skips it)
+    if os.environ.get("BENCH_SERVE_AUTOPILOT", "1") == "1":
+        result["autopilot"] = measure_autopilot()
     if degraded:
         result["degraded"] = (
             "accelerator tunnel down; measured on the CPU backend — "
@@ -1250,6 +1459,8 @@ def main() -> None:
             "multi_worker": result.get("multi_worker"),
             # objective attainment + burn rates at end of run (§18)
             "slo": result.get("slo"),
+            # closed-loop controller A/B on the shifting load mix (§20)
+            "autopilot": result.get("autopilot"),
         })
     except Exception:
         pass  # history is never worth failing an artifact over

@@ -83,7 +83,13 @@ class Baseline:
 
     def split(self, findings: List[Finding]):
         """Partition findings into (fresh, suppressed) and compute the
-        stale baseline idents (entries matching no current finding)."""
+        stale baseline idents (entries matching no current finding).
+
+        A suppressing entry must also be JUSTIFIED: ``--write-baseline``
+        stubs reasons as ``TODO: justify``, and an entry still carrying
+        a stub (or an empty reason) is itself a finding — the baseline
+        may only hold keeps a human has written a reason for, so stubs
+        expire instead of quietly becoming permanent."""
         fresh: List[Finding] = []
         suppressed: List[Finding] = []
         seen = set()
@@ -93,6 +99,28 @@ class Baseline:
                 seen.add(finding.ident)
             else:
                 fresh.append(finding)
+        for ident in sorted(seen):
+            reason = self.entries.get(ident, "").strip()
+            if not reason or reason.upper().startswith("TODO"):
+                fresh.append(
+                    Finding(
+                        checker="baseline",
+                        code="unjustified-keep",
+                        file=self.path or "lint_baseline.json",
+                        line=1,
+                        key=ident,
+                        message=(
+                            f"baseline entry {ident!r} suppresses a "
+                            "finding without a written reason "
+                            f"({reason or 'empty'!r})"
+                        ),
+                        hint=(
+                            "replace the stub with WHY this violation "
+                            "is a deliberate keep, or fix the violation "
+                            "and delete the entry"
+                        ),
+                    )
+                )
         stale = sorted(set(self.entries) - seen)
         for ident in stale:
             fresh.append(

@@ -145,6 +145,57 @@ KNOBS: Dict[str, Knob] = dict(
               "min seconds between scrape-driven SLO evaluation ticks "
               "(`/metrics` and `/slo` reads piggyback evaluation)",
               "observability"),
+        # -- autopilot (§20) ---------------------------------------------
+        _knob("GORDO_AUTOPILOT", "unset", "bool",
+              "closed-loop controller: `1` enables at boot, unset boots "
+              "disabled but runtime-enableable (`POST /autopilot/enable`), "
+              "explicit `0` is the hard kill switch (no controller at all)",
+              "autopilot"),
+        _knob("GORDO_AUTOPILOT_INTERVAL", "5", "float",
+              "min seconds between scrape-driven autopilot evaluation "
+              "ticks (`/metrics` and `/autopilot` reads piggyback them)",
+              "autopilot"),
+        _knob("GORDO_AUTOPILOT_BURN_HIGH", "1.0", "float",
+              "fast-window burn rate at/above which the controller backs "
+              "actuators off (multiplicative decrease)", "autopilot"),
+        _knob("GORDO_AUTOPILOT_BURN_LOW", "0.25", "float",
+              "fast-window burn rate at/below which the controller may "
+              "probe upward (additive increase)", "autopilot"),
+        _knob("GORDO_AUTOPILOT_COOLDOWN", "30", "float",
+              "per-actuator seconds between applied adaptations (the AIMD "
+              "settling time)", "autopilot"),
+        _knob("GORDO_AUTOPILOT_STEP", "0.5", "float",
+              "AIMD additive-increase fraction of the current value "
+              "(min +1) on an upward decision", "autopilot"),
+        _knob("GORDO_AUTOPILOT_BACKOFF", "0.5", "float",
+              "AIMD multiplicative-decrease factor on a downward "
+              "decision (never less than -1 per step)", "autopilot"),
+        _knob("GORDO_AUTOPILOT_CONFIRM", "2", "int",
+              "hysteresis: consecutive ticks a direction must persist "
+              "before the controller acts on it", "autopilot"),
+        _knob("GORDO_AUTOPILOT_SCALE_TICKS", "3", "int",
+              "elastic hysteresis: consecutive ticks of sustained burn / "
+              "idle before a worker is spawned or retired", "autopilot"),
+        _knob("GORDO_AUTOPILOT_IDLE_RPS", "1.0", "float",
+              "observed fleet request rate below which (with zero burn) "
+              "sustained idle may retire a worker down to the floor",
+              "autopilot"),
+        _knob("GORDO_AUTOPILOT_DEPTH_BOUNDS", "1:8", "spec",
+              "`min:max` hard bounds for live dispatch-depth tuning "
+              "(the GORDO_DISPATCH_DEPTH actuator)", "autopilot"),
+        _knob("GORDO_AUTOPILOT_FILL_BOUNDS", "0:4000", "spec",
+              "`min:max` hard bounds (µs) for live fill-window tuning "
+              "(the GORDO_FILL_WINDOW_US actuator)", "autopilot"),
+        _knob("GORDO_AUTOPILOT_INFLIGHT_BOUNDS", "8:256", "spec",
+              "`min:max` hard bounds for live admission tuning (the "
+              "GORDO_MAX_INFLIGHT actuator)", "autopilot"),
+        _knob("GORDO_AUTOPILOT_RESIDENCY_BOUNDS", "16:1024", "spec",
+              "`min:max` hard bounds for live megabatch-residency tuning "
+              "(the GORDO_MEGABATCH_RESIDENCY actuator; partial-residency "
+              "buckets only)", "autopilot"),
+        _knob("GORDO_AUTOPILOT_WORKER_BOUNDS", "1:8", "spec",
+              "`floor:ceiling` for the elastic worker count (the router's "
+              "spawn/retire actuator)", "autopilot"),
         # -- store -------------------------------------------------------
         _knob("GORDO_STORE_KEEP_GENERATIONS", "3", "int",
               "generations kept per machine after a commit prunes old "

@@ -29,6 +29,8 @@ from typing import Dict, Tuple
 LOCK_RANKS: Dict[str, int] = {
     # -- admin / control-plane outer locks (held across whole operations)
     "server.reload": 10,        # server.py _reload_lock: one reload at a time
+    "autopilot.state": 12,      # controller.py _lock: tick/decision state
+    "autopilot.elastic": 13,    # elastic.py _lock: one scale op at a time
     "router.op": 15,            # rollout.py _op_lock: one rollout/rollback
     "server.admission": 20,     # admission.py gate condition
     "server.state_cond": 25,    # server.py _ServerState in-flight tracking
@@ -95,6 +97,8 @@ LOCK_ATTRS: Dict[Tuple[str, str], str] = {
     ("router/router.py", "_models_lock"): "router.models",
     ("router/router.py", "_stitch_lock"): "router.stitch",
     ("observability/slo.py", "_lock"): "observability.slo",
+    ("autopilot/controller.py", "_lock"): "autopilot.state",
+    ("autopilot/elastic.py", "_lock"): "autopilot.elastic",
     ("router/rollout.py", "_op_lock"): "router.op",
     ("router/rollout.py", "_lock"): "router.rollout_state",
     ("router/placement.py", "_lock"): "router.placement",

@@ -32,19 +32,20 @@ CHECKER = "metrics-conventions"
 COMPONENTS = (
     "server", "engine", "client", "build", "builds", "fleet", "watchman",
     "router", "resilience", "store", "compile_cache", "span", "stage",
-    "drift", "lint", "slo",
+    "drift", "lint", "slo", "autopilot",
 )
 
 # §7 label allowlist: low-cardinality enums only. ``machine``/``worker``/
 # ``target`` are bounded by fleet/tier size — the documented exceptions.
 # ``window`` is the two-value fast/slow burn-rate window enum (§18).
 # ``precision`` is the three-value f32/bf16/int8 ladder enum (§19).
+# ``actuator``/``direction`` are the autopilot's decision enums (§20).
 ALLOWED_LABELS = frozenset(
     {
         "endpoint", "status", "kind", "outcome", "path", "event", "phase",
         "reason", "stage", "name", "trigger", "format", "worker",
         "machine", "target", "cause", "point", "to", "where", "error",
-        "window", "precision",
+        "window", "precision", "actuator", "direction",
     }
 )
 

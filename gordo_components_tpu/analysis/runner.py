@@ -219,7 +219,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--write-baseline", action="store_true",
                         help="grandfather every current finding into the "
                              "baseline (reasons start as TODO — fill them "
-                             "in)")
+                             "in; a TODO-stubbed entry is itself reported "
+                             "as baseline[unjustified-keep] until a real "
+                             "reason lands)")
     parser.add_argument("--write-knob-table", action="store_true",
                         help="regenerate README.md's knob table from "
                              "analysis/knobs.py and exit")

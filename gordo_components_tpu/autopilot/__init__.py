@@ -1,0 +1,47 @@
+"""Closed-loop autopilot: the SLO engine drives the knobs and workers.
+
+ARCHITECTURE §20. Three layers, one decision journal:
+
+- :mod:`.signals` — normalized observation snapshots off the existing
+  signal plane (SLO burn rates, flight-recorder span shares, admission
+  occupancy, request rate); clock-injectable and scrape-driven;
+- :mod:`.policy` — per-actuator AIMD with hysteresis, cooldowns, and
+  hard ``min:max`` bounds, every constant a registered ``GORDO_
+  AUTOPILOT_*`` knob;
+- :mod:`.controller` — the tick loop, oscillation guard, kill-switch
+  contract, and the journal (``gordo_autopilot_decisions_total`` +
+  flight-recorder events + the ``/autopilot`` status ring);
+- :mod:`.elastic` — spawn/retire router workers through the existing
+  supervisor slot table and consistent-hash ring, drain-before-retire.
+"""
+
+from __future__ import annotations
+
+from .controller import (
+    Autopilot,
+    build_router_autopilot,
+    build_server_autopilot,
+    disabled_snapshot,
+    enabled_at_boot,
+    hard_off,
+)
+from .elastic import ElasticWorkers
+from .policy import AIMD, Actuator, Bounds, Thresholds, parse_bounds
+from .signals import Observation, SignalReader
+
+__all__ = [
+    "AIMD",
+    "Actuator",
+    "Autopilot",
+    "Bounds",
+    "ElasticWorkers",
+    "Observation",
+    "SignalReader",
+    "Thresholds",
+    "build_router_autopilot",
+    "build_server_autopilot",
+    "disabled_snapshot",
+    "enabled_at_boot",
+    "hard_off",
+    "parse_bounds",
+]

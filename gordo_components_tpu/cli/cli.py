@@ -895,6 +895,69 @@ def slo_cmd(base_url):
     click.echo(json.dumps(response.json(), indent=2))
 
 
+@gordo.group("autopilot")
+def autopilot_group():
+    """The closed-loop controller (ARCHITECTURE §20): SLO-driven knob
+    tuning on servers, elastic worker scaling on the router.
+
+    ``status`` dumps the /autopilot body (enablement, per-actuator
+    values/bounds/cooldowns, the decision journal, the last
+    observation); ``enable``/``disable`` are the runtime kill switch.
+    The HARD kill switch is ``GORDO_AUTOPILOT=0`` at process start —
+    under it no controller exists and ``enable`` answers 409.
+    """
+
+
+def _autopilot_request(base_url: str, path: str, method: str = "GET"):
+    import requests
+
+    url = f"{base_url.rstrip('/')}{path}"
+    try:
+        response = requests.request(method, url, timeout=10)
+    except requests.RequestException as exc:
+        logger.error("Could not reach %s: %s", url, exc)
+        sys.exit(1)
+    try:
+        body = response.json()
+    except ValueError:
+        logger.error("Non-JSON answer from %s (HTTP %d)", url,
+                     response.status_code)
+        sys.exit(1)
+    if response.status_code >= 400:
+        logger.error("%s answered HTTP %d: %s", url, response.status_code,
+                     body.get("error", body))
+        sys.exit(1)
+    return body
+
+
+@autopilot_group.command("status")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+def autopilot_status_cmd(base_url):
+    """Controller status from a live server's ``/autopilot``."""
+    click.echo(json.dumps(_autopilot_request(base_url, "/autopilot"),
+                          indent=2))
+
+
+@autopilot_group.command("enable")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+def autopilot_enable_cmd(base_url):
+    """Start (or resume) adapting: ``POST /autopilot/enable``."""
+    body = _autopilot_request(base_url, "/autopilot/enable", method="POST")
+    click.echo(json.dumps(body, indent=2))
+
+
+@autopilot_group.command("disable")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+def autopilot_disable_cmd(base_url):
+    """The runtime kill switch: freeze all adaptation NOW
+    (``POST /autopilot/disable``); status stays readable."""
+    body = _autopilot_request(base_url, "/autopilot/disable", method="POST")
+    click.echo(json.dumps(body, indent=2))
+
+
 @gordo.group("client")
 def client_group():
     """Bulk prediction against running servers."""

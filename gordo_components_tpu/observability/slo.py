@@ -391,6 +391,33 @@ class SLOEvaluator:
         recorder.record(timeline)
 
     # -- views ---------------------------------------------------------------
+    def burn_snapshot(
+        self, now: Optional[float] = None
+    ) -> Dict[str, Dict[str, Any]]:
+        """Lightweight per-objective burn view for programmatic consumers
+        (the autopilot's signals layer): fast/slow window burn and
+        since-boot attainment, no recorder scan, no attribution."""
+        now = self._clock() if now is None else now
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for objective in self.objectives:
+                history = self._history[objective.name]
+                good, total = (
+                    (history[-1][1], history[-1][2])
+                    if history else (0.0, 0.0)
+                )
+                out[objective.name] = {
+                    "kind": objective.kind,
+                    "fast": self._burn_locked(
+                        objective, self.fast_window, now
+                    ),
+                    "slow": self._burn_locked(
+                        objective, self.slow_window, now
+                    ),
+                    "attainment": good / total if total > 0 else None,
+                }
+        return out
+
     def snapshot(
         self, recorder: Optional[flightrec.FlightRecorder] = None
     ) -> Dict[str, Any]:

@@ -107,6 +107,19 @@ class AdmissionController:
                 "closed": self._closed,
             }
 
+    # -- live tuning ---------------------------------------------------------
+    def set_max_inflight(self, max_inflight: int) -> int:
+        """Resize the gate live (the autopilot's admission actuator, §20).
+        Raising it wakes queued waiters so newly legal slots are taken
+        immediately; lowering it sheds no one already admitted — the gate
+        simply stops admitting until occupancy drains below the new
+        bound. Returns the applied value."""
+        max_inflight = max(1, int(max_inflight))
+        with self._cond:
+            self.max_inflight = max_inflight
+            self._cond.notify_all()
+        return max_inflight
+
     # -- graceful shutdown ---------------------------------------------------
     @property
     def closed(self) -> Optional[str]:

@@ -199,3 +199,10 @@ class BreakerBoard:
                 name: breaker.state
                 for name, breaker in sorted(self._breakers.items())
             }
+
+    def forget(self, name: str) -> None:
+        """Drop a breaker whose downstream no longer exists (a retired
+        elastic worker, §20) so status views stop reporting it. A later
+        ``get`` for the same name mints a fresh closed circuit."""
+        with self._lock:
+            self._breakers.pop(name, None)

@@ -39,6 +39,7 @@ from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
 from ..analysis import lockcheck
+from ..autopilot import build_router_autopilot, disabled_snapshot
 from ..observability import (
     aggregate,
     exposition,
@@ -113,6 +114,9 @@ _URL_MAP = Map(
         Rule("/healthz", endpoint="healthz"),
         Rule("/metrics", endpoint="metrics"),
         Rule("/slo", endpoint="slo"),
+        # elastic autopilot: status + runtime kill switch (§20)
+        Rule("/autopilot", endpoint="autopilot"),
+        Rule("/autopilot/<action>", endpoint="autopilot-action"),
         Rule("/models", endpoint="models"),
         Rule("/reload", endpoint="reload"),
         Rule("/rollback", endpoint="rollback"),
@@ -184,6 +188,11 @@ class FleetRouter:
             if slo_engine.enabled()
             else None
         )
+        # elastic autopilot (§20): spawns/retires workers through the
+        # supervisor slot table + hash ring on sustained burn / idle.
+        # None under GORDO_AUTOPILOT=0; constructed-but-frozen when the
+        # knob is unset.
+        self.autopilot = build_router_autopilot(self)
         tracing.install_log_record_factory()
 
     # -- WSGI ----------------------------------------------------------------
@@ -224,11 +233,15 @@ class FleetRouter:
                 )
                 if request.path not in (
                     "/healthz", "/metrics", "/slo", "/router/status",
-                ) and not request.path.startswith("/debug/"):
+                ) and not request.path.startswith(
+                    ("/debug/", "/autopilot")
+                ):
                     flightrec.RECORDER.record(timeline)
             logger.log(
                 logging.DEBUG
-                if request.path in ("/healthz", "/metrics", "/slo")
+                if request.path in (
+                    "/healthz", "/metrics", "/slo", "/autopilot",
+                )
                 else logging.INFO,
                 "%s %s -> %d in %.1f ms [trace=%s]",
                 request.method,
@@ -250,6 +263,8 @@ class FleetRouter:
         if endpoint == "metrics":
             if self.slo is not None:
                 self.slo.maybe_tick()
+            if self.autopilot is not None:
+                self.autopilot.maybe_tick()
             exemplars = request.args.get("exemplars") in ("1", "true")
             if request.args.get("format") == "prometheus":
                 if request.args.get("aggregate") in (
@@ -280,6 +295,39 @@ class FleetRouter:
                 return _json({"enabled": False})
             self.slo.maybe_tick()
             return _json(self.slo.snapshot(recorder=flightrec.RECORDER))
+        if endpoint == "autopilot":
+            if self.autopilot is None:
+                return _json(disabled_snapshot())
+            if self.slo is not None:
+                self.slo.maybe_tick()  # fresh burn rates first
+            self.autopilot.maybe_tick()
+            return _json(self.autopilot.snapshot())
+        if endpoint == "autopilot-action":
+            if request.method != "POST":
+                return _json({"error": "POST required"}, status=405)
+            if self.autopilot is None:
+                return _json(
+                    {
+                        **disabled_snapshot(),
+                        "error": "hard kill switch active; runtime "
+                                 "enable is not possible",
+                    },
+                    status=409,
+                )
+            action = args.get("action")
+            if action == "enable":
+                self.autopilot.enable()
+            elif action == "disable":
+                self.autopilot.disable(
+                    reason="operator via /autopilot/disable"
+                )
+            else:
+                return _json(
+                    {"error": f"unknown autopilot action {action!r} "
+                              "(enable | disable)"},
+                    status=404,
+                )
+            return _json(self.autopilot.snapshot())
         if endpoint == "debug-requests":
             limit = request.args.get("limit", type=int)
             return _json(

@@ -163,6 +163,12 @@ class WorkerSupervisor:
     REPLACES the slot's worker object; the spec (name, port) is stable,
     so the ring, the placement table, and every cached base URL survive
     the restart untouched.
+
+    The slot table itself is elastic (§20): ``add_slot`` grows it and
+    ``retire`` shrinks it at runtime. ``self.specs`` is COPY-ON-WRITE —
+    every mutation swaps in a fresh dict — so the router's lock-free
+    readers (candidate walks, status views, probe sweeps mid-iteration)
+    always see a consistent snapshot, never a dict mutated under them.
     """
 
     def __init__(
@@ -196,16 +202,25 @@ class WorkerSupervisor:
         timeout: float = 180.0,
         poll_interval: float = 0.25,
         probe: Optional[Callable[[WorkerSpec], bool]] = None,
+        names: Optional[Sequence[str]] = None,
     ) -> List[str]:
         """Block until every worker answers its ``/healthz`` (or
         ``timeout``); returns the names that became ready. Workers that
-        DIED while waiting are reported missing rather than waited on."""
+        DIED while waiting are reported missing rather than waited on.
+        ``names`` restricts the wait to a subset — the elastic layer
+        waits on its ONE new worker without re-gating the whole fleet
+        (a sick incumbent must not stall a scale-up)."""
         if probe is None:
             probe = _default_ready_probe
         ready: set = set()
         end = time.monotonic() + timeout
         while time.monotonic() < end:
-            for name, spec in self.specs.items():
+            specs = self.specs  # copy-on-write snapshot per sweep
+            wanted = (
+                {n: specs[n] for n in names if n in specs}
+                if names is not None else specs
+            )
+            for name, spec in wanted.items():
                 if name in ready:
                     continue
                 worker = self.worker(name)
@@ -216,7 +231,7 @@ class WorkerSupervisor:
                         ready.add(name)
                 except Exception:
                     pass
-            if len(ready) == len(self.specs):
+            if len(ready) == len(wanted):
                 break
             time.sleep(poll_interval)
         self._publish_alive()
@@ -256,6 +271,73 @@ class WorkerSupervisor:
         _M_WORKERS_ALIVE.set(
             sum(1 for w in self.workers().values() if w.alive())
         )
+
+    # -- elastic slots (§20) -------------------------------------------------
+    def add_slot(self, spec: WorkerSpec):
+        """Grow the slot table by one worker (spawned immediately via
+        the supervisor's own factory — subprocess and thread tiers share
+        this seam). The caller owns readiness and ring membership; this
+        method only makes the process exist.
+
+        Ordering matters: the worker is STARTED before its spec is
+        published. A spec visible without a live worker object reads as
+        ``dead`` to a concurrent control-plane probe sweep, which would
+        quarantine the slot and respawn a duplicate process onto the
+        same port — so spec and worker land in the table together, under
+        the lock, only once the process exists."""
+        with self._lock:
+            if spec.name in self.specs:
+                raise ValueError(f"worker {spec.name!r} already has a slot")
+        worker = self._factory(spec)
+        worker.start()
+        with self._lock:
+            if spec.name in self.specs:
+                # lost a naming race (two concurrent scale-ups must not
+                # both win a slot): ours never becomes visible — kill it
+                try:
+                    worker.terminate(2.0)
+                except Exception:
+                    pass
+                raise ValueError(f"worker {spec.name!r} already has a slot")
+            self.specs = {**self.specs, spec.name: spec}
+            self._workers[spec.name] = worker
+            self._respawns.setdefault(spec.name, 0)
+        logger.info("Worker slot %s added (elastic)", spec.name)
+        self._publish_alive()
+        return worker
+
+    def retire(self, name: str, grace: float = 15.0) -> WorkerSpec:
+        """Shrink the slot table: remove ``name`` from the table (probe
+        sweeps and status views stop seeing it immediately — a racing
+        control-plane respawn finds no spec and no-ops), then terminate
+        its worker GRACEFULLY: SIGTERM → the server drains in-flight
+        requests and quiesces its engine → exit. The caller must have
+        removed the worker from placement first; with that ordering a
+        retire drops zero accepted requests."""
+        with self._lock:
+            spec = self.specs.get(name)
+            if spec is None:
+                raise KeyError(f"unknown worker {name!r}")
+            specs = dict(self.specs)
+            specs.pop(name)
+            self.specs = specs
+            worker = self._workers.pop(name, None)
+            self._respawns.pop(name, None)
+        if worker is not None:
+            try:
+                worker.terminate(grace)
+            except Exception:
+                logger.warning(
+                    "Retiring worker %s terminate failed; killing", name,
+                    exc_info=True,
+                )
+                try:
+                    worker.kill()
+                except Exception:
+                    pass
+        logger.info("Worker slot %s retired (elastic)", name)
+        self._publish_alive()
+        return spec
 
     # -- repair --------------------------------------------------------------
     def respawn(

@@ -396,6 +396,42 @@ class _Stop:
         self.thread = thread
 
 
+class _DepthGate:
+    """A semaphore whose permit count can be RESIZED live — the seam the
+    autopilot's dispatch-depth actuator turns (§20). Same contract as the
+    ``threading.Semaphore`` it replaces (acquire = take an in-flight
+    slot, release = free one); ``resize`` takes effect without blocking:
+    a shrink simply stops new acquires until in-flight work drains below
+    the new depth, a grow wakes waiting leaders immediately. The inner
+    condition is a plain threading primitive (untracked, like the
+    Semaphore's own lock) — it guards two integers and is never held
+    across any other acquisition."""
+
+    __slots__ = ("_depth_cond", "_depth", "_in_use")
+
+    def __init__(self, depth: int):
+        self._depth_cond = threading.Condition()
+        self._depth = max(1, int(depth))
+        self._in_use = 0
+
+    def acquire(self) -> None:
+        with self._depth_cond:
+            while self._in_use >= self._depth:
+                self._depth_cond.wait()
+            self._in_use += 1
+
+    def release(self) -> None:
+        with self._depth_cond:
+            self._in_use -= 1
+            self._depth_cond.notify_all()
+
+    def resize(self, depth: int) -> int:
+        with self._depth_cond:
+            self._depth = max(1, int(depth))
+            self._depth_cond.notify_all()
+            return self._depth
+
+
 def _collector_loop(bucket_ref: "weakref.ref", fetch_queue: "queue.Queue"):
     """Per-bucket fetch stage: ``device_get`` + result fan-out, FIFO in
     dispatch order. Holds only a WEAK reference between jobs so a dropped
@@ -625,7 +661,7 @@ class _Bucket:
         # collector thread for device_get + fan-out; the semaphore is the
         # backpressure that caps in-flight depth
         self.dispatch_depth = _dispatch_depth()
-        self._inflight_slots = threading.Semaphore(self.dispatch_depth)
+        self._inflight_slots = _DepthGate(self.dispatch_depth)
         self._fetch_queue: "queue.Queue" = queue.Queue()
         self._collector: Optional[threading.Thread] = None
         # serializes collector handover (spawn / close / enqueue): a
@@ -1797,7 +1833,10 @@ class _Bucket:
             slot = self._mega_slots.pop(idx, None)
             if slot is None:
                 return
-            if not self._mega_full:
+            if not self._mega_full and slot < self._mega_cap:
+                # the cap guard matters only across a live residency
+                # resize (§20): a slot handed out under the OLD cap must
+                # not re-enter the new, smaller free list
                 self._mega_free.append(slot)
             self._mega_last_use.pop(idx, None)
             self._mega_hits.pop(idx, None)
@@ -1855,7 +1894,9 @@ class _Bucket:
                     )
                     if age < self._hot_evict_window():
                         continue  # working set is live — don't thrash it
-                    self._mega_free.append(self._mega_slots.pop(victim))
+                    freed = self._mega_slots.pop(victim)
+                    if freed < self._mega_cap:  # resize guard, see demote
+                        self._mega_free.append(freed)
                     self._mega_last_use.pop(victim, None)
                     self._mega_hits.pop(victim, None)
                     _M_MEGA_EVENTS.labels("evict").inc()
@@ -1902,10 +1943,15 @@ class _Bucket:
                 self._mega_stack_dev = new_stack
         except BaseException:
             # a failed gather/upload must hand the reserved slots back,
-            # or the cap shrinks permanently with every failure
+            # or the cap shrinks permanently with every failure (slots
+            # minted under an old, larger cap stay retired — see
+            # _mega_demote's resize guard)
             with self._mega_lock:
                 for idx, slot in pending:
-                    if self._mega_slots.get(idx) != slot:
+                    if (
+                        self._mega_slots.get(idx) != slot
+                        and slot < self._mega_cap
+                    ):
                         self._mega_free.append(slot)
             raise
         for idx, slot in pending:
@@ -1914,6 +1960,62 @@ class _Bucket:
                 "megabatch_residency", action="promote",
                 machine=self.names[idx], slot=slot,
             )
+
+    # -- live tuning (the autopilot's actuation seam, §20) -------------------
+    def set_dispatch_depth(self, depth: int) -> int:
+        """Resize the in-flight dispatch bound live. Non-blocking: a
+        shrink takes effect as in-flight fetches drain below the new
+        depth; a grow wakes any leader waiting on a slot now."""
+        depth = max(1, int(depth))
+        self.dispatch_depth = depth
+        return self._inflight_slots.resize(depth)
+
+    def set_fill_window(self, seconds: float) -> float:
+        """Retarget the megabatch fill window live. A single float swap
+        (reads snapshot it once per fill), clamped off for buckets that
+        never megabatch — exactly the constructor's rule."""
+        self._fill_s = max(0.0, float(seconds)) if self._mega_enabled else 0.0
+        return self._fill_s
+
+    def set_mega_cap(self, cap: int) -> Optional[int]:
+        """Retarget the megabatch residency cap live (partial-residency
+        buckets only — a fully-resident bucket's stack aliases
+        ``self.stacked`` and has no cap to turn; returns None there).
+
+        The resident stack's machine-axis height IS the cap (it is part
+        of the program identity and the persistent cache key, §14/§15),
+        so a resize cannot edit the stack in place: residency is RESET —
+        slots cleared, free list rebuilt, host/device stacks dropped, and
+        the in-memory ``("mega", ...)`` programs evicted so the next
+        promotion compiles at the new height (a clean persistent-cache
+        miss, never a stale hit). Machines re-earn their slots through
+        the normal promotion path. A dispatch racing the resize can pair
+        an old program with a new stack (or vice versa) for one batch;
+        the fused path's failure contract already demotes and rescores
+        that batch cold, so the race costs a fallback, never a wrong or
+        dropped result."""
+        if not self._mega_enabled or self._mega_full:
+            return None
+        cap = max(1, int(cap))
+        with self._mega_lock:
+            if cap == self._mega_cap:
+                return cap
+            self._mega_cap = cap
+            self._mega_slots.clear()
+            self._mega_free = list(range(cap))
+            self._mega_hits.clear()
+            self._mega_last_use.clear()
+            self._mega_host_stack = None
+            self._mega_stack_dev = None
+        for key in [
+            k for k in list(self._programs)
+            if isinstance(k, tuple) and k and k[0] == "mega"
+        ]:
+            self._programs.pop(key, None)
+            self._fresh_programs.discard(key)
+        _M_MEGA_EVENTS.labels("residency_resize").inc()
+        spans.event("megabatch_residency", action="resize", cap=cap)
+        return cap
 
     @staticmethod
     def _pay_down_demotions(demotions: Dict[int, int], idx: int) -> None:
@@ -2331,6 +2433,55 @@ class ServingEngine:
         """Drain every bucket's fetch stage (see ``_Bucket.quiesce``)."""
         for bucket in self._buckets:
             bucket.quiesce()
+
+    def current_tuning(self) -> Dict[str, int]:
+        """The live values of the autopilot-tunable knobs — cheap (no
+        stats() dict build), read per evaluation tick."""
+        return {
+            "dispatch_depth": (
+                self._buckets[0].dispatch_depth if self._buckets
+                else _dispatch_depth()
+            ),
+            "fill_window_us": self.fill_window_us,
+            "megabatch_residency": self.megabatch_residency,
+        }
+
+    def apply_tuning(
+        self,
+        dispatch_depth: Optional[int] = None,
+        fill_window_us: Optional[int] = None,
+        megabatch_residency: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Live actuation seam (§20): retarget the data-plane knobs on a
+        RUNNING engine, no reload. Narrow by design — each value lands
+        through one per-bucket setter that respects the lock hierarchy
+        (depth: a lock-free gate resize; fill window: one float swap;
+        residency: a reset under ``engine.mega`` with the fused-failure
+        contract absorbing any in-flight race). Returns what was applied;
+        residency reports None when no bucket runs partial residency."""
+        applied: Dict[str, Any] = {}
+        if dispatch_depth is not None:
+            depth = max(1, int(dispatch_depth))
+            for bucket in self._buckets:
+                bucket.set_dispatch_depth(depth)
+            applied["dispatch_depth"] = depth
+        if fill_window_us is not None:
+            us = max(0, int(fill_window_us)) if self.megabatch else 0
+            self.fill_window_us = us
+            for bucket in self._buckets:
+                bucket.set_fill_window(us / 1e6)
+            applied["fill_window_us"] = us
+        if megabatch_residency is not None:
+            cap = max(1, int(megabatch_residency))
+            results = [
+                bucket.set_mega_cap(cap) for bucket in self._buckets
+            ]
+            if any(result is not None for result in results):
+                self.megabatch_residency = cap
+                applied["megabatch_residency"] = cap
+            else:
+                applied["megabatch_residency"] = None
+        return applied
 
     def can_score(self, name: str) -> bool:
         return name in self._by_name

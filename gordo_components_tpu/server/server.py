@@ -57,6 +57,7 @@ from werkzeug.wrappers import Request, Response
 
 from .. import precision as precision_mod
 from ..analysis import lockcheck
+from ..autopilot import build_server_autopilot, disabled_snapshot
 from ..models.anomaly.base import AnomalyDetectorBase
 from ..observability import exposition, flightrec, spans, stitch, tracing
 from ..observability import slo as slo_engine
@@ -103,6 +104,9 @@ _URL_MAP = Map(
         Rule("/slo", endpoint="slo"),
         Rule("/models", endpoint="models"),
         Rule("/reload", endpoint="reload"),
+        # closed-loop controller status + runtime kill switch (§20)
+        Rule("/autopilot", endpoint="autopilot"),
+        Rule("/autopilot/<action>", endpoint="autopilot-action"),
         Rule("/prediction", endpoint="prediction"),
         Rule("/anomaly/prediction", endpoint="anomaly"),
         Rule("/download-model", endpoint="download-model"),
@@ -463,6 +467,14 @@ class ModelServer:
             if slo_engine.enabled()
             else None
         )
+        # closed-loop autopilot (§20): observes the SLO engine + span
+        # shares, tunes dispatch depth / fill window / admission /
+        # residency through apply_tuning below. None under the hard kill
+        # switch (GORDO_AUTOPILOT=0); constructed-but-frozen when unset.
+        # Last-applied values survive reload generation swaps via
+        # self._tuning.
+        self._tuning: Dict[str, int] = {}
+        self.autopilot = build_server_autopilot(self)
         # every record emitted while serving a request carries its trace id
         # (idempotent; composes with logsetup.configure_logging)
         tracing.install_log_record_factory()
@@ -484,6 +496,40 @@ class ModelServer:
     @property
     def _single(self) -> Optional[_Machine]:
         return self._state.single
+
+    def apply_tuning(
+        self,
+        dispatch_depth: Optional[int] = None,
+        fill_window_us: Optional[int] = None,
+        max_inflight: Optional[int] = None,
+        megabatch_residency: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """The autopilot's actuation seam (§20): land new knob values on
+        the LIVE serving state without a reload. Admission resizes under
+        its own condition; engine values go through the engine's
+        per-bucket setters. Applied values are remembered so a reload's
+        fresh generation inherits them instead of re-reading the env."""
+        applied: Dict[str, Any] = {}
+        if max_inflight is not None:
+            applied["max_inflight"] = self.admission.set_max_inflight(
+                max_inflight
+            )
+            self._tuning["max_inflight"] = applied["max_inflight"]
+        engine_values = {
+            "dispatch_depth": dispatch_depth,
+            "fill_window_us": fill_window_us,
+            "megabatch_residency": megabatch_residency,
+        }
+        engine_values = {
+            key: value for key, value in engine_values.items()
+            if value is not None
+        }
+        if engine_values:
+            applied.update(self._state.engine.apply_tuning(**engine_values))
+            for key, value in applied.items():
+                if key != "max_inflight" and value is not None:
+                    self._tuning[key] = value
+        return applied
 
     def reload(self) -> Dict[str, Any]:
         """Rescan ``models_root`` and swap in the new fleet as ONE state
@@ -599,6 +645,15 @@ class ModelServer:
                 # generation: the old state serves meanwhile, so no request
                 # ever races the compile (the reload POST waits instead)
                 self._warm_engine(new_state)
+                # the autopilot's live-applied values survive the swap: a
+                # fresh generation resolves knobs from env, which would
+                # silently revert every adaptation on the next rollout
+                engine_tuning = {
+                    key: value for key, value in self._tuning.items()
+                    if key != "max_inflight"
+                }
+                if engine_tuning:
+                    new_state.engine.apply_tuning(**engine_tuning)
                 self._state = new_state
                 # drain the OLD generation before returning: dropped
                 # machines' device-resident params must not be released
@@ -769,6 +824,7 @@ class ModelServer:
                 # ring within one poll interval
                 if endpoint not in (
                     "healthz", "metrics", "slo",
+                    "autopilot", "autopilot-action",
                     "debug-requests", "debug-request",
                 ):
                     flightrec.RECORDER.record(timeline)
@@ -777,7 +833,8 @@ class ModelServer:
             # double steady-state log volume (werkzeug's own access line
             # already covers them); real work logs at INFO with its trace
             logger.log(
-                logging.DEBUG if endpoint in ("healthz", "metrics", "slo")
+                logging.DEBUG
+                if endpoint in ("healthz", "metrics", "slo", "autopilot")
                 else logging.INFO,
                 "%s %s -> %d in %.1f ms [trace=%s]",
                 request.method,
@@ -908,12 +965,26 @@ class ModelServer:
                 return _json({"enabled": False})
             self.slo.maybe_tick()
             return _json(self.slo.snapshot(recorder=flightrec.RECORDER))
+        if endpoint == "autopilot":
+            if self.autopilot is None:
+                return _json(disabled_snapshot())
+            # a status read is also an evaluation tick (scrape-driven,
+            # like /slo) — but the SLO engine must tick FIRST so the
+            # burn rates the controller reads are fresh
+            if self.slo is not None:
+                self.slo.maybe_tick()
+            self.autopilot.maybe_tick()
+            return _json(self.autopilot.snapshot())
+        if endpoint == "autopilot-action":
+            return self._autopilot_action(request, args.get("action"))
         if endpoint == "metrics":
             # scrape-driven SLO evaluation: every scrape advances the
             # burn-rate windows (min-interval-gated), so gordo_slo_*
             # series below are fresh without a background thread
             if self.slo is not None:
                 self.slo.maybe_tick()
+            if self.autopilot is not None:
+                self.autopilot.maybe_tick()
             if request.args.get("format") == "prometheus":
                 # &exemplars=1 opts into OpenMetrics-style exemplar
                 # suffixes (gordo tooling / OpenMetrics ingesters); the
@@ -985,6 +1056,32 @@ class ModelServer:
             finally:
                 state.exit()
         raise NotFound(endpoint)
+
+    def _autopilot_action(
+        self, request: Request, action: Optional[str]
+    ) -> Response:
+        """``POST /autopilot/enable|disable`` — the runtime kill switch
+        (``gordo autopilot enable|disable``). Under the HARD kill switch
+        there is no controller to act on: 409."""
+        if request.method != "POST":
+            _abort(405, "POST required")
+        if self.autopilot is None:
+            return _json(
+                {
+                    **disabled_snapshot(),
+                    "error": "hard kill switch active; runtime enable "
+                             "is not possible",
+                },
+                status=409,
+            )
+        if action == "enable":
+            self.autopilot.enable()
+        elif action == "disable":
+            self.autopilot.disable(reason="operator via /autopilot/disable")
+        else:
+            _abort(404, f"unknown autopilot action {action!r} "
+                        "(enable | disable)")
+        return _json(self.autopilot.snapshot())
 
     def _score_endpoint(
         self, request: Request, endpoint: str, machine: _Machine,

@@ -304,6 +304,19 @@ class ControlPlane:
             result = self._last.get(name)
             return dict(result) if result else None
 
+    def forget(self, name: str) -> None:
+        """Drop all health state for a worker that LEFT the slot table
+        (elastic retire, §20): probe history, spawn grace, quarantine
+        entry, and its circuit — a retired worker must not haunt status
+        views, and a future worker reusing the name starts clean."""
+        with self._lock:
+            self._last.pop(name, None)
+            self._spawned_at.pop(name, None)
+        self.quarantine.recover(name)
+        forget = getattr(self.breakers, "forget", None)
+        if callable(forget):
+            forget(name)
+
     def status(self) -> Dict[str, Any]:
         with self._lock:
             last = {name: dict(r) for name, r in self._last.items()}

@@ -215,6 +215,7 @@ def thread_hygiene():
     _GORDO_THREADS = (
         "gordo-bucket-collector", "gordo-control-plane", "gordo-client-io",
         "gordo-worker", "gordo-drain", "gordo-router-stop",
+        "gordo-autopilot-scale",
     )
 
     def offenders():

@@ -146,6 +146,35 @@ def test_baseline_suppresses_and_expires(tmp_path):
     assert [f.key for f in fresh] == ["k2"]
 
 
+def test_baseline_todo_stub_reason_is_itself_a_finding(tmp_path):
+    """ISSUE 12 satellite: a baseline entry still carrying the
+    ``--write-baseline`` stub (or an empty reason) suppresses its
+    finding but is reported as baseline[unjustified-keep] — stubs
+    expire instead of quietly becoming permanent."""
+    path = str(tmp_path / "lint_baseline.json")
+    baseline = Baseline(path=path)
+    baseline.entries[_finding().ident] = "TODO: justify"
+    baseline.entries[_finding(key="k2").ident] = "   "
+    baseline.entries[_finding(key="k3").ident] = "real reason: probe loop"
+    baseline.save()
+
+    reloaded = Baseline.load(path)
+    fresh, suppressed = reloaded.split(
+        [_finding(), _finding(key="k2"), _finding(key="k3")]
+    )
+    assert len(suppressed) == 3  # all three still suppress
+    unjustified = sorted(
+        f.key for f in fresh if f.code == "unjustified-keep"
+    )
+    assert unjustified == sorted(
+        [_finding().ident, _finding(key="k2").ident]
+    )
+    # the justified keep stays clean
+    assert not any(
+        _finding(key="k3").ident == f.key for f in fresh
+    )
+
+
 def test_baseline_ident_is_line_free():
     a = _finding()
     b = Finding(checker="c", code="x", file="f.py", line=999, key="k1",

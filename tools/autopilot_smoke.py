@@ -1,0 +1,620 @@
+#!/usr/bin/env python
+"""Autopilot smoke: the closed loop end to end (``make autopilot-smoke``).
+
+Four experiments (ISSUE 12 acceptance):
+
+- **[1/4] convergence, no oscillation** — a scripted-signal controller
+  on a fake clock: a step change in the observed load must converge the
+  actuator within a bounded number of evaluation ticks and then hold
+  (cooldowns suppress re-fires; an alternating load may flip direction
+  at most once per hold window — the oscillation guard freezes the
+  actuator on the second flip). Pure policy, no jax, microseconds.
+- **[2/4] burn → recorded downscale** — a REAL model server with an
+  injected 250 ms dispatch latency (``GORDO_FAULTS``) and a tight
+  latency objective: the burn-rate crossing must drive a journaled
+  downscale decision (flight-recorder event + ``gordo_autopilot_*``
+  series + ``/autopilot`` ring), and the runtime kill switch
+  (``POST /autopilot/disable``) must stop further adaptation instantly.
+- **[3/4] elastic drain-retire at zero drops** — 2 REAL worker
+  processes behind the router, sustained-idle knobs: the controller
+  must retire one worker (off the ring first, then the PR-8 graceful
+  SIGTERM drain) while trickle traffic flows, with ZERO client-visible
+  errors.
+- **[4/4] elastic spawn on sustained burn + CLI parity** — workers
+  restarted with injected dispatch latency: the router-side burn
+  crossing must spawn a THIRD worker into a fresh slot (ready-gated
+  ring join), and ``gordo autopilot status`` must dump the same
+  decision journal ``/autopilot`` serves.
+
+Exit codes: 0 = all checks passed, 1 = at least one failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-04T00:00:00+00:00",
+    "tag_list": ["tag-a", "tag-b", "tag-c"],
+}
+MODEL_CONFIG = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                  "dims": [6], "epochs": 1,
+                                  "batch_size": 32}},
+        ]
+    }
+}
+MACHINES = ("mach-a", "mach-b")
+
+_failures: list = []
+
+
+def check(ok: bool, message: str) -> None:
+    marker = "ok  " if ok else "FAIL"
+    print(f"  {marker} {message}")
+    if not ok:
+        _failures.append(message)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+def convergence_check() -> None:
+    """[1/4] scripted signals + fake clock: bounded convergence, cooldown
+    suppression, one-flip-per-window oscillation guard, freeze."""
+    from gordo_components_tpu.autopilot import (
+        AIMD,
+        Actuator,
+        Autopilot,
+        Bounds,
+        Observation,
+        Thresholds,
+    )
+    from gordo_components_tpu.autopilot import policy as ap_policy
+    from gordo_components_tpu.observability.flightrec import FlightRecorder
+
+    print("\n[1/4] convergence under a step load change (fake clock)")
+    clock = [0.0]
+    box = {"obs": Observation()}
+
+    class Scripted:
+        def read(self, now=None):
+            return box["obs"]
+
+    value = {"v": 1}
+    actuator = Actuator(
+        name="dispatch_depth",
+        read=lambda: value["v"],
+        apply=lambda v: value.update(v=v),
+        decide=ap_policy.depth_rule(Thresholds()),
+        bounds=Bounds(1, 8),
+        aimd=AIMD(0.5, 0.5),
+        cooldown=5.0,
+        confirm=2,
+    )
+    pilot = Autopilot(
+        Scripted(), [actuator], role="smoke", min_interval=1.0,
+        clock=lambda: clock[0], recorder=FlightRecorder(enabled=True),
+        enabled=True,
+    )
+    # step: idle → queue-dominated healthy load
+    box["obs"] = Observation(
+        burn_fast=0.0, queue_share=0.6, sampled_requests=20
+    )
+    ticks_to_converge = None
+    for tick in range(40):
+        clock[0] += 1.0
+        pilot.tick()
+        if value["v"] >= 8 and ticks_to_converge is None:
+            ticks_to_converge = tick + 1
+    check(
+        ticks_to_converge is not None and ticks_to_converge <= 30,
+        f"actuator converged to its bound within "
+        f"{ticks_to_converge} evaluation ticks",
+    )
+    decisions = pilot.snapshot()["decisions"]
+    check(
+        all(d["direction"] == "up" for d in decisions),
+        f"monotone approach, no oscillation ({len(decisions)} steps)",
+    )
+    up_steps = len(decisions)
+    # steady state: nothing more fires (cooldown + at-bound clamp)
+    for _ in range(20):
+        clock[0] += 1.0
+        pilot.tick()
+    check(
+        len(pilot.snapshot()["decisions"]) == up_steps,
+        "steady state holds: no decision re-fires at the bound",
+    )
+    # alternating load: at most ONE direction flip per actuator per
+    # hold window (4 cooldowns = 20 ticks at 1 s/tick) — the guard's
+    # contract
+    hold_window = 4 * 5.0
+    for i in range(60):
+        clock[0] += 1.0
+        box["obs"] = (
+            Observation(burn_fast=2.0, device_share=0.8)
+            if (i // 5) % 2 == 0
+            else Observation(
+                burn_fast=0.0, queue_share=0.6, sampled_requests=20
+            )
+        )
+        pilot.tick()
+    journal = pilot.snapshot()["decisions"][up_steps:]
+    applied = [d for d in journal if d["direction"] != "hold"]
+    flip_ticks = [
+        b["tick"] for a, b in zip(applied, applied[1:])
+        if a["direction"] != b["direction"]
+    ]
+    min_gap = min(
+        (b - a for a, b in zip(flip_ticks, flip_ticks[1:])),
+        default=hold_window,
+    )
+    held = any(d["reason"] == "oscillation_guard" for d in journal)
+    check(
+        min_gap >= hold_window and held,
+        f"<=1 direction flip per hold window ({len(flip_ticks)} flip(s) "
+        f"over 60 ticks, min gap {min_gap} >= {hold_window:.0f} ticks, "
+        f"guard fired: {held})",
+    )
+
+
+# ---------------------------------------------------------------------------
+def burn_downscale_check(tmp: str) -> None:
+    """[2/4] real server + injected dispatch latency: burn drives a
+    journaled downscale; the runtime kill switch stops it."""
+    import requests
+    from werkzeug.serving import make_server
+
+    print("\n[2/4] injected dispatch latency -> recorded downscale "
+          "decision on a real server")
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.observability.flightrec import RECORDER
+    from gordo_components_tpu.resilience import faults
+    from gordo_components_tpu.server import build_app
+
+    env = {
+        "GORDO_AUTOPILOT": "1",
+        "GORDO_AUTOPILOT_INTERVAL": "0",
+        "GORDO_AUTOPILOT_COOLDOWN": "0.5",
+        "GORDO_AUTOPILOT_CONFIRM": "2",
+        "GORDO_DISPATCH_DEPTH": "4",
+        "GORDO_SLO_LATENCY_MS": "100",
+        "GORDO_SLO_FAST_WINDOW": "10",
+        "GORDO_SLO_EVAL_INTERVAL": "0",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        model_dir = provide_saved_model(
+            "mach-ap", MODEL_CONFIG, DATA_CONFIG,
+            os.path.join(tmp, "mach-ap"),
+            evaluation_config={"cv_mode": "build_only"},
+        )
+        RECORDER.clear()
+        app = build_app({"mach-ap": model_dir}, project="smoke")
+        faults.configure("engine-dispatch:*:latency:0.25")
+        server = make_server("127.0.0.1", 0, app, threaded=True)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_port}"
+        session = requests.Session()
+        payload = json.dumps({"X": [[0.1, 0.2, 0.3]] * 3})
+        headers = {"Content-Type": "application/json"}
+
+        def score():
+            return session.post(
+                f"{base}/gordo/v0/smoke/mach-ap/prediction",
+                data=payload, headers=headers, timeout=30,
+            )
+
+        try:
+            downs = []
+            for _ in range(30):
+                threads = [
+                    threading.Thread(target=score) for _ in range(4)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                status = session.get(f"{base}/autopilot", timeout=10).json()
+                downs = [
+                    d for d in status.get("decisions", [])
+                    if d["direction"] == "down"
+                ]
+                if downs:
+                    break
+                time.sleep(0.2)
+            check(
+                bool(downs),
+                f"downscale decision journaled under burn "
+                f"({[(d['actuator'], d['reason']) for d in downs][:3]})",
+            )
+            # the decision is a flight-recorder event ...
+            debug = session.get(f"{base}/debug/requests", timeout=10).json()
+            ap_rows = [
+                row for row in debug.get("requests", [])
+                if str(row.get("trace_id", "")).startswith("autopilot-")
+            ]
+            check(
+                bool(ap_rows),
+                f"decision recorded in the flight recorder "
+                f"({[r['trace_id'] for r in ap_rows][:2]})",
+            )
+            # ... and a gordo_autopilot_* series
+            text = session.get(
+                f"{base}/metrics?format=prometheus", timeout=10
+            ).text
+            check(
+                "gordo_autopilot_decisions_total" in text
+                and "gordo_autopilot_enabled" in text,
+                "gordo_autopilot_* series in the exposition",
+            )
+            # runtime kill switch: disable stops adaptation instantly
+            disabled = session.post(
+                f"{base}/autopilot/disable", timeout=10
+            ).json()
+            check(disabled.get("enabled") is False,
+                  "POST /autopilot/disable freezes the controller")
+            before = len(
+                session.get(f"{base}/autopilot", timeout=10)
+                .json()["decisions"]
+            )
+            for _ in range(8):
+                score()
+                session.get(f"{base}/autopilot", timeout=10)
+            after_body = session.get(f"{base}/autopilot", timeout=10).json()
+            check(
+                len(after_body["decisions"]) == before,
+                "no decision fires while frozen (kill switch honored)",
+            )
+            enabled = session.post(
+                f"{base}/autopilot/enable", timeout=10
+            ).json()
+            check(enabled.get("enabled") is True,
+                  "POST /autopilot/enable resumes")
+        finally:
+            faults.configure("")
+            server.shutdown()
+            thread.join(timeout=5)
+            session.close()
+    finally:
+        for key, old in saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
+# ---------------------------------------------------------------------------
+def _build_fleet(models_root, worker_env, log_dir, knobs, respawn=False):
+    from gordo_components_tpu.router import (
+        SubprocessWorker,
+        assemble_fleet,
+        server_worker_argv,
+        worker_specs,
+    )
+
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    try:
+        specs = [
+            spec._replace(port=_free_port())
+            for spec in worker_specs(2, _free_port())
+        ]
+
+        def factory(spec):
+            log = open(
+                os.path.join(log_dir, f"{spec.name}-{spec.port}.log"), "ab"
+            )
+            return SubprocessWorker(
+                spec,
+                server_worker_argv(spec, models_root, project="ap-smoke"),
+                env=dict(worker_env),
+                stdout=log, stderr=log,
+            )
+
+        router = assemble_fleet(
+            specs, factory, project="ap-smoke", models_root=models_root,
+            breaker_recovery=3.0, boot_grace=120.0, respawn=respawn,
+        )
+    finally:
+        for key, old in saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+    return router
+
+
+def elastic_retire_check(models_root: str, log_dir: str) -> None:
+    """[3/4] sustained idle retires a worker — drain-before-retire, zero
+    client-visible errors under live trickle traffic."""
+    import requests
+    from werkzeug.serving import make_server
+
+    print("\n[3/4] sustained idle -> drain-retire with zero dropped "
+          "requests (2 real worker processes)")
+    knobs = {
+        "GORDO_AUTOPILOT": "1",
+        "GORDO_AUTOPILOT_INTERVAL": "0",
+        "GORDO_AUTOPILOT_COOLDOWN": "0.5",
+        "GORDO_AUTOPILOT_SCALE_TICKS": "2",
+        "GORDO_AUTOPILOT_IDLE_RPS": "100000",
+        "GORDO_AUTOPILOT_WORKER_BOUNDS": "1:3",
+        "GORDO_SLO_LATENCY_MS": "30000",
+        "GORDO_SLO_EVAL_INTERVAL": "0",
+    }
+    worker_env = {
+        "JAX_PLATFORMS": "cpu",
+        "GORDO_DRAIN_TIMEOUT": "10",
+        "GORDO_AUTOPILOT": "0",  # workers: hard off — this phase tests
+        # the ROUTER's elastic actuator in isolation
+    }
+    router = _build_fleet(models_root, worker_env, log_dir, knobs)
+    supervisor = router.supervisor
+    print("  spawning 2 worker processes ...", file=sys.stderr)
+    supervisor.start_all()
+    ready = supervisor.wait_ready(timeout=300)
+    check(len(ready) == 2, f"both workers ready ({ready})")
+    front = make_server("127.0.0.1", 0, router, threaded=True)
+    front_thread = threading.Thread(target=front.serve_forever, daemon=True)
+    front_thread.start()
+    base = f"http://127.0.0.1:{front.server_port}"
+    session = requests.Session()
+    payload = json.dumps({"X": [[0.1, 0.2, 0.3]] * 3})
+    headers = {"Content-Type": "application/json"}
+    results = {"ok": 0, "bad": []}
+    stop = threading.Event()
+
+    def trickle():
+        with requests.Session() as s:
+            i = 0
+            while not stop.is_set():
+                machine = MACHINES[i % len(MACHINES)]
+                i += 1
+                try:
+                    response = s.post(
+                        f"{base}/gordo/v0/ap-smoke/{machine}/prediction",
+                        data=payload, headers=headers, timeout=60,
+                    )
+                    if response.status_code == 200:
+                        results["ok"] += 1
+                    else:
+                        results["bad"].append(response.status_code)
+                except Exception as exc:
+                    results["bad"].append(repr(exc))
+                time.sleep(0.05)
+
+    try:
+        # warm both workers before the controller starts watching
+        for machine in MACHINES:
+            response = session.post(
+                f"{base}/gordo/v0/ap-smoke/{machine}/prediction",
+                data=payload, headers=headers, timeout=120,
+            )
+            check(response.status_code == 200,
+                  f"warm scoring 200 for {machine}")
+        trickler = threading.Thread(target=trickle, daemon=True)
+        trickler.start()
+        retired = False
+        for _ in range(60):
+            status = session.get(f"{base}/autopilot", timeout=10).json()
+            if any(
+                d["actuator"] == "workers" and d["direction"] == "down"
+                for d in status.get("decisions", [])
+            ):
+                retired = True
+                break
+            time.sleep(0.3)
+        check(retired, "sustained-idle retire decision fired")
+        check(
+            router.autopilot.elastic.join(timeout=60),
+            "drain-retire op completed",
+        )
+        # keep traffic flowing PAST the retire to catch dropped requests
+        time.sleep(1.5)
+        stop.set()
+        trickler.join(timeout=10)
+        check(
+            len(supervisor.specs) == 1,
+            f"worker count 2 -> 1 ({sorted(supervisor.specs)})",
+        )
+        check(
+            len(router.placement.workers()) == 1,
+            f"ring shrank with the slot table "
+            f"({router.placement.workers()})",
+        )
+        check(
+            not results["bad"] and results["ok"] > 10,
+            f"ZERO client-visible errors through the retire "
+            f"({results['ok']} ok, bad: {results['bad'][:5]})",
+        )
+        # floor: no further retire below the bound
+        count = len(supervisor.specs)
+        for _ in range(8):
+            session.get(f"{base}/autopilot", timeout=10)
+            time.sleep(0.1)
+        router.autopilot.elastic.join(timeout=30)
+        check(
+            len(supervisor.specs) == count == 1,
+            "worker floor holds (never retires the last worker)",
+        )
+    finally:
+        stop.set()
+        front.shutdown()
+        front_thread.join(timeout=5)
+        router.control.stop()
+        supervisor.stop_all(grace=10)
+        router.close()
+        session.close()
+
+
+def elastic_spawn_check(models_root: str, log_dir: str) -> None:
+    """[4/4] sustained burn spawns a worker; CLI status parity."""
+    import requests
+    from werkzeug.serving import make_server
+
+    print("\n[4/4] sustained burn -> elastic spawn (faulted workers) "
+          "+ CLI parity")
+    knobs = {
+        "GORDO_AUTOPILOT": "1",
+        "GORDO_AUTOPILOT_INTERVAL": "0",
+        "GORDO_AUTOPILOT_COOLDOWN": "0.5",
+        "GORDO_AUTOPILOT_SCALE_TICKS": "2",
+        "GORDO_AUTOPILOT_IDLE_RPS": "0",
+        "GORDO_AUTOPILOT_WORKER_BOUNDS": "1:3",
+        "GORDO_SLO_LATENCY_MS": "150",
+        "GORDO_SLO_FAST_WINDOW": "30",
+        "GORDO_SLO_EVAL_INTERVAL": "0",
+    }
+    worker_env = {
+        "JAX_PLATFORMS": "cpu",
+        "GORDO_DRAIN_TIMEOUT": "10",
+        "GORDO_AUTOPILOT": "0",
+        # every scoring dispatch pays 400 ms: the route-latency
+        # objective burns, and burn sustained over SCALE_TICKS ticks is
+        # the spawn trigger
+        "GORDO_FAULTS": "engine-dispatch:*:latency:0.4",
+    }
+    router = _build_fleet(models_root, worker_env, log_dir, knobs)
+    supervisor = router.supervisor
+    print("  spawning 2 worker processes ...", file=sys.stderr)
+    supervisor.start_all()
+    ready = supervisor.wait_ready(timeout=300)
+    check(len(ready) == 2, f"both workers ready ({ready})")
+    front = make_server("127.0.0.1", 0, router, threaded=True)
+    front_thread = threading.Thread(target=front.serve_forever, daemon=True)
+    front_thread.start()
+    base = f"http://127.0.0.1:{front.server_port}"
+    session = requests.Session()
+    payload = json.dumps({"X": [[0.1, 0.2, 0.3]] * 3})
+    headers = {"Content-Type": "application/json"}
+    try:
+        spawned = False
+        for _ in range(40):
+            for machine in MACHINES:
+                session.post(
+                    f"{base}/gordo/v0/ap-smoke/{machine}/prediction",
+                    data=payload, headers=headers, timeout=120,
+                )
+            status = session.get(f"{base}/autopilot", timeout=10).json()
+            if any(
+                d["actuator"] == "workers" and d["direction"] == "up"
+                for d in status.get("decisions", [])
+            ):
+                spawned = True
+                break
+            time.sleep(0.3)
+        check(spawned, "sustained-burn spawn decision fired")
+        check(
+            router.autopilot.elastic.join(timeout=300),
+            "spawn op completed (worker booted + ready-gated ring join)",
+        )
+        check(
+            len(supervisor.specs) == 3
+            and "worker-2" in supervisor.specs,
+            f"worker-2 spawned into a fresh slot "
+            f"({sorted(supervisor.specs)})",
+        )
+        check(
+            "worker-2" in router.placement.workers(),
+            f"new worker joined the ring ({router.placement.workers()})",
+        )
+        # the new worker actually serves: it answers its own healthz
+        spec = supervisor.specs["worker-2"]
+        health = session.get(f"{spec.base_url}/healthz", timeout=10)
+        check(health.status_code == 200,
+              "spawned worker answers /healthz 200")
+
+        # CLI parity: gordo autopilot status dumps the same journal
+        from click.testing import CliRunner
+
+        from gordo_components_tpu.cli import gordo
+
+        try:
+            runner = CliRunner(mix_stderr=False)  # click < 8.2
+        except TypeError:
+            runner = CliRunner()
+        result = runner.invoke(
+            gordo, ["autopilot", "status", "--base-url", base]
+        )
+        check(result.exit_code == 0, "gordo autopilot status exits 0")
+        try:
+            dumped = json.loads(result.stdout)
+            live = session.get(f"{base}/autopilot", timeout=10).json()
+            check(
+                dumped.get("decisions") == live.get("decisions")
+                and dumped.get("role") == "router",
+                "CLI dump matches /autopilot (decision journal parity)",
+            )
+        except ValueError:
+            check(False, "gordo autopilot status output is valid JSON")
+    finally:
+        front.shutdown()
+        front_thread.join(timeout=5)
+        router.control.stop()
+        supervisor.stop_all(grace=10)
+        router.close()
+        session.close()
+
+
+def main() -> int:
+    import logging
+    import tempfile
+
+    logging.getLogger("werkzeug").setLevel(logging.WARNING)
+
+    convergence_check()
+    with tempfile.TemporaryDirectory() as tmp:
+        burn_downscale_check(tmp)
+        models_root = os.path.join(tmp, "models")
+        os.makedirs(models_root)
+        log_dir = os.path.join(tmp, "logs")
+        os.makedirs(log_dir)
+        from gordo_components_tpu.builder import provide_saved_model
+
+        print("\nbuilding 2 throwaway machines for the elastic phases ...",
+              file=sys.stderr)
+        for name in MACHINES:
+            provide_saved_model(
+                name, MODEL_CONFIG, DATA_CONFIG,
+                os.path.join(models_root, name),
+                evaluation_config={"cv_mode": "build_only"},
+            )
+        elastic_retire_check(models_root, log_dir)
+        elastic_spawn_check(models_root, log_dir)
+
+    if _failures:
+        print(f"\nAUTOPILOT SMOKE FAILED: {len(_failures)} check(s)",
+              file=sys.stderr)
+        return 1
+    print("\nautopilot smoke passed: bounded convergence without "
+          "oscillation, burn-driven downscale journaled three ways, and "
+          "an elastic tier that retires on idle (zero drops) and spawns "
+          "on sustained burn")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
