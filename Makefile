@@ -3,12 +3,17 @@
 
 .PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke smoke images builder-image server-image watchman-image
 
-# invariant linter (docs/ARCHITECTURE.md §17): lock discipline against
-# the declared hierarchy, blocking-calls-under-hot-locks, unbound
-# span seams, gordo_* metric conventions, GORDO_* knob registry +
-# generated README table sync. Pure stdlib — runs in seconds, no jax.
-# The gate is "no NEW violations" (lint_baseline.json grandfathers the
-# deliberate keeps, each with a reason).
+# invariant linter (docs/ARCHITECTURE.md §17/§21): lock discipline
+# against the declared hierarchy, blocking-calls-under-hot-locks,
+# guarded-state ownership (GUARDED_FIELDS only under their lock),
+# wire contracts (routes / X-Gordo-* headers / smoke-asserted series
+# cross-referenced producer↔consumer), fault-seam coverage, exception
+# hygiene (counterless broad swallows), unbound span seams, gordo_*
+# metric conventions, GORDO_* knob registry + generated README table
+# sync. Pure stdlib — runs in seconds, no jax (--jobs N parallelizes,
+# --format json for CI). The gate is "no NEW violations"
+# (lint_baseline.json grandfathers the deliberate keeps, each with a
+# reason — empty reasons expire).
 lint:
 	python -m gordo_components_tpu.analysis
 

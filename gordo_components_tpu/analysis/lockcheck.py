@@ -157,6 +157,44 @@ def named_condition(name: str):
     return threading.Condition(TrackedLock(name))
 
 
+# -- guarded-state runtime twin ----------------------------------------------
+
+
+def assert_guard(name: str) -> None:
+    """Runtime half of the guarded-state contract (GUARDED_FIELDS in
+    :mod:`.locks`): mutation sites call this with the OWNING lock's name
+    and, under ``GORDO_LOCKCHECK=1``, a violation is recorded when the
+    calling thread does not hold it. The static checker
+    (:mod:`.guarded_state`) proves the lexical shape; this witnesses
+    the dynamic one — including every ``allow-unguarded`` escape and
+    one-level blessing the static pass took on faith. With the knob off
+    it is a single early return, cheap enough for dispatch-path
+    mutation sites."""
+    if not enabled:
+        return
+    if name not in LOCK_RANKS:
+        raise ValueError(
+            f"guard {name!r} is not declared in analysis/locks.py — "
+            "add it to LOCK_RANKS (and ARCHITECTURE §21)"
+        )
+    if name not in _stack():
+        import traceback
+
+        # extract_stack(limit=2) keeps the two INNERMOST frames,
+        # oldest-first: [0] is the mutation site that called
+        # assert_guard, [1] is this frame
+        site = traceback.extract_stack(limit=2)[0]
+        message = (
+            f"guarded-state violation on thread "
+            f"{threading.current_thread().name!r}: mutation at "
+            f"{site.filename}:{site.lineno} ({site.name}) without "
+            f"holding its declared guard {name!r} "
+            f"(held: {_stack() or 'nothing'})"
+        )
+        with _state_lock:
+            _violations.append(message)
+
+
 # -- reporting ---------------------------------------------------------------
 
 

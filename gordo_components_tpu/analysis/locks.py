@@ -108,6 +108,48 @@ LOCK_ATTRS: Dict[Tuple[str, str], str] = {
 }
 
 
+# (file suffix, attribute/global name) -> lock name: the GUARDED-STATE
+# declaration. Every mutable field listed here is OWNED by one declared
+# lock — any read or write outside a ``with <its lock>:`` scope is a
+# static finding (:mod:`.guarded_state`) and, under ``GORDO_LOCKCHECK=1``,
+# a runtime violation at mutation (:func:`.lockcheck.assert_guard`).
+# Keyed like LOCK_ATTRS: the attribute names collide across files
+# (``_hot`` is an engine cache AND a placement set), the file suffix
+# disambiguates. ``__init__``/``__new__`` are exempt (construction
+# happens-before publication); deliberate lock-free reads carry
+# ``# lint: allow-unguarded(<reason>)`` — the reason is mandatory.
+GUARDED_FIELDS: Dict[Tuple[str, str], str] = {
+    # engine bucket state: the shard hot cache and the megabatch
+    # residency slot table (§12/§15)
+    ("server/engine.py", "_hot"): "engine.hot",
+    ("server/engine.py", "_mega_slots"): "engine.mega",
+    # server in-flight tracking: the drain/quiesce latch (§16)
+    ("server/server.py", "_inflight"): "server.state_cond",
+    # admission counters: occupancy, queue depth, closed marker (§10)
+    ("resilience/admission.py", "_inflight"): "server.admission",
+    ("resilience/admission.py", "_waiting"): "server.admission",
+    ("resilience/admission.py", "_closed"): "server.admission",
+    # fault-injection plan (module global, not an attribute)
+    ("resilience/faults.py", "_rules"): "resilience.faults",
+    # router: cached fleet model list + placement ring/rate state +
+    # supervisor slot table (§16)
+    ("router/router.py", "_models_cache"): "router.models",
+    ("router/placement.py", "ring"): "router.placement",
+    ("router/placement.py", "_rates"): "router.placement",
+    ("router/placement.py", "_rotation"): "router.placement",
+    ("router/placement.py", "_hot"): "router.placement",
+    ("router/workers.py", "_workers"): "router.workers",
+    ("router/workers.py", "_respawns"): "router.workers",
+    # SLO burn-rate history + breach edge state (§18)
+    ("observability/slo.py", "_history"): "observability.slo",
+    ("observability/slo.py", "_breached"): "observability.slo",
+    ("observability/slo.py", "_breach_counts"): "observability.slo",
+    # autopilot actuator state + decision journal (§20)
+    ("autopilot/controller.py", "_state"): "autopilot.state",
+    ("autopilot/controller.py", "_decisions"): "autopilot.state",
+}
+
+
 def rank_of(name: str) -> int:
     return LOCK_RANKS[name]
 

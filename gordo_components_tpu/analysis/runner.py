@@ -4,29 +4,44 @@ the baseline, print ``file:line severity checker message`` findings.
 Pure stdlib and import-light on purpose — the gate must run in seconds,
 before any jax import could slow it down. Exit status: 0 = clean (no
 non-baselined findings), 1 = findings, 2 = usage error.
+
+Two-phase shape so the scan parallelizes: per-file checkers run in a
+:func:`_scan_one` worker (``--jobs N`` fans files over processes; the
+default ``--jobs 1`` stays in-process and deterministic), returning
+findings + the cross-file EVIDENCE (knob mentions, wire-contract
+producer/consumer sites, fault-seam references). The aggregate half —
+wire finalize, fault finalize, stale knobs, README knob table — joins
+the evidence single-threaded. Per-checker wall time is accumulated
+either way and reported in the summary line (``--format json`` for CI).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from . import (
+    exception_hygiene,
+    fault_coverage,
+    guarded_state,
     knob_registry,
     knobs,
     lock_discipline,
     metrics_conventions,
     span_seam,
+    wire_contracts,
 )
-from .astscan import Module, parse_module
+from .astscan import parse_module
 from .findings import Baseline, Finding
 
 # checker -> repo-relative path prefixes it runs over
 SCOPES: Dict[str, Tuple[str, ...]] = {
     "lock-discipline": ("gordo_components_tpu/",),
+    "guarded-state": ("gordo_components_tpu/",),
     "span-seam": (
         "gordo_components_tpu/server/",
         "gordo_components_tpu/client/",
@@ -40,6 +55,11 @@ SCOPES: Dict[str, Tuple[str, ...]] = {
         "gordo_components_tpu/", "tools/", "tests/", "bench.py",
         "bench_serving.py",
     ),
+    # tests legitimately swallow in teardown helpers; the hygiene rule
+    # covers the shipped tree
+    "exception-hygiene": ("gordo_components_tpu/", "tools/"),
+    "wire-contracts": ("gordo_components_tpu/", "tools/"),
+    "fault-coverage": ("gordo_components_tpu/", "tools/", "tests/"),
 }
 
 KNOB_TABLE_BEGIN = "<!-- knob-table:begin (generated: make lint) -->"
@@ -148,36 +168,138 @@ def write_knob_table(root: str) -> bool:
     return True
 
 
-def run_lint(root: Optional[str] = None) -> List[Finding]:
-    root = root or repo_root()
-    findings: List[Finding] = []
-    mentions: Set[str] = set()
-    for path in _iter_files(root):
-        relpath = os.path.relpath(path, root).replace(os.sep, "/")
-        module = parse_module(path, relpath)
-        if module is None:
-            findings.append(
-                Finding(
-                    checker="lint", code="unparseable", file=relpath,
-                    line=1, key=relpath,
-                    message="file does not parse; checkers skipped it",
-                )
+# -- per-file scan (the parallelizable half) ----------------------------------
+
+# (checker name, check callable) for the simple per-file checkers
+_PER_FILE = (
+    ("lock-discipline", lock_discipline.check),
+    ("guarded-state", guarded_state.check),
+    ("span-seam", span_seam.check),
+    ("metrics-conventions", metrics_conventions.check),
+    ("exception-hygiene", exception_hygiene.check),
+)
+
+
+def _scan_one(job: Tuple[str, str]) -> Dict[str, Any]:
+    """Worker: parse one file, run every in-scope per-file checker, and
+    collect the cross-file evidence. Returns only picklable data so
+    ``--jobs N`` can fan it across processes."""
+    path, relpath = job
+    result: Dict[str, Any] = {
+        "findings": [], "knob_mentions": set(), "wire": None,
+        "fault": None, "timings": {},
+    }
+    module = parse_module(path, relpath)
+    if module is None:
+        result["findings"].append(
+            Finding(
+                checker="lint", code="unparseable", file=relpath,
+                line=1, key=relpath,
+                message="file does not parse; checkers skipped it",
             )
-            continue
-        if _in_scope(relpath, "lock-discipline"):
-            findings.extend(lock_discipline.check(module))
-        if _in_scope(relpath, "span-seam"):
-            findings.extend(span_seam.check(module))
-        if _in_scope(relpath, "metrics-conventions"):
-            findings.extend(metrics_conventions.check(module))
-        if _in_scope(relpath, "knob-registry") and (
-            relpath != "gordo_components_tpu/analysis/knobs.py"
-        ):
-            # knobs.py itself is the registry: its literals would make
-            # every registered knob count as "mentioned" (circular
-            # staleness) and can never be unregistered
-            findings.extend(knob_registry.check(module))
-            mentions |= knob_registry.collect_mentions(module)
+        )
+        return result
+    timings: Dict[str, float] = result["timings"]
+    for checker, check in _PER_FILE:
+        if _in_scope(relpath, checker):
+            started = time.perf_counter()
+            result["findings"].extend(check(module))
+            timings[checker] = (
+                timings.get(checker, 0.0) + time.perf_counter() - started
+            )
+    if _in_scope(relpath, "knob-registry") and (
+        relpath != "gordo_components_tpu/analysis/knobs.py"
+    ):
+        # knobs.py itself is the registry: its literals would make
+        # every registered knob count as "mentioned" (circular
+        # staleness) and can never be unregistered
+        started = time.perf_counter()
+        result["findings"].extend(knob_registry.check(module))
+        result["knob_mentions"] = knob_registry.collect_mentions(module)
+        timings["knob-registry"] = (
+            timings.get("knob-registry", 0.0)
+            + time.perf_counter() - started
+        )
+    if _in_scope(relpath, "wire-contracts") and not relpath.startswith(
+        "gordo_components_tpu/analysis/"
+    ):
+        # the registry module's own docstrings/specs are not evidence
+        started = time.perf_counter()
+        wire_findings, wire_evidence = wire_contracts.scan(module)
+        result["findings"].extend(wire_findings)
+        result["wire"] = wire_evidence
+        timings["wire-contracts"] = (
+            timings.get("wire-contracts", 0.0)
+            + time.perf_counter() - started
+        )
+    if _in_scope(relpath, "fault-coverage") and not relpath.startswith(
+        "gordo_components_tpu/analysis/"
+    ):
+        started = time.perf_counter()
+        result["fault"] = fault_coverage.scan(module)
+        timings["fault-coverage"] = (
+            timings.get("fault-coverage", 0.0)
+            + time.perf_counter() - started
+        )
+    return result
+
+
+def run_lint(
+    root: Optional[str] = None,
+    jobs: int = 1,
+    timings: Optional[Dict[str, float]] = None,
+) -> List[Finding]:
+    root = root or repo_root()
+    if timings is None:
+        timings = {}
+    job_list = [
+        (path, os.path.relpath(path, root).replace(os.sep, "/"))
+        for path in _iter_files(root)
+    ]
+    if jobs == 0:
+        jobs = os.cpu_count() or 1
+    if jobs > 1:
+        import multiprocessing
+        from concurrent.futures import ProcessPoolExecutor
+
+        # spawn, not fork: run_lint is also called in-process by the
+        # test suite, where jax has already spun up worker threads —
+        # forking a multithreaded process can deadlock in the child.
+        # The analysis package imports in ~0.3s, so spawn stays cheap.
+        with ProcessPoolExecutor(
+            max_workers=jobs,
+            mp_context=multiprocessing.get_context("spawn"),
+        ) as pool:
+            results = list(pool.map(_scan_one, job_list, chunksize=8))
+    else:
+        results = [_scan_one(job) for job in job_list]
+
+    findings: List[Finding] = []
+    mentions = set()
+    wire_evidence = []
+    fault_evidence = []
+    for result in results:
+        findings.extend(result["findings"])
+        mentions |= result["knob_mentions"]
+        if result["wire"] is not None:
+            wire_evidence.append(result["wire"])
+        if result["fault"] is not None:
+            fault_evidence.append(result["fault"])
+        for checker, spent in result["timings"].items():
+            timings[checker] = timings.get(checker, 0.0) + spent
+
+    started = time.perf_counter()
+    findings.extend(wire_contracts.finalize(wire_evidence))
+    timings["wire-contracts"] = (
+        timings.get("wire-contracts", 0.0) + time.perf_counter() - started
+    )
+    started = time.perf_counter()
+    findings.extend(fault_coverage.finalize(fault_evidence))
+    timings["fault-coverage"] = (
+        timings.get("fault-coverage", 0.0) + time.perf_counter() - started
+    )
+
+    started = time.perf_counter()
     # registered-but-unmentioned knobs. README PROSE counts as a
     # mention, but the generated knob-table block must NOT: it always
     # contains every registered knob (it is rendered FROM the
@@ -200,15 +322,29 @@ def run_lint(root: Optional[str] = None) -> List[Finding]:
         knob_registry.stale_knobs(set(mentions) | readme_mentions)
     )
     findings.extend(_check_knob_table(root))
+    timings["knob-registry"] = (
+        timings.get("knob-registry", 0.0) + time.perf_counter() - started
+    )
     return findings
+
+
+def _render_timings(timings: Dict[str, float]) -> str:
+    return ", ".join(
+        f"{checker} {spent:.2f}s"
+        for checker, spent in sorted(
+            timings.items(), key=lambda item: -item[1]
+        )
+    )
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="gordo lint",
         description=(
-            "Invariant linter: lock discipline, span seams, metric "
-            "conventions, knob registry (docs/ARCHITECTURE.md §17)."
+            "Invariant linter: lock discipline, guarded state, span "
+            "seams, wire contracts, fault-seam coverage, exception "
+            "hygiene, metric conventions, knob registry "
+            "(docs/ARCHITECTURE.md §17/§21)."
         ),
     )
     parser.add_argument("--root", default=None,
@@ -216,6 +352,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--baseline", default=None,
                         help="baseline path (default: <root>/lint_baseline"
                              ".json)")
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="parallel per-file scan processes "
+                             "(0 = one per CPU; default 1, in-process)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="output format (json: one object with "
+                             "findings/baselined/timings, CI-friendly)")
     parser.add_argument("--write-baseline", action="store_true",
                         help="grandfather every current finding into the "
                              "baseline (reasons start as TODO — fill them "
@@ -238,7 +381,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     started = time.perf_counter()
-    findings = run_lint(root)
+    timings: Dict[str, float] = {}
+    findings = run_lint(root, jobs=args.jobs, timings=timings)
     baseline_path = args.baseline or os.path.join(root, "lint_baseline.json")
     baseline = Baseline.load(baseline_path)
 
@@ -259,6 +403,37 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     fresh, suppressed = baseline.split(findings)
     fresh.sort(key=lambda f: (f.file, f.line, f.checker, f.code))
+    elapsed = time.perf_counter() - started
+
+    if args.format == "json":
+        def _as_dict(finding: Finding) -> Dict[str, Any]:
+            return {
+                "file": finding.file, "line": finding.line,
+                "severity": finding.severity, "checker": finding.checker,
+                "code": finding.code, "key": finding.key,
+                "message": finding.message, "hint": finding.hint,
+                "ident": finding.ident,
+            }
+
+        print(json.dumps(
+            {
+                "findings": [_as_dict(f) for f in fresh],
+                "baselined": [
+                    dict(_as_dict(f),
+                         reason=baseline.entries.get(f.ident, ""))
+                    for f in suppressed
+                ],
+                "timings": {
+                    checker: round(spent, 4)
+                    for checker, spent in sorted(timings.items())
+                },
+                "elapsed": round(elapsed, 4),
+                "clean": not fresh,
+            },
+            indent=2,
+        ))
+        return 1 if fresh else 0
+
     for finding in fresh:
         print(finding.render())
     if args.show_baselined and suppressed:
@@ -266,9 +441,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         for finding in suppressed:
             print(f"   {finding.render()}  "
                   f"[baseline: {baseline.entries.get(finding.ident, '')}]")
-    elapsed = time.perf_counter() - started
     print(
         f"lint: {len(fresh)} finding(s), {len(suppressed)} baselined, "
-        f"{elapsed:.2f}s"
+        f"{elapsed:.2f}s [{_render_timings(timings)}]"
     )
     return 1 if fresh else 0

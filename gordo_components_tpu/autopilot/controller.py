@@ -336,6 +336,7 @@ class Autopilot:
         }
         if extra:
             decision.update(extra)
+        lockcheck.assert_guard("autopilot.state")
         self._decisions.append(decision)
         _M_DECISIONS.labels(actuator, direction, reason).inc()
         logger.info(
@@ -373,7 +374,7 @@ class Autopilot:
                 state = self._state[name]
                 try:
                     value: Optional[int] = int(actuator.read())
-                except Exception:
+                except Exception:  # lint: allow-swallow(status-view actuator read; a dark actuator renders as null and real decisions have their own journal)
                     value = None
                 cooldown_left = 0.0
                 if state.last_applied_at is not None:

@@ -41,6 +41,15 @@ _DEVICE_STAGES = ("dispatch", "device_execute")
 _FETCH_STAGES = ("fetch", "data_fetch", "chunk_fetch")
 _FILL_STAGES = ("megabatch",)
 
+# a raising source reads neutral (the controller must keep ticking),
+# but the failure itself must not vanish: a persistently dark source
+# starves the policy layer, and this counter is how ops sees it
+_M_DARK = REGISTRY.counter(
+    "gordo_autopilot_dark_sources_total",
+    "Signal-source reads that raised and fell back to neutral values",
+    labels=("kind",),
+)
+
 
 @dataclass
 class Observation:
@@ -161,7 +170,7 @@ class SignalReader:
             try:
                 obs.extras.update(self.extras() or {})
             except Exception:
-                pass
+                _M_DARK.labels("extras").inc()
         return obs
 
     # -- sources (each guarded: a dark source yields neutral values) ---------
@@ -171,6 +180,7 @@ class SignalReader:
         try:
             snapshot = self.slo.burn_snapshot(now)
         except Exception:
+            _M_DARK.labels("burn").inc()
             return
         for row in snapshot.values():
             obs.burn_fast = max(obs.burn_fast, float(row.get("fast") or 0.0))
@@ -188,6 +198,7 @@ class SignalReader:
         try:
             rows = self.recorder.summaries(limit=self.sample)
         except Exception:
+            _M_DARK.labels("shares").inc()
             return
         totals = {"queue": 0.0, "device": 0.0, "fetch": 0.0, "fill": 0.0}
         sampled = 0
@@ -219,6 +230,7 @@ class SignalReader:
         try:
             stats = self.admission_stats()
         except Exception:
+            _M_DARK.labels("admission").inc()
             return
         max_inflight = max(1, int(stats.get("max_inflight") or 1))
         obs.inflight_frac = float(stats.get("inflight") or 0) / max_inflight
@@ -231,6 +243,7 @@ class SignalReader:
         try:
             stats = self.engine_stats()
         except Exception:
+            _M_DARK.labels("engine").inc()
             return
         mega = stats.get("megabatch") or {}
         obs.extras.update(
@@ -251,6 +264,7 @@ class SignalReader:
         try:
             count = float(self.request_count())
         except Exception:
+            _M_DARK.labels("rate").inc()
             return
         if self._last_count is not None and self._last_at is not None:
             dt = now - self._last_at

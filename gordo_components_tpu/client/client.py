@@ -222,7 +222,7 @@ class Client:
     def __del__(self):  # best-effort backstop; close() is the contract
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: allow-swallow(GC-time backstop: __del__ must never raise, and interpreter teardown makes logging unsafe)
             pass
 
     def _backoff_delay(self, attempt: int) -> float:

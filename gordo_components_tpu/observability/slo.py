@@ -293,6 +293,7 @@ class SLOEvaluator:
         now = self._clock() if now is None else now
         crossings: List[Dict[str, Any]] = []
         with self._lock:
+            lockcheck.assert_guard("observability.slo")
             self._last_tick = now
             self.ticks += 1
             for objective in self.objectives:

@@ -711,7 +711,7 @@ def compiled_flops(compiled) -> Optional[float]:
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0]
         return float(analysis["flops"])
-    except Exception:
+    except Exception:  # lint: allow-swallow(XLA cost introspection is optional; None is the documented unknown result)
         return None
 
 

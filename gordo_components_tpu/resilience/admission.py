@@ -134,6 +134,7 @@ class AdmissionController:
         waiters are woken so they shed now instead of burning their full
         queue timeout against a gate that can never admit them."""
         with self._cond:
+            lockcheck.assert_guard("server.admission")
             self._closed = reason
             self._cond.notify_all()
 
@@ -166,6 +167,7 @@ class AdmissionController:
                 _M_ADMISSION.labels("shed_closed").inc()
                 raise AdmissionRejected(self._closed, self.retry_after)
             if self._inflight < self.max_inflight:
+                lockcheck.assert_guard("server.admission")
                 self._inflight += 1
                 _M_INFLIGHT.set(self._inflight)
                 _M_ADMISSION.labels("admitted").inc()

@@ -121,6 +121,7 @@ def configure(spec: str) -> int:
     global _configured
     rules = parse_spec(spec)
     with _lock:
+        lockcheck.assert_guard("resilience.faults")
         _rules[:] = rules
         _configured = True
     if rules:

@@ -214,6 +214,7 @@ class Placement:
             return
         now = self._clock()
         with self._lock:
+            lockcheck.assert_guard("router.placement")
             window = self._rates.get(machine)
             if window is None:
                 window = self._rates[machine] = _RateWindow(

@@ -726,6 +726,7 @@ class FleetRouter:
                 continue
             if isinstance(models, list):
                 with self._models_lock:
+                    lockcheck.assert_guard("router.models")
                     self._models_cache = sorted(models)
                     return self._models_cache
         with self._models_lock:
@@ -741,7 +742,7 @@ class FleetRouter:
     def close(self) -> None:
         try:
             self._session.close()
-        except Exception:
+        except Exception:  # lint: allow-swallow(pooled-session teardown; the router is already shutting down)
             pass
 
 

@@ -190,6 +190,7 @@ class WorkerSupervisor:
     # -- lifecycle -----------------------------------------------------------
     def start_all(self) -> None:
         with self._lock:
+            lockcheck.assert_guard("router.workers")
             for name, spec in self.specs.items():
                 if name not in self._workers:
                     worker = self._factory(spec)
@@ -229,7 +230,7 @@ class WorkerSupervisor:
                 try:
                     if probe(spec):
                         ready.add(name)
-                except Exception:
+                except Exception:  # lint: allow-swallow(a failed ready-probe just means not ready yet; the poll loop retries until its deadline)
                     pass
             if len(ready) == len(wanted):
                 break
@@ -296,7 +297,7 @@ class WorkerSupervisor:
                 # both win a slot): ours never becomes visible — kill it
                 try:
                     worker.terminate(2.0)
-                except Exception:
+                except Exception:  # lint: allow-swallow(best-effort kill of the naming-race loser; the ValueError below is the loud signal)
                     pass
                 raise ValueError(f"worker {spec.name!r} already has a slot")
             self.specs = {**self.specs, spec.name: spec}
@@ -333,7 +334,7 @@ class WorkerSupervisor:
                 )
                 try:
                     worker.kill()
-                except Exception:
+                except Exception:  # lint: allow-swallow(SIGKILL backstop; the terminate failure above already warned with exc_info)
                     pass
         logger.info("Worker slot %s retired (elastic)", name)
         self._publish_alive()
@@ -361,7 +362,7 @@ class WorkerSupervisor:
                 )
                 try:
                     old.kill()
-                except Exception:
+                except Exception:  # lint: allow-swallow(SIGKILL backstop; the terminate failure above already warned with exc_info)
                     pass
         fresh = self._factory(spec)
         fresh.start()

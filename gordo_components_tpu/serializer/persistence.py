@@ -210,8 +210,8 @@ def load(source_dir: str, *, allow_external: bool = False) -> Any:
 def load_metadata(source_dir: str) -> Dict[str, Any]:
     try:
         source_dir = resolve_artifact_dir(source_dir)
-    except Exception:
-        return {}  # torn generation root: metadata is best-effort context
+    except Exception:  # lint: allow-swallow(torn generation root: metadata is best-effort context; verified load is the loud path)
+        return {}
     path = os.path.join(source_dir, METADATA_FILE)
     if not os.path.exists(path):
         return {}

@@ -1830,6 +1830,7 @@ class _Bucket:
         failed); its traffic falls back to the cold path and re-earns a
         slot under exponential backoff, mirroring hot-cache demotion."""
         with self._mega_lock:
+            lockcheck.assert_guard("engine.mega")
             slot = self._mega_slots.pop(idx, None)
             if slot is None:
                 return
@@ -1936,6 +1937,7 @@ class _Bucket:
                     dst[slot] = src
             new_stack = jax.device_put(self._mega_host_stack)
             with self._mega_lock:
+                lockcheck.assert_guard("engine.mega")
                 for idx, slot in pending:
                     self._mega_slots[idx] = slot
                     self._mega_last_use[idx] = self.dispatch_count
@@ -1998,6 +2000,7 @@ class _Bucket:
             return None
         cap = max(1, int(cap))
         with self._mega_lock:
+            lockcheck.assert_guard("engine.mega")
             if cap == self._mega_cap:
                 return cap
             self._mega_cap = cap
@@ -2033,6 +2036,7 @@ class _Bucket:
 
     def _demote(self, idx: int) -> None:
         with self._hot_lock:
+            lockcheck.assert_guard("engine.hot")
             self._hot.pop(idx, None)
             self._hot_last_use.pop(idx, None)
             self._hot_hits.pop(idx, None)
@@ -2131,6 +2135,7 @@ class _Bucket:
             # outside the hot lock, so leader routing never stalls on it
             tree = self._gather_machine(idx)
             with self._hot_lock:
+                lockcheck.assert_guard("engine.hot")
                 self._hot[idx] = tree
                 self._hot_last_use[idx] = self.dispatch_count
             _M_HOT_EVENTS.labels("promote").inc()
@@ -2619,7 +2624,7 @@ class ServingEngine:
             ),
             # shard-mode hot cache: machines currently holding an unsharded
             # device copy, and requests that skipped the sharded gather
-            "hot_machines": sum(len(b._hot) for b in self._buckets),
+            "hot_machines": sum(len(b._hot) for b in self._buckets),  # lint: allow-unguarded(point-in-time len() for stats; GIL-atomic read and staleness is fine in a gauge)
             "hot_requests": sum(
                 b.hot_request_count for b in self._buckets
             ),
@@ -2632,7 +2637,7 @@ class ServingEngine:
                 "fill_window_us": self.fill_window_us,
                 "residency_cap": self.megabatch_residency,
                 "resident_machines": sum(
-                    len(b._mega_slots) for b in self._buckets
+                    len(b._mega_slots) for b in self._buckets  # lint: allow-unguarded(point-in-time len() for stats; GIL-atomic read and staleness is fine in a gauge)
                 ),
                 "dispatches": mega_dispatches,
                 "requests": mega_requests,

@@ -329,10 +329,12 @@ class _ServerState:
 
     def enter(self) -> None:
         with self._cond:
+            lockcheck.assert_guard("server.state_cond")
             self._inflight += 1
 
     def exit(self) -> None:
         with self._cond:
+            lockcheck.assert_guard("server.state_cond")
             self._inflight -= 1
             if self._inflight == 0:
                 self._cond.notify_all()

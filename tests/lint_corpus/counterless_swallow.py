@@ -1,0 +1,65 @@
+"""Seeded-bad corpus: exception-hygiene violations. The pure swallows
+(broad catch, inert body) must be flagged; the logged handler, the
+counter-publishing handler, the narrow catch, the error-capturing
+``as exc`` body, and the reasoned escape must NOT. The reasonless
+escape is itself a finding."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def pure_swallow(op):
+    try:
+        op()
+    except Exception:
+        pass                      # BAD: counterless-swallow
+
+
+def bare_swallow(op):
+    try:
+        op()
+    except:                       # noqa: E722  BAD: counterless-swallow
+        pass
+
+
+def logged_handler(op):
+    try:
+        op()
+    except Exception:
+        logger.warning("op failed", exc_info=True)   # GOOD: logged
+
+
+def counted_handler(op, counter):
+    try:
+        op()
+    except Exception:
+        counter.labels("op").inc()                   # GOOD: counted
+
+
+def narrow_handler(op):
+    try:
+        op()
+    except ValueError:
+        pass                      # GOOD: narrow catch is a decision
+
+
+def captured_handler(op, item):
+    try:
+        op()
+    except Exception as exc:
+        item.error = exc          # GOOD: error propagated by value
+
+
+def escaped_handler(op):
+    try:
+        op()
+    except Exception:  # lint: allow-swallow(corpus: deliberate best-effort teardown)
+        pass
+
+
+def empty_escape(op):
+    try:
+        op()
+    except Exception:  # lint: allow-swallow()
+        pass

@@ -1,0 +1,39 @@
+"""Seeded-bad corpus for fault-seam coverage. One file, parsed three
+ways by tests/test_lint.py:
+
+- as ``gordo_components_tpu/resilience/faults.py`` — the POINTS tuple
+  below is the declaration;
+- as ``gordo_components_tpu/server/x.py`` — the inject()/corrupt()
+  calls are production wiring (incl. one point NOT in POINTS);
+- as ``tests/x.py`` — the spec string + direct call are coverage
+  references.
+
+Expected after finalize: ``ghost-seam`` is declared but uncovered AND
+unwired; ``typo-seam`` is wired but undeclared; ``engine-dispatch`` is
+covered from both the spec string and the direct call; ``prose-seam``
+is uncovered like ghost-seam even though a docstring below quotes a
+full spec string for it — prose is not coverage."""
+
+POINTS = (
+    "engine-dispatch",
+    "ghost-seam",
+    "prose-seam",
+)
+
+
+def production_boundary(faults, name, payload):
+    faults.inject("engine-dispatch", name)
+    # BAD when scanned as production code: not in POINTS, can never fire
+    faults.inject("typo-seam", name)
+    return faults.corrupt("engine-dispatch", name, payload)
+
+
+def chaos_test(faults):
+    faults.configure("engine-dispatch:mach-slow:latency:0.2")
+    faults.inject("engine-dispatch", "mach-slow")
+
+
+def documented_only_test(faults):
+    """Mentions prose-seam:mach-1:latency:0.1 in prose only; a spec
+    string quoted in a docstring must not count as chaos coverage."""
+    return faults

@@ -1,0 +1,54 @@
+"""Seeded-bad corpus: wire-contract violations. Scanned under the
+pretend path gordo_components_tpu/server/wire_bad.py (a wire-scope
+component). The checker must flag: the unregistered header literal,
+the unregistered served route, the call to a route nothing declares —
+and, after finalize() over JUST this module, the registered header
+that is read here but stamped nowhere plus the one stamped here but
+read nowhere. The conventional shapes must pass."""
+
+import requests
+from werkzeug.routing import Rule
+
+RULES = [
+    Rule("/healthz"),                  # GOOD: registered + serve evidence
+    Rule("/frobnicate"),               # BAD: unregistered-route
+]
+
+
+def orphan_consumer(request):
+    # BAD after finalize: X-Gordo-Deadline read with no stamp in the
+    # scanned set (the real tree stamps it client-side)
+    return request.headers.get("X-Gordo-Deadline")
+
+
+def orphan_producer():
+    # BAD after finalize: stamped but read nowhere in the scanned set
+    return [("X-Gordo-Worker", "w0")]
+
+
+def mystery_header(request):
+    # BAD: not declared in the registry at all
+    return request.headers.get("X-Gordo-Mystery-Knob")
+
+
+def good_roundtrip(request, response):
+    # GOOD: X-Gordo-Trace-Id both read and stamped in this module
+    trace_id = request.headers.get("X-Gordo-Trace-Id")
+    response.headers["X-Gordo-Trace-Id"] = trace_id
+    return response
+
+
+def calls(base_url):
+    requests.get(f"{base_url}/models")            # GOOD: declared route
+    requests.get(f"{base_url}/no/such/endpoint")  # BAD: unserved-route-call
+    requests.post(f"{base_url}/gordo/v0/proj/machine-7/anomaly/prediction")
+
+
+def not_http(env, payload, base_url):
+    # GOOD: none of these are routes — builtin open(), a dict/env .get()
+    # default, a .post() body argument
+    with open("/etc/ssl/cert.pem") as fh:
+        fh.read()
+    cache = env.get("GORDO_CACHE_DIR", "/var/cache/gordo")
+    requests.post(f"{base_url}/models", "/static/payload.bin")
+    return cache

@@ -1,0 +1,70 @@
+"""Seeded-bad corpus: guarded-state violations the guarded-state
+checker must catch. Scanned under the pretend path
+gordo_components_tpu/server/engine.py, so ``_hot`` resolves to the
+declared guard engine.hot and ``_mega_slots`` to engine.mega
+(analysis/locks.py GUARDED_FIELDS). The guarded counterexamples — the
+lexical ``with``, the transitively blessed helper chain, the reasoned
+escape, and ``__init__`` — must NOT be flagged."""
+
+import threading
+
+
+class BadBucket:
+    def __init__(self):
+        self._hot_lock = threading.Lock()
+        self._mega_lock = threading.Lock()
+        self._hot = {}           # __init__ stores are exempt
+        self._mega_slots = {}
+
+    def naked_promote(self, idx, tree):
+        self._hot[idx] = tree    # BAD: mutation without engine.hot
+
+    def naked_read(self, idx):
+        return self._mega_slots.get(idx)  # BAD: read without engine.mega
+
+    def guarded_promote(self, idx, tree):
+        with self._hot_lock:
+            self._hot[idx] = tree        # GOOD: lexical guard
+
+    def outer(self, idx):
+        with self._mega_lock:
+            return self._locked_helper(idx)
+
+    def _locked_helper(self, idx):
+        # GOOD: only ever called under the mega lock (blessed), and the
+        # blessing is transitive through the next hop
+        return self._locked_helper_two(idx)
+
+    def _locked_helper_two(self, idx):
+        return self._mega_slots.get(idx)
+
+    def stats_escape(self):
+        return len(self._hot)  # lint: allow-unguarded(point-in-time gauge read)
+
+    def empty_escape(self, idx):
+        # the reasonless escape is itself a finding
+        return self._hot.get(idx)  # lint: allow-unguarded()
+
+    def recursive_naked(self, idx, depth):
+        # BAD: a self-recursive call site must not bless its own scope
+        # (blessing is earned from a guarded entry point, never
+        # self-supported)
+        if depth:
+            self.recursive_naked(idx, depth - 1)
+        self._hot[idx] = depth   # BAD: mutation without engine.hot
+
+    def lambda_naked(self, keys):
+        # BAD: the read inside the lambda body runs with no lock held
+        return sorted(keys, key=lambda i: self._hot[i])
+
+    def lambda_guarded(self, keys):
+        with self._hot_lock:
+            # GOOD: defined AND invoked under the lexical guard
+            return sorted(keys, key=lambda i: self._hot[i])
+
+
+class OtherBucket:
+    def _locked_helper(self, idx):
+        # BAD: same NAME as BadBucket's blessed helper but a different
+        # class — blessing must not leak across classes
+        return self._mega_slots.get(idx)

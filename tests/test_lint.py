@@ -12,12 +12,16 @@ import threading
 import pytest
 
 from gordo_components_tpu.analysis import (
+    exception_hygiene,
+    fault_coverage,
+    guarded_state,
     knob_registry,
     knobs,
     lock_discipline,
     lockcheck,
     metrics_conventions,
     span_seam,
+    wire_contracts,
 )
 from gordo_components_tpu.analysis.astscan import parse_module
 from gordo_components_tpu.analysis.findings import Baseline, Finding
@@ -111,6 +115,127 @@ def test_corpus_unregistered_knob_caught():
     assert "GORDO_CORPUS_" + "MYSTERY_KNOB" in keys
     assert knobs.get("GORDO_DISPATCH_DEPTH") is not None
     assert not (keys & set(knobs.KNOBS))
+
+
+def test_corpus_unguarded_mutation_caught():
+    """ISSUE 13 tentpole: declared guarded fields flagged outside their
+    lock; lexical guards, transitive blessing, __init__, and reasoned
+    escapes pass; the reasonless escape is itself a finding."""
+    findings = guarded_state.check(_corpus("unguarded_mutation.py"))
+    by_code = {}
+    for finding in findings:
+        by_code.setdefault(finding.code, []).append(finding)
+    keys = {f.key for f in by_code.get("unguarded-access", [])}
+    assert "_hot:BadBucket.naked_promote" in keys, findings
+    assert "_mega_slots:BadBucket.naked_read" in keys, findings
+    # recursion must not self-bless; lambda bodies are not invisible
+    assert "_hot:BadBucket.recursive_naked" in keys, findings
+    assert "_hot:BadBucket.lambda_naked" in keys, findings
+    # blessing is class-scoped: OtherBucket's same-named helper is not
+    # covered by BadBucket's guarded call sites
+    assert "_mega_slots:OtherBucket._locked_helper" in keys, findings
+    # counterexamples: guarded, blessed through TWO hops, escaped, init,
+    # lambda under its lock
+    assert not any("guarded_promote" in key for key in keys)
+    assert not any("BadBucket._locked_helper" in key for key in keys)
+    assert not any("stats_escape" in key for key in keys)
+    assert not any("__init__" in key for key in keys)
+    assert not any("lambda_guarded" in key for key in keys)
+    assert any(
+        "empty_escape" in f.key for f in by_code.get("empty-escape-reason", [])
+    ), findings
+
+
+def test_corpus_orphan_wire_caught():
+    """ISSUE 13 tentpole: unregistered header/route literals, a call to
+    a route nothing serves, and — after finalize over just this module
+    — the orphan header producer and consumer."""
+    module = _corpus(
+        "orphan_wire.py", relpath="gordo_components_tpu/server/wire_bad.py"
+    )
+    scan_findings, evidence = wire_contracts.scan(module)
+    codes = {(f.code, f.key) for f in scan_findings}
+    assert ("unregistered-header", "X-Gordo-Mystery-Knob") in codes
+    assert ("unregistered-route", "/frobnicate") in codes
+    assert ("unserved-route-call", "/no/such/endpoint") in codes
+    # declared routes (incl. the machine-scoped anomaly path aligning
+    # through <project>/<machine> wildcards) are not call findings
+    assert not any(
+        code == "unserved-route-call" and key != "/no/such/endpoint"
+        for code, key in codes
+    ), scan_findings
+
+    final = wire_contracts.finalize([evidence])
+    final_codes = {(f.code, f.key) for f in final}
+    assert ("header-never-stamped", "X-Gordo-Deadline") in final_codes
+    assert ("header-never-read", "X-Gordo-Worker") in final_codes
+    # the round-tripped header is clean both ways
+    assert not any(
+        key == "X-Gordo-Trace-Id" for _, key in final_codes
+    ), final
+    # /healthz has serve evidence; the rest of the registry (scanned
+    # set = this one module) correctly reads as unserved
+    unserved = {
+        f.key for f in final if f.code == "route-not-served"
+    }
+    assert "/healthz" not in unserved
+    assert "/metrics" in unserved
+
+
+def test_corpus_fault_seams_caught():
+    """ISSUE 13 satellite: a declared injection point nothing exercises
+    (or wires) is a finding; a wired point not in POINTS is one too."""
+    declaration = _corpus(
+        "fault_seams.py",
+        relpath="gordo_components_tpu/resilience/faults.py",
+    )
+    production = _corpus(
+        "fault_seams.py", relpath="gordo_components_tpu/server/x.py"
+    )
+    exerciser = _corpus("fault_seams.py", relpath="tests/x.py")
+    findings = fault_coverage.finalize([
+        fault_coverage.scan(declaration),
+        fault_coverage.scan(production),
+        fault_coverage.scan(exerciser),
+    ])
+    codes = {(f.code, f.key) for f in findings}
+    assert ("uncovered-fault-seam", "ghost-seam") in codes
+    assert ("unwired-fault-point", "ghost-seam") in codes
+    assert ("undeclared-fault-point", "typo-seam") in codes
+    assert not any(key == "engine-dispatch" for _, key in codes), findings
+    # a spec string quoted in a docstring is prose, not coverage
+    assert ("uncovered-fault-seam", "prose-seam") in codes, findings
+
+
+def test_corpus_counterless_swallow_caught():
+    """ISSUE 13 satellite: inert broad catches flagged; logged/counted/
+    narrow/error-capturing handlers and reasoned escapes pass."""
+    findings = exception_hygiene.check(
+        _corpus("counterless_swallow.py", relpath="gordo_components_tpu/x.py")
+    )
+    by_code = {}
+    for finding in findings:
+        by_code.setdefault(finding.code, []).append(finding)
+    swallow_keys = {f.key for f in by_code.get("counterless-swallow", [])}
+    assert "pure_swallow:Exception" in swallow_keys, findings
+    assert "bare_swallow:bare" in swallow_keys, findings
+    for good in ("logged_handler", "counted_handler", "narrow_handler",
+                 "captured_handler", "escaped_handler"):
+        assert not any(good in key for key in swallow_keys), findings
+    assert by_code.get("empty-escape-reason"), findings
+
+
+def test_wire_fragment_matching():
+    templates = [r.path for r in wire_contracts.ROUTES]
+    match = wire_contracts._fragment_matches
+    assert match("/healthz", templates)
+    assert match("/anomaly/prediction", templates)        # suffix tail
+    assert match("/gordo/v0/chaos/", templates)           # prefix + <var>
+    assert match("/gordo/v0/p/m/healthz", templates)      # full structural
+    assert match("/debug/requests?limit=1", templates)    # query stripped
+    assert match("/autopilot/enable", templates)          # <action> wildcard
+    assert not match("/no/such/endpoint", templates)
+    assert not match("/healthz/extra/deep", templates)
 
 
 # -- baseline: suppress + expiry round-trip ----------------------------------
@@ -266,6 +391,41 @@ def test_lockcheck_allows_declared_order_and_condition_wait():
         lockcheck.reset()
 
 
+def test_lockcheck_assert_guard(monkeypatch):
+    """ISSUE 13 tentpole, runtime half: a guarded mutation without its
+    declared lock held is witnessed as a violation; under the lock it
+    is silent; undeclared guard names are rejected."""
+    monkeypatch.setattr(lockcheck, "enabled", True)
+    lockcheck.reset()
+    try:
+        guard = lockcheck.TrackedLock("engine.hot")
+        with guard:
+            lockcheck.assert_guard("engine.hot")
+        assert lockcheck.violations() == []
+        lockcheck.assert_guard("engine.hot")  # nothing held: violation
+        violations = lockcheck.violations()
+        assert len(violations) == 1
+        assert "engine.hot" in violations[0]
+        assert "guarded-state violation" in violations[0]
+        # the message must blame THIS function (the assert_guard call
+        # site), not a frame further up the stack
+        assert "test_lockcheck_assert_guard" in violations[0], violations[0]
+        with pytest.raises(ValueError, match="not declared"):
+            lockcheck.assert_guard("engine.no_such_guard")
+    finally:
+        lockcheck.reset()
+
+
+def test_assert_guard_noop_when_disabled(monkeypatch):
+    monkeypatch.setattr(lockcheck, "enabled", False)
+    lockcheck.reset()
+    try:
+        lockcheck.assert_guard("engine.hot")  # no lock held, no tracking
+        assert lockcheck.violations() == []
+    finally:
+        lockcheck.reset()
+
+
 def test_lockcheck_cycle_detection():
     cycle = lockcheck._find_cycle({("a", "b"), ("b", "c"), ("c", "a")})
     assert cycle is not None
@@ -307,9 +467,21 @@ def test_stale_knob_not_masked_by_generated_readme_table():
 
 
 def test_tree_is_lint_clean():
-    """The repo's own gate, as a test: zero non-baselined findings."""
+    """The repo's own gate, as a test: zero non-baselined findings —
+    and the ``--jobs`` parallel scan reaches the identical verdict
+    (ISSUE 13 satellite: the fan-out must not change the findings)."""
     root = repo_root()
-    findings = run_lint(root)
+    timings = {}
+    findings = run_lint(root, timings=timings)
     baseline = Baseline.load(os.path.join(root, "lint_baseline.json"))
     fresh, _ = baseline.split(findings)
     assert not fresh, "\n" + "\n".join(f.render() for f in fresh)
+    # every checker actually ran (and was timed)
+    for checker in ("lock-discipline", "guarded-state", "wire-contracts",
+                    "fault-coverage", "exception-hygiene", "span-seam",
+                    "metrics-conventions", "knob-registry"):
+        assert checker in timings, sorted(timings)
+    parallel = run_lint(root, jobs=2)
+    assert sorted(f.ident for f in parallel) == sorted(
+        f.ident for f in findings
+    )
