@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke smoke images builder-image server-image watchman-image
 
 # invariant linter (docs/ARCHITECTURE.md §17/§21): lock discipline
 # against the declared hierarchy, blocking-calls-under-hot-locks,
@@ -114,13 +114,27 @@ quant-smoke:
 autopilot-smoke:
 	JAX_PLATFORMS=cpu python tools/autopilot_smoke.py
 
+# fleet-scale hot-path check (§22): a 2k-machine synthetic fleet —
+# FLEET_INDEX lazy boot ≥5x faster than the full scan, the host-RAM
+# spill tier serving a demoted machine ≥3x faster than the store path,
+# placement candidate lookups in the microsecond regime at a 64-worker
+# ring (incremental join beats full rebuild), production-shaped load
+# through 2 lazy workers at zero failures / zero SLO breaches, and the
+# Prometheus exposition size-bounded (top-K + `other` machine labels)
+# at any fleet size. GORDO_CAPACITY_MACHINES/SECONDS resize; the 10k+
+# sweep lives in the bench `capacity` block and the `slow` test
+capacity-smoke:
+	JAX_PLATFORMS=cpu python tools/capacity_smoke.py
+
 # the full smoke battery: invariant lint + exposition + resilience +
 # store integrity + serving data plane + span attribution + cold-start
 # economics + cross-machine megabatching + the horizontal serving tier
 # + the fleet observability plane (stitching / aggregation / SLO)
 # + the precision ladder (parity budgets / dtype routing / warm boots)
 # + the closed-loop autopilot (convergence / journal / elastic tier)
-smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke
+# + the fleet-scale hot paths (index boot / spill tier / placement /
+#   bounded scrape)
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke
 
 images: builder-image server-image watchman-image
 

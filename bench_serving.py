@@ -46,9 +46,14 @@ precision-ladder f32/bf16/int8 A/B block; 0 skips it),
 BENCH_SERVE_WORKERS (2 — the N rung),
 BENCH_SERVE_MW_MACHINES (8) / BENCH_SERVE_MW_REQUESTS (40 per thread)
 — the multi-worker block's fleet and load sizes,
+BENCH_SERVE_MW_PASSES (3 — timed passes per rung, median reported),
 BENCH_SERVE_AUTOPILOT (1 — include the closed-loop autopilot A/B under
 the shifting ramp→spike→idle mix; 0 skips it) /
-BENCH_SERVE_AP_MACHINES (8 — that block's fleet size). The engine's own
+BENCH_SERVE_AP_MACHINES (8 — that block's fleet size),
+BENCH_SERVE_CAPACITY (1 — include the 10k-machine fleet-scale capacity
+block, §22: index boot, spill tier, incremental ring, bounded scrape;
+0 skips its ~5 minutes) / GORDO_CAPACITY_MACHINES (10000) /
+GORDO_CAPACITY_SECONDS (8). The engine's own
 GORDO_MEGABATCH / GORDO_FILL_WINDOW_US / GORDO_MEGABATCH_RESIDENCY knobs
 apply as in production (ARCHITECTURE §15).
 """
@@ -123,6 +128,11 @@ def effective_env() -> dict:
         # resolved by the engine itself, so the history row can never
         # record a default the engine doesn't actually use
         "slo": _slo_knob_summary(),
+        # fleet-scale hot-path knobs (§22): the spill tier's byte cap
+        # and the bounded machine-label cardinality that shaped the
+        # capacity block and the exposition sizes in this row
+        "host_cache_mb": int(os.environ.get("GORDO_HOST_CACHE_MB", "256")),
+        "metrics_machine_cardinality": _machine_cardinality_cap(),
     }
 
 
@@ -130,6 +140,14 @@ def _slo_knob_summary() -> dict:
     from gordo_components_tpu.observability import slo as slo_engine
 
     return slo_engine.knob_summary()
+
+
+def _machine_cardinality_cap() -> int:
+    from gordo_components_tpu.observability.registry import (
+        machine_cardinality_cap,
+    )
+
+    return machine_cardinality_cap()
 
 
 def begin_slo_watch():
@@ -966,9 +984,21 @@ def measure_multi_worker() -> dict:
     one worker precisely so fusion survives the split.
 
     Env: BENCH_SERVE_WORKERS (2) — the N rung; BENCH_SERVE_MW_MACHINES
-    (8); BENCH_SERVE_MW_REQUESTS (40) — requests per thread per rung.
+    (8); BENCH_SERVE_MW_REQUESTS (40) — requests per thread per pass;
+    BENCH_SERVE_MW_PASSES (3) — timed passes per rung, MEDIAN reported.
     Workers are real ``gordo run-server`` subprocesses sharing one
-    models tree + compile-cache store (the second rung boots warm)."""
+    models tree + compile-cache store (the second rung boots warm).
+
+    Noise note (ISSUE 14 satellite): BENCH_r06 recorded scaling_x 0.66
+    from a SINGLE timed pass per rung inside the full bench run.
+    Standalone reruns on the same 2-core rig measured 1.24x and 1.33x
+    (2 workers faster, as designed), with no memory pressure and
+    ok_fraction 1.0 in every rung — the 0.66 was one-shot scheduler
+    noise on a box where 12 client threads + router + workers share 2
+    cores, not router forward overhead and not a worker regression.
+    This block now reports the median of ``BENCH_SERVE_MW_PASSES``
+    timed passes (per-pass values in ``rps_passes``) so a single noisy
+    pass can no longer flip the headline."""
     import socket
     import tempfile
 
@@ -985,6 +1015,7 @@ def measure_multi_worker() -> dict:
     n_workers = int(os.environ.get("BENCH_SERVE_WORKERS", "2"))
     n_machines = int(os.environ.get("BENCH_SERVE_MW_MACHINES", "8"))
     per_thread = int(os.environ.get("BENCH_SERVE_MW_REQUESTS", "40"))
+    passes = max(1, int(os.environ.get("BENCH_SERVE_MW_PASSES", "3")))
     threads = 12
     rows = 24
 
@@ -1069,16 +1100,30 @@ def measure_multi_worker() -> dict:
                                 )
                     return lat
 
+                pass_rps: list = []
+                pass_lat: list = []
                 with ThreadPoolExecutor(max_workers=threads) as pool:
                     # settle pass: worker-side batch-shape compiles and
                     # connection setup stay out of the timed window
                     list(pool.map(one, range(threads)))
-                    started = time.perf_counter()
-                    lat_lists = list(pool.map(one, range(threads)))
-                elapsed = time.perf_counter() - started
-                lat_ms = np.asarray(
-                    [v for lat in lat_lists for v in lat]
-                ) * 1000.0
+                    # median of N timed passes: one pass per rung let a
+                    # single scheduler hiccup flip the scaling headline
+                    # on this 2-core rig (the BENCH_r06 0.66 reading —
+                    # see the docstring's noise note)
+                    for _ in range(passes):
+                        started = time.perf_counter()
+                        lat_lists = list(pool.map(one, range(threads)))
+                        elapsed = time.perf_counter() - started
+                        lat = np.asarray(
+                            [v for lat in lat_lists for v in lat]
+                        ) * 1000.0
+                        pass_rps.append(
+                            lat.size / elapsed if elapsed else 0.0
+                        )
+                        pass_lat.append(lat)
+                median_at = int(np.argsort(pass_rps)[len(pass_rps) // 2])
+                lat_ms = pass_lat[median_at]
+                median_rps = pass_rps[median_at]
                 per_worker: dict = {}
                 for spec in specs:
                     try:
@@ -1098,7 +1143,8 @@ def measure_multi_worker() -> dict:
                     "ok_fraction": round(
                         lat_ms.size / (threads * per_thread), 3
                     ),
-                    "rps": round(lat_ms.size / elapsed, 1),
+                    "rps": round(median_rps, 1),
+                    "rps_passes": [round(v, 1) for v in pass_rps],
                     "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
                     "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
                     "per_worker": per_worker,
@@ -1376,6 +1422,64 @@ def measure_cold_start(models, rows: int, tags: int) -> dict:
     return out
 
 
+def measure_capacity() -> dict:
+    """Fleet-scale capacity block (ISSUE 14 acceptance, ARCHITECTURE
+    §22): the whole capacity story at a 10k-machine synthetic fleet via
+    ``tools/capacity_harness.full_run`` — every §22 optimization with
+    its before/after number from the harness itself:
+
+    - boot: FLEET_INDEX lazy boot (after) vs full-scan boot (before);
+    - spill tier: serving a demoted machine from host RAM (after) vs
+      the store path (before), both bundle-seam and end-to-end;
+    - placement: incremental vnode-arc join (after) vs full ring
+      rebuild (before), plus candidates() p50/p99 at a 64-worker ring;
+    - traffic: heavy-tailed diurnal hot-key-skewed load plus a
+      flight-recorder-replay pass through 2 lazy workers behind the
+      real router, with SLO attainment and zero-failure accounting;
+    - metrics: exposition bytes + worst machine-label cardinality
+      (bounded top-K + `other` at any fleet size).
+
+    Env: GORDO_CAPACITY_MACHINES (10000 here; the 2k default belongs to
+    capacity_smoke), GORDO_CAPACITY_SECONDS (8) per traffic phase;
+    BENCH_SERVE_CAPACITY=0 skips the block — fleet generation plus the
+    full-scan boot comparison takes ~5 minutes at 10k machines."""
+    import shutil
+    import tempfile
+
+    from tools import capacity_harness as ch
+
+    machines = int(os.environ.get("GORDO_CAPACITY_MACHINES", "10000"))
+    seconds = float(os.environ.get("GORDO_CAPACITY_SECONDS", "8"))
+    root = tempfile.mkdtemp(prefix="gordo-bench-capacity-")
+    try:
+        report = ch.full_run(
+            root, machines, seconds, workers=2, threads=8
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    boot = report.get("boot", {})
+    spill = report.get("spill", {})
+    placement = report.get("placement", {})
+    report["headlines"] = {
+        # before/after, one line per §22 optimization
+        "boot_scan_vs_lazy_s": [boot.get("scan_s"), boot.get("lazy_s")],
+        "boot_speedup_x": boot.get("speedup_x"),
+        "spill_store_vs_hit_ms": [
+            spill.get("serve_store_ms_p50"), spill.get("serve_hit_ms_p50")
+        ],
+        "spill_speedup_x": spill.get("speedup_x"),
+        "ring_rebuild_vs_incremental_ms": [
+            placement.get("join_full_rebuild_ms"),
+            placement.get("join_incremental_ms"),
+        ],
+        "exposition_bytes": report.get("metrics", {}).get(
+            "exposition_bytes"
+        ),
+        "slo_breaches": report.get("slo", {}).get("breaches"),
+    }
+    return report
+
+
 def main() -> None:
     from gordo_components_tpu.utils.backend import (
         enable_persistent_compile_cache,
@@ -1406,6 +1510,12 @@ def main() -> None:
     # (ISSUE 12; BENCH_SERVE_AUTOPILOT=0 skips it)
     if os.environ.get("BENCH_SERVE_AUTOPILOT", "1") == "1":
         result["autopilot"] = measure_autopilot()
+    # fleet-scale capacity: the §22 before/after numbers (index boot,
+    # spill tier, incremental ring, bounded scrape) from a 10k-machine
+    # synthetic fleet through the real router tier (ISSUE 14;
+    # BENCH_SERVE_CAPACITY=0 skips — it takes ~5 minutes)
+    if os.environ.get("BENCH_SERVE_CAPACITY", "1") == "1":
+        result["capacity"] = measure_capacity()
     if degraded:
         result["degraded"] = (
             "accelerator tunnel down; measured on the CPU backend — "
@@ -1461,6 +1571,9 @@ def main() -> None:
             "slo": result.get("slo"),
             # closed-loop controller A/B on the shifting load mix (§20)
             "autopilot": result.get("autopilot"),
+            # fleet-scale capacity headlines: §22 before/after numbers
+            # (index boot, spill tier, incremental ring, bounded scrape)
+            "capacity": (result.get("capacity") or {}).get("headlines"),
         })
     except Exception:
         pass  # history is never worth failing an artifact over

@@ -53,6 +53,11 @@ KNOBS: Dict[str, Knob] = dict(
               "shard mode: machines keeping an unsharded hot device copy "
               "(skips the per-dispatch cross-device gather); 0 disables",
               "engine"),
+        _knob("GORDO_HOST_CACHE_MB", "256", "int",
+              "host-RAM spill tier (§22): megabytes of deserialized "
+              "pre-stacked host arrays cached between device residency "
+              "and the model store; `0` disables (every lazy request "
+              "pays the store path)", "engine"),
         # -- server admission / lifecycle --------------------------------
         _knob("GORDO_MAX_INFLIGHT", "64", "int",
               "admission gate: concurrent admitted requests "
@@ -71,6 +76,16 @@ KNOBS: Dict[str, Knob] = dict(
               "horizontal tier: this worker's slot id (stamped on "
               "responses as `X-Gordo-Worker`; set by the router "
               "supervisor)", "serving"),
+        _knob("GORDO_LAZY_BOOT", "0", "bool",
+              "lazy fleet boot (§22): boot from the `FLEET_INDEX.json` "
+              "sidecar — O(index read) instead of O(load the fleet); "
+              "non-eager machines serve through the host-RAM spill tier "
+              "with first-touch verification (`--lazy-boot` on "
+              "`run-server`)", "serving"),
+        _knob("GORDO_BOOT_EAGER", "0", "int",
+              "lazy fleet boot: machines (index order) materialized "
+              "eagerly at boot to warm the common architecture's "
+              "programs; the rest stay behind the spill tier", "serving"),
         # -- compile caches ----------------------------------------------
         _knob("GORDO_COMPILE_CACHE", "~/.cache/gordo-tpu/jax-compile",
               "path",
@@ -111,6 +126,12 @@ KNOBS: Dict[str, Knob] = dict(
               "trace stitching: size cap for the worker's "
               "`X-Gordo-Timeline` response header (past it the router "
               "pulls the timeline from the worker instead)",
+              "observability"),
+        _knob("GORDO_METRICS_MACHINE_CARDINALITY", "64", "int",
+              "machine-labeled metric families render at most this many "
+              "distinct machines per family (top-K by traffic) plus one "
+              "`machine=\"other\"` aggregate, so exposition size is "
+              "bounded at any fleet size; `0` disables the bound",
               "observability"),
         _knob("GORDO_ROUTER_AGGREGATE", "1", "bool",
               "router scrape-of-scrapes: `0` makes "
@@ -203,6 +224,10 @@ KNOBS: Dict[str, Knob] = dict(
         _knob("GORDO_MAX_ARTIFACT_BYTES", "2 GiB", "int",
               "bounded artifact loads: max decompressed tar bytes a "
               "model load will extract", "store"),
+        _knob("GORDO_STORE_FSYNC", "1", "bool",
+              "`0` disables commit-path fsyncs (durability escape hatch "
+              "for bulk synthetic-fleet generation — atomicity is kept, "
+              "power-cut durability is not)", "store"),
         # -- precision ladder (§19) --------------------------------------
         _knob("GORDO_PRECISION_DEFAULT", "f32", "str",
               "build-time default rung on the serving precision ladder "
@@ -245,6 +270,18 @@ KNOBS: Dict[str, Knob] = dict(
         _knob("GORDO_RESET_BENCH_ANCHOR", "0", "bool",
               "reseed the bench-regression anchor ring (after a rig "
               "change that legitimately moved the baseline)", "bench"),
+        _knob("GORDO_CAPACITY_MACHINES", "2000 (smoke) / 10000 (bench)",
+              "int",
+              "capacity harness (§22): synthetic-fleet size for "
+              "`tools/capacity_smoke.py` and the bench `capacity` block",
+              "bench"),
+        _knob("GORDO_CAPACITY_SECONDS", "8", "float",
+              "capacity harness: seconds of production-shaped load per "
+              "traffic phase", "bench"),
+        _knob("GORDO_CAPACITY_SWEEP_MACHINES", "100000", "int",
+              "capacity harness: fleet size for the `slow`-marked full "
+              "sweep (tests/test_capacity_slow.py) — scale down for a "
+              "faster manual run", "bench"),
         # -- test / validation harnesses ---------------------------------
         _knob("GORDO_LOCKCHECK", "0", "bool",
               "runtime lock-order validator: named locks record real "

@@ -52,6 +52,7 @@ LOCK_RANKS: Dict[str, int] = {
     "engine.collector": 75,     # _Bucket._collector_lock handover
     "engine.hot": 80,           # _Bucket._hot_lock shard hot cache
     "engine.mega": 82,          # _Bucket._mega_lock residency routing
+    "engine.host_cache": 84,    # host_cache.py LRU dict + byte ledger (§22)
     "engine.shard_dispatch": 90,  # process-global collective-launch lock
 }
 
@@ -72,6 +73,7 @@ HOT_LOCKS = frozenset(
         "engine.collector",
         "engine.hot",
         "engine.mega",
+        "engine.host_cache",
         "engine.shard_dispatch",
     }
 )
@@ -88,6 +90,7 @@ LOCK_ATTRS: Dict[Tuple[str, str], str] = {
     ("server/engine.py", "_hot_lock"): "engine.hot",
     ("server/engine.py", "_mega_lock"): "engine.mega",
     ("server/engine.py", "_collector_lock"): "engine.collector",
+    ("server/host_cache.py", "_lock"): "engine.host_cache",
     ("server/server.py", "_cond"): "server.state_cond",
     ("server/server.py", "_reload_lock"): "server.reload",
     ("resilience/admission.py", "_cond"): "server.admission",
@@ -123,6 +126,11 @@ GUARDED_FIELDS: Dict[Tuple[str, str], str] = {
     # residency slot table (§12/§15)
     ("server/engine.py", "_hot"): "engine.hot",
     ("server/engine.py", "_mega_slots"): "engine.mega",
+    # host-RAM spill tier: the LRU dict, its byte ledger, and the
+    # in-flight prefetch claims (§22)
+    ("server/host_cache.py", "_entries"): "engine.host_cache",
+    ("server/host_cache.py", "_bytes"): "engine.host_cache",
+    ("server/host_cache.py", "_inflight"): "engine.host_cache",
     # server in-flight tracking: the drain/quiesce latch (§16)
     ("server/server.py", "_inflight"): "server.state_cond",
     # admission counters: occupancy, queue depth, closed marker (§10)

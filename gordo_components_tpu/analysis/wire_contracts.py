@@ -126,6 +126,9 @@ ROUTES: Tuple[RouteSpec, ...] = (
     RouteSpec("/slo", ("server", "router"),
               "burn-rate objectives + per-stage attribution (§18)"),
     RouteSpec("/models", ("server", "router"), "served machine list"),
+    RouteSpec("/prefetch", ("server",),
+              "POST placement hint (§22): queue async host-cache loads "
+              "for lazy machines; advisory, never blocks"),
     RouteSpec("/reload", ("server", "router"),
               "adopt a new generation; router: canary→sweep rollout, "
               "busy answers 409 (§16)"),

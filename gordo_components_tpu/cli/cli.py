@@ -567,10 +567,17 @@ def cache_purge_cmd(store_dir, stale_only):
                    "a run-fleet-server tier: responses carry "
                    "X-Gordo-Worker and /healthz reports the id so the "
                    "router can verify placement")
+@click.option("--lazy-boot/--no-lazy-boot", default=None,
+              help="boot from the models tree's FLEET_INDEX.json sidecar "
+                   "— O(index read) instead of O(load the fleet); "
+                   "non-eager machines serve through the host-RAM spill "
+                   "tier (GORDO_HOST_CACHE_MB) with artifact verification "
+                   "on first touch. Requires --models-dir. Overrides "
+                   "GORDO_LAZY_BOOT")
 @_TRACE_DIR_OPT
 def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
                    max_inflight, faults, compile_cache_store, megabatch,
-                   fill_window_us, worker_id, trace_dir):
+                   fill_window_us, worker_id, lazy_boot, trace_dir):
     """Serve built model(s) over REST."""
     import os
 
@@ -583,6 +590,14 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
         os.environ["GORDO_MEGABATCH"] = "1" if megabatch else "0"
     if fill_window_us is not None:
         os.environ["GORDO_FILL_WINDOW_US"] = str(fill_window_us)
+    if lazy_boot is not None:
+        os.environ["GORDO_LAZY_BOOT"] = "1" if lazy_boot else "0"
+    if lazy_boot is None:
+        lazy_boot = os.environ.get(
+            "GORDO_LAZY_BOOT", "0"
+        ).strip().lower() in ("1", "true", "on", "yes")
+    if lazy_boot and not models_dir:
+        raise click.UsageError("--lazy-boot requires --models-dir")
 
     if faults is not None:
         from ..resilience import faults as faults_mod
@@ -600,17 +615,28 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
             model_dir.rstrip("/")
         )
         resolved[name] = model_dir
-    if models_dir:
+    if models_dir and not lazy_boot:
         from ..server.server import scan_models_root
 
         # same scan rule as POST /reload (definition.json gate) so startup
         # and reload can never disagree about what counts as a model dir
         for entry, path in scan_models_root(models_dir).items():
             resolved.setdefault(entry, path)
-    if not resolved:
+    if not resolved and not lazy_boot:
         raise click.UsageError(
             "Provide --model-dir (or MODEL_LOCATION) or --models-dir"
         )
+    if lazy_boot:
+        # §22: the FLEET_INDEX sidecar names the fleet — no eager scan
+        # here; explicit --model-dir machines stay eager, the server
+        # partitions the rest behind the host-RAM spill tier (and falls
+        # back to its own scan when the index is damaged or absent)
+        run_server(resolved, host=host, port=port, project=project,
+                   models_root=models_dir, shard_fleet=shard_fleet,
+                   trace_dir=trace_dir, max_inflight=max_inflight,
+                   compile_cache_store=compile_cache_store,
+                   worker_id=worker_id, lazy_boot=True)
+        return
     if len(resolved) == 1 and not models_dir:
         run_server(next(iter(resolved.values())), host=host, port=port,
                    project=project, shard_fleet=shard_fleet,

@@ -28,7 +28,7 @@ import math
 import re
 from typing import Any, Dict, List, Tuple
 
-from .registry import Histogram, Registry
+from .registry import Histogram, Registry, bound_machine_cardinality
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -111,7 +111,10 @@ def render_prometheus(registry: Registry, exemplars: bool = False) -> str:
             lines.append(f"# HELP {metric.name} {_escape_help(metric.help)}")
         lines.append(f"# TYPE {metric.name} {metric.kind}")
         if isinstance(metric, Histogram):
-            for values, data in sorted(metric.collect().items()):
+            # §22: machine-labeled families render top-K + "other", so
+            # exposition size is bounded at ANY fleet size
+            collected = bound_machine_cardinality(metric, metric.collect())
+            for values, data in sorted(collected.items()):
                 series_exemplars = data.get("exemplars") or {}
                 for i, (le, cumulative) in enumerate(data["buckets"]):
                     labels = _fmt_labels(
@@ -129,7 +132,8 @@ def render_prometheus(registry: Registry, exemplars: bool = False) -> str:
                 )
                 lines.append(f"{metric.name}_count{labels} {data['count']}")
         else:
-            for values, value in sorted(metric.collect().items()):
+            collected = bound_machine_cardinality(metric, metric.collect())
+            for values, value in sorted(collected.items()):
                 labels = _fmt_labels(metric.labelnames, values)
                 lines.append(f"{metric.name}{labels} {_fmt_value(value)}")
     return "\n".join(lines) + "\n"

@@ -25,11 +25,111 @@ write incompatible series under one name.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 INF = float("inf")
+
+# -- bounded machine cardinality (ARCHITECTURE §22) ---------------------------
+# The one label dimension that scales with FLEET SIZE, not with code: a
+# 100k-machine fleet must not be able to melt the scrape path (100k text
+# lines per family) or the §18 aggregator. Families labeled by machine
+# collapse at exposition/snapshot time to the top-K machines by traffic
+# plus ONE `machine="other"` aggregate; the in-memory series stay exact
+# (a future scoped query could still read them), only the rendered view
+# is bounded.
+MACHINE_LABEL = "machine"
+MACHINE_OTHER = "other"
+
+
+def machine_cardinality_cap() -> int:
+    """``GORDO_METRICS_MACHINE_CARDINALITY``: distinct machine label
+    values rendered per family before top-K + ``other`` collapse
+    (default 64; ``0`` disables the bound)."""
+    try:
+        return int(
+            os.environ.get("GORDO_METRICS_MACHINE_CARDINALITY", "64")
+        )
+    except ValueError:
+        return 64
+
+
+def _merge_histogram_data(into: Dict[str, Any], data: Dict[str, Any]) -> None:
+    """le-wise bucket merge (+sum/count) of two ``Histogram.collect``
+    series — bucket bounds agree by construction (same metric)."""
+    into["buckets"] = [
+        (le, acc + other_acc)
+        for (le, acc), (_, other_acc) in zip(into["buckets"], data["buckets"])
+    ]
+    into["sum"] += data["sum"]
+    into["count"] += data["count"]
+    into["samples"] = (into["samples"] + data["samples"])[-1000:]
+    for i, exemplar in (data.get("exemplars") or {}).items():
+        current = into["exemplars"].get(i)
+        if current is None or exemplar[2] >= current[2]:  # newest wins
+            into["exemplars"][i] = exemplar
+
+
+def bound_machine_cardinality(
+    metric: "_Metric", collected: Dict[Tuple[str, ...], Any]
+) -> Dict[Tuple[str, ...], Any]:
+    """Collapse ``collected`` (a ``metric.collect()`` mapping) so at most
+    top-K distinct machine label values survive; the rest aggregate into
+    ``machine="other"`` — counters SUM (total traffic is additive),
+    gauges take MAX (summing per-machine durations would fabricate a
+    value no machine ever reported; the worst straggler is the honest
+    scalar), histograms merge le-wise. Ranking is by counter/gauge value
+    or histogram count — "traffic", so the named survivors are the ones
+    an operator would ask about."""
+    if MACHINE_LABEL not in metric.labelnames:
+        return collected
+    cap = machine_cardinality_cap()
+    if cap <= 0:
+        return collected
+    idx = metric.labelnames.index(MACHINE_LABEL)
+    is_hist = isinstance(metric, Histogram)
+
+    def weight(data: Any) -> float:
+        return float(data["count"]) if is_hist else float(data)
+
+    totals: Dict[str, float] = {}
+    for key, data in collected.items():
+        totals[key[idx]] = totals.get(key[idx], 0.0) + weight(data)
+    if len(totals) <= cap:
+        return collected
+    keep = set(sorted(totals, key=lambda m: (-totals[m], m))[:cap])
+    # "other" is a RESERVED label value once collapse is in play: a real
+    # machine named "other" kept verbatim would collide with the
+    # synthetic aggregate (counter sums merging into its kept entry,
+    # histogram merges mutating its un-copied collect() data) — fold it
+    # into the aggregate instead, where its traffic is at least honest
+    keep.discard(MACHINE_OTHER)
+    out: Dict[Tuple[str, ...], Any] = {}
+    for key, data in collected.items():
+        if key[idx] in keep:
+            out[key] = data
+            continue
+        okey = key[:idx] + (MACHINE_OTHER,) + key[idx + 1:]
+        current = out.get(okey)
+        if current is None:
+            if is_hist:
+                data = {
+                    "buckets": list(data["buckets"]),
+                    "sum": data["sum"],
+                    "count": data["count"],
+                    "samples": list(data["samples"]),
+                    "exemplars": dict(data.get("exemplars") or {}),
+                }
+            out[okey] = data
+        elif is_hist:
+            _merge_histogram_data(current, data)
+        elif isinstance(metric, Counter):
+            out[okey] = current + data
+        else:
+            out[okey] = max(current, data)
+    return out
 
 
 _get_trace_id = None
@@ -165,6 +265,17 @@ class _BoundGauge:
         self._metric._inc(self._values, -amount)
 
 
+def _percentile(samples: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over the bounded sample window — THE one
+    rule (``Histogram.stats`` and the snapshot's collapsed series must
+    agree)."""
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    n = len(ordered)
+    return ordered[min(n - 1, int(round(q * (n - 1))))]
+
+
 class _HistSeries:
     __slots__ = ("bucket_counts", "sum", "count", "samples", "exemplars")
 
@@ -261,19 +372,11 @@ class Histogram(_Metric):
         out = {}
         for values, data in self.collect().items():
             samples = data["samples"]
-            if samples:
-                ordered = sorted(samples)
-                n = len(ordered)
-                p50 = ordered[min(n - 1, int(round(0.50 * (n - 1))))]
-                p99 = ordered[min(n - 1, int(round(0.99 * (n - 1))))]
-                mean = sum(samples) / n
-            else:
-                p50 = p99 = mean = 0.0
             out[values] = {
                 "count": data["count"],
-                "p50": p50,
-                "p99": p99,
-                "mean": mean,
+                "p50": _percentile(samples, 0.50),
+                "p99": _percentile(samples, 0.99),
+                "mean": sum(samples) / len(samples) if samples else 0.0,
             }
         return out
 
@@ -352,22 +455,28 @@ class Registry:
         out: Dict[str, Any] = {}
         for metric in self.metrics():
             if isinstance(metric, Histogram):
-                stats = metric.stats()
-                collected = metric.collect()
+                collected = bound_machine_cardinality(
+                    metric, metric.collect()
+                )
                 series = {
                     _label_key(metric.labelnames, values): {
-                        "count": s["count"],
-                        "sum": collected[values]["sum"],
-                        "mean": s["mean"],
-                        "p50": s["p50"],
-                        "p99": s["p99"],
+                        "count": data["count"],
+                        "sum": data["sum"],
+                        "mean": (
+                            sum(data["samples"]) / len(data["samples"])
+                            if data["samples"] else 0.0
+                        ),
+                        "p50": _percentile(data["samples"], 0.50),
+                        "p99": _percentile(data["samples"], 0.99),
                     }
-                    for values, s in stats.items()
+                    for values, data in collected.items()
                 }
             else:
                 series = {
                     _label_key(metric.labelnames, values): value
-                    for values, value in metric.collect().items()
+                    for values, value in bound_machine_cardinality(
+                        metric, metric.collect()
+                    ).items()
                 }
             out[metric.name] = {
                 "kind": metric.kind,

@@ -65,6 +65,10 @@ class HashRing:
         self._points: List[int] = []
         self._owners: List[str] = []
         self._workers: set = set()
+        # membership version: bumped on every add/remove so callers
+        # (Placement) can cache membership-derived views — a join/leave
+        # invalidates exactly once, lookups between them are cache hits
+        self.version = 0
         for worker in workers:
             self.add(worker)
 
@@ -72,18 +76,43 @@ class HashRing:
         return [_hash64(f"{worker}#{i}") for i in range(self.vnodes)]
 
     def add(self, worker: str) -> None:
+        """Incremental join (§22): ONE sorted merge of the worker's
+        ``vnodes`` points into the arrays — O(P + v), not the O(v·P) of
+        v independent ``list.insert`` memmoves. Only the joining
+        worker's arcs change ownership; incumbent points are untouched
+        (the bounded-movement property is structural)."""
         if worker in self._workers:
             return
         self._workers.add(worker)
-        for point in self._worker_points(worker):
-            at = bisect.bisect_left(self._points, point)
-            self._points.insert(at, point)
-            self._owners.insert(at, worker)
+        self.version += 1
+        incoming = sorted(self._worker_points(worker))
+        merged_points: List[int] = []
+        merged_owners: List[str] = []
+        i = j = 0
+        while i < len(self._points) and j < len(incoming):
+            if self._points[i] <= incoming[j]:
+                merged_points.append(self._points[i])
+                merged_owners.append(self._owners[i])
+                i += 1
+            else:
+                merged_points.append(incoming[j])
+                merged_owners.append(worker)
+                j += 1
+        merged_points.extend(self._points[i:])
+        merged_owners.extend(self._owners[i:])
+        merged_points.extend(incoming[j:])
+        merged_owners.extend([worker] * (len(incoming) - j))
+        self._points = merged_points
+        self._owners = merged_owners
 
     def remove(self, worker: str) -> None:
+        """Incremental leave: one filtering pass dropping ONLY the
+        departed worker's points — its arcs fall to their clockwise
+        successors, nothing moves between survivors."""
         if worker not in self._workers:
             return
         self._workers.discard(worker)
+        self.version += 1
         keep = [
             (point, owner)
             for point, owner in zip(self._points, self._owners)
@@ -192,6 +221,10 @@ class Placement:
         self._rates: Dict[str, _RateWindow] = {}
         self._hot: set = set(self._pinned_hot)
         self._rotation: Dict[str, int] = {}
+        # membership list cached per ring version (§22): the failover
+        # tail of candidates() reads this tuple instead of re-walking
+        # (and re-sorting) anything per request
+        self._order_cache = (-1, ())
 
     # -- membership ----------------------------------------------------------
     def add_worker(self, worker: str) -> None:
@@ -242,27 +275,60 @@ class Placement:
             return sorted(self._hot)
 
     # -- placement -----------------------------------------------------------
+    # distinct workers walked clockwise PAST the replica set — the warm
+    # failover candidates a routing sweep actually reaches in practice
+    _FAILOVER_PROBE = 2
+
+    def _membership_locked(self):
+        """Sorted worker tuple, cached per ring version — join/leave
+        invalidates once; every lookup in between is a tuple read."""
+        version = self.ring.version
+        cached_version, cached = self._order_cache
+        if cached_version != version:
+            cached = tuple(self.ring.workers())
+            self._order_cache = (version, cached)
+        return cached
+
     def candidates(self, machine: str) -> List[str]:
         """Ordered candidate workers for ``machine``: its replica set
         (rotated per-machine so a hot machine's load spreads over its
-        replicas) followed by every remaining ring worker in preference
-        order — the failover tail a router walks when candidates are dead
-        or draining."""
+        replicas), then a short clockwise failover probe, then every
+        remaining worker (full coverage for the sweep that routes around
+        a mostly-dead fleet).
+
+        Cost per request is O(log v) — a bisect plus a bounded distinct-
+        worker walk for the head, and a cached-membership rotation for
+        the tail — NOT a full rescan of the N·vnodes point array (§22):
+        at fleet scale this call is the router's per-request hot path."""
         with self._lock:
             n_replicas = (
                 self.replicas if machine in self._hot else 1
             )
-            order = self.ring.preference(machine, len(self.ring) or 1)
+            order = self._membership_locked()
             if not order:
                 return []
-            replica_set = order[:n_replicas]
-            tail = order[n_replicas:]
+            head = self.ring.preference(
+                machine, min(n_replicas + self._FAILOVER_PROBE, len(order))
+            )
+            replica_set = head[:n_replicas]
+            tail = head[n_replicas:]
             if len(replica_set) > 1:
                 turn = self._rotation.get(machine, 0)
                 self._rotation[machine] = (turn + 1) % len(replica_set)
                 replica_set = (
                     replica_set[turn:] + replica_set[:turn]
                 )
+            if len(head) < len(order):
+                # deterministic per-machine rotation of the cached
+                # membership list — same coverage the old full ring walk
+                # gave, without touching the point array
+                start = _hash64(machine) % len(order)
+                seen = set(head)
+                tail = tail + [
+                    worker
+                    for worker in order[start:] + order[:start]
+                    if worker not in seen
+                ]
             return replica_set + tail
 
     def replica_set(self, machine: str) -> List[str]:

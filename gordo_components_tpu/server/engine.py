@@ -191,6 +191,52 @@ def _sidecar_matches(q_tree, params) -> bool:
     )
 
 
+def _make_machine_score(lookback: int, lookahead, apply_fn, precision: str):
+    """The per-machine scoring math — scale → (window) → predict →
+    inverse-scale → residual-vs-target-columns → error-scale → L2 —
+    closed over one architecture AND one precision rung. THE one copy:
+    every bucket program (stacked gather, hot, megabatch) and the spill
+    tier's per-machine program build on this closure, so the paths
+    cannot drift numerically (the spill byte-identity gate rides on it).
+    Precision variants are documented on ``_Bucket._machine_score_fn``,
+    which delegates here."""
+    L, la = lookback, lookahead
+
+    def machine_score(machine, x):
+        if precision == "int8":
+            params = jax.tree_util.tree_map(
+                lambda q, s: q.astype(jnp.float32) * s,
+                machine["params"], machine["params_scale"],
+            )
+        else:
+            params = machine["params"]
+        xs = x * machine["sx"].scale + machine["sx"].offset
+        if la is None:
+            inputs = xs
+        else:
+            inputs = windowing.sliding_windows(xs, L, la)
+        if precision == "bf16":
+            inputs = inputs.astype(jnp.bfloat16)
+        pred = apply_fn(
+            {"params": params}, inputs, deterministic=True
+        )
+        if precision == "bf16":
+            pred = pred.astype(jnp.float32)
+        pred_raw = (pred - machine["sy"].offset) / machine["sy"].scale
+        x_tail = x[x.shape[0] - pred_raw.shape[0] :]
+        # residuals score against the machine's TARGET columns of the
+        # raw input — identity for reconstruction configs, a subset /
+        # permutation gather for target_tag_list ones (mirrors the host
+        # path scoring anomaly(X, y=X[target_tags]))
+        y_tail = jnp.take(x_tail, machine["tcols"], axis=-1)
+        err = jnp.abs(y_tail - pred_raw)
+        scaled = err * machine["es"].scale + machine["es"].offset
+        total = jnp.linalg.norm(scaled, axis=-1)
+        return x_tail, pred_raw, scaled, total
+
+    return machine_score
+
+
 def _supports_donation(mesh) -> bool:
     """Whether scoring dispatches may donate their input buffers (XLA:CPU
     silently copies donated buffers and warns per execution — see
@@ -336,6 +382,214 @@ class _MachineEntry:
     # int8 machines only: per-tensor dequantization scales, same treedef
     # as params (which then holds the int8-quantized weights)
     params_scale: Any = None
+
+
+def _lift_machine(name, model, target_cols, precision, quantized_pair):
+    """Analyze one model into its stacked-engine form: ``(estimator,
+    architecture signature, _MachineEntry)``. Raises ``ValueError`` /
+    ``AttributeError`` / ``TypeError`` for machines the engine cannot
+    lift (callers fall back to the host path). THE one lift rule, shared
+    by eager boot (``ServingEngine.__init__``) and the lazy spill tier
+    (§22) so the two can never diverge on what an entry contains."""
+    analyzed = analyze_model(model)
+    est = analyzed.estimator
+    if est.params_ is None:
+        raise ValueError("estimator is not fitted")
+    if getattr(est, "joint_horizon", False):
+        raise ValueError(
+            "joint multi-step forecast emits horizon x F values "
+            "per window; the anomaly engine scores one row per "
+            "timestamp — use the direct-horizon LSTMForecast "
+            "for anomaly serving"
+        )
+    n_features = int(est.n_features_)
+    n_targets = int(est.n_features_out_)
+    tcols = target_cols
+    if tcols is None:
+        if n_targets != n_features:
+            raise ValueError(
+                f"targets are a {n_targets}-of-{n_features} "
+                "subset but no target-column mapping was "
+                "provided (target tags must be derivable from "
+                "input tags)"
+            )
+        tcols = np.arange(n_features, dtype=np.int32)
+    else:
+        tcols = np.asarray(tcols, np.int32)
+        if tcols.shape != (n_targets,):
+            raise ValueError(
+                f"target-column mapping has {tcols.shape[0]} "
+                f"entries for {n_targets} targets"
+            )
+        if tcols.size and (
+            tcols.min() < 0 or tcols.max() >= n_features
+        ):
+            raise ValueError(
+                "target-column mapping indexes outside the "
+                f"{n_features}-wide input"
+            )
+    detector = analyzed.detector
+    if detector is None:
+        es = _identity(n_targets)
+    elif getattr(detector.scaler, "params_", "unset") is None:
+        if detector.require_thresholds:
+            # host path refuses to score this state (HTTP 400);
+            # the engine must not serve it either
+            raise ValueError(
+                "error scaler unfitted and require_thresholds set"
+            )
+        # diff.anomaly's documented fallback: raw |residuals|
+        es = _identity(n_targets)
+    else:
+        es = _affine(detector.scaler, n_targets)
+    prec = precision_mod.validate(precision)
+    params = jax.device_get(est.params_)
+    params_scale = None
+    if prec == "bf16":
+        # weights live as bf16 on host AND device (half the
+        # stacked bytes); the closure computes the forward
+        # pass in bf16 and everything else in f32
+        params = jax.tree_util.tree_map(
+            lambda a: np.asarray(a, dtype=jnp.bfloat16), params
+        )
+    elif prec == "int8":
+        pair = quantized_pair
+        if pair is not None and not _sidecar_matches(pair[0], params):
+            # treedef AND per-leaf shapes: a stale sidecar
+            # whose structure matches but whose leaves were
+            # shaped by an older retrain must fall back to
+            # on-the-fly quantization here — trusted, it
+            # would blow up np.stack in _Bucket.__init__
+            # and take the whole boot down with it
+            logger.warning(
+                "Machine %r: stored int8 sidecar disagrees "
+                "with the model params (tree or leaf "
+                "shapes); quantizing on the fly instead",
+                name,
+            )
+            pair = None
+        if pair is None:
+            pair = precision_mod.quantize_tree_int8(params)
+        params, params_scale = pair
+        params = jax.tree_util.tree_map(
+            lambda a: np.asarray(a, np.int8), params
+        )
+        params_scale = jax.tree_util.tree_map(
+            lambda s: np.asarray(s, np.float32), params_scale
+        )
+    entry = _MachineEntry(
+        name=name,
+        params=params,
+        sx=_affine(analyzed.input_scaler, n_features),
+        sy=_affine(analyzed.target_scaler, n_targets),
+        es=es,
+        has_detector=detector is not None,
+        tcols=tcols,
+        params_scale=params_scale,
+    )
+    sig = json.dumps(
+        {
+            "config": est._spec.config,
+            "loss": est._spec.loss,
+            "F": n_features,
+            "T": n_targets,
+            "L": est.lookback_window,
+            "la": est.lookahead,
+            # precision partitions the fleet into dtype-homogeneous
+            # buckets (§19): machines sharing an architecture at
+            # DIFFERENT rungs stack into different trees, so no
+            # program — cold, hot, or fused — ever mixes dtypes
+            "precision": prec,
+        },
+        sort_keys=True,
+        default=str,
+    )
+    return est, sig, entry
+
+
+def _entry_host_tree(entry: _MachineEntry) -> Dict[str, Any]:
+    """One machine's dispatchable tree — the SAME dict shape a bucket
+    program gathers per slot, so the spill program's ``machine_score``
+    sees bit-identical inputs to the stacked paths."""
+    tree: Dict[str, Any] = {
+        "params": entry.params,
+        "sx": entry.sx,
+        "sy": entry.sy,
+        "es": entry.es,
+        "tcols": np.asarray(entry.tcols, np.int32),
+    }
+    if entry.params_scale is not None:
+        tree["params_scale"] = entry.params_scale
+    return tree
+
+
+def _tree_nbytes(tree: Any) -> int:
+    return int(
+        sum(
+            np.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+    )
+
+
+class SpillNotLiftable(Exception):
+    """A lazily-registered machine's model cannot be lifted into the
+    engine (same rule as the eager boot's ``skipped`` set). The bundle —
+    and its parked context — is still cached; the server scores it
+    through the host path, exactly as an eager boot would have."""
+
+
+class _SpillScorer:
+    """Per-architecture scoring programs for the spill tier (§22): one
+    replicated ``jit(vmap(machine_score))`` per (rows, batch) over a
+    SINGLE machine tree — structurally the hot-cache program, built from
+    the same ``_make_machine_score`` closure, so a spill-served score is
+    bit-identical to the same machine served through a stacked bucket.
+    Cold-tail machines don't fuse (that is what makes them the cold
+    tail); the working set belongs in the stacked engine, and the spill
+    path's job is to make everything else O(memcpy + one dispatch).
+
+    Program compiles are per (architecture, row bucket) — O(arch), never
+    O(machines) — and run outside the host-cache lock (first spill
+    request of an arch pays one XLA compile, like any unwarmed shape).
+    """
+
+    __slots__ = ("lookback", "lookahead", "n_features", "precision",
+                 "_apply_fn", "_donate", "_programs", "_compile_lock")
+
+    def __init__(self, est, precision: str):
+        self.lookback = est.lookback_window
+        self.lookahead = est.lookahead
+        self.n_features = int(est.n_features_)
+        self.precision = precision
+        self._apply_fn = est._spec.module.apply
+        self._donate = _supports_donation(None)
+        self._programs: Dict[Tuple[int, int], Any] = {}
+        # plain lock (never nests anything): serializes first-compile per
+        # shape so a thundering herd compiles once, not N times
+        self._compile_lock = threading.Lock()
+
+    def program(self, rows: int, k: int = 1):
+        key = (rows, k)
+        program = self._programs.get(key)
+        if program is not None:
+            _M_PROGRAM_CACHE.labels("spill", "hit").inc()
+            return program
+        with self._compile_lock:
+            program = self._programs.get(key)
+            if program is None:
+                _M_PROGRAM_CACHE.labels("spill", "miss").inc()
+                machine_score = _make_machine_score(
+                    self.lookback, self.lookahead, self._apply_fn,
+                    self.precision,
+                )
+                donate = (1,) if self._donate else ()
+                program = jax.jit(
+                    jax.vmap(machine_score, in_axes=(None, 0)),
+                    donate_argnums=donate,
+                )
+                self._programs[key] = program
+        return program
 
 
 class _Item:
@@ -690,42 +944,9 @@ class _Bucket:
         inside the program (per-tensor scales gathered alongside), so
         accumulation is full f32 while the resident weight bytes are a
         quarter of f32's."""
-        L, la, apply_fn = self.lookback, self.lookahead, self.apply_fn
-        precision = self.precision
-
-        def machine_score(machine, x):
-            if precision == "int8":
-                params = jax.tree_util.tree_map(
-                    lambda q, s: q.astype(jnp.float32) * s,
-                    machine["params"], machine["params_scale"],
-                )
-            else:
-                params = machine["params"]
-            xs = x * machine["sx"].scale + machine["sx"].offset
-            if la is None:
-                inputs = xs
-            else:
-                inputs = windowing.sliding_windows(xs, L, la)
-            if precision == "bf16":
-                inputs = inputs.astype(jnp.bfloat16)
-            pred = apply_fn(
-                {"params": params}, inputs, deterministic=True
-            )
-            if precision == "bf16":
-                pred = pred.astype(jnp.float32)
-            pred_raw = (pred - machine["sy"].offset) / machine["sy"].scale
-            x_tail = x[x.shape[0] - pred_raw.shape[0] :]
-            # residuals score against the machine's TARGET columns of the
-            # raw input — identity for reconstruction configs, a subset /
-            # permutation gather for target_tag_list ones (mirrors the host
-            # path scoring anomaly(X, y=X[target_tags]))
-            y_tail = jnp.take(x_tail, machine["tcols"], axis=-1)
-            err = jnp.abs(y_tail - pred_raw)
-            scaled = err * machine["es"].scale + machine["es"].offset
-            total = jnp.linalg.norm(scaled, axis=-1)
-            return x_tail, pred_raw, scaled, total
-
-        return machine_score
+        return _make_machine_score(
+            self.lookback, self.lookahead, self.apply_fn, self.precision
+        )
 
     def _program(self, rows: int, k: int):
         key = (rows, k)
@@ -2176,8 +2397,31 @@ class ServingEngine:
         megabatch_residency: Optional[int] = None,
         precisions: Optional[Dict[str, str]] = None,
         quantized: Optional[Dict[str, Tuple[Any, Any]]] = None,
+        lazy: Optional[Dict[str, Any]] = None,
+        host_cache_mb: Optional[int] = None,
     ):
         self.mesh = mesh
+        # host-RAM spill tier (§22): machines registered LAZY are not
+        # materialized (no model object, no stacked slot, no device
+        # bytes) until their first request — which loads them through the
+        # byte-bounded host cache and scores them via a per-architecture
+        # replicated program. ``lazy`` maps name -> loader() returning
+        # {"model", "target_cols", "precision", "quantized", "context"}
+        # (context is opaque to the engine; the server parks its
+        # _Machine there). GORDO_HOST_CACHE_MB bounds the tier; 0
+        # disables caching (every spill request pays the store path).
+        if host_cache_mb is None:
+            host_cache_mb = _env_int("GORDO_HOST_CACHE_MB", 256)
+        self.host_cache_mb = host_cache_mb
+        self._lazy: Dict[str, Any] = dict(lazy or {})
+        from .host_cache import HostTierCache
+
+        self.host_cache = HostTierCache(host_cache_mb * (1 << 20))
+        # per-architecture spill scorers, keyed by arch signature; reads
+        # and writes both under the host-cache tier's lock rank is NOT
+        # needed — a plain dict with last-write-wins registration is
+        # correct (two racing first-requests build equal scorers)
+        self._spill_scorers: Dict[str, _SpillScorer] = {}
         # cross-machine megabatching (ARCHITECTURE §15): replicated mode
         # only; env-resolved unless the caller overrides. fill_window_us
         # is zeroed when megabatching is off — the window is the fused
@@ -2236,125 +2480,16 @@ class ServingEngine:
         groups: Dict[str, List[Tuple[Any, _MachineEntry]]] = {}
         for name, model in models.items():
             try:
-                analyzed = analyze_model(model)
-                est = analyzed.estimator
-                if est.params_ is None:
-                    raise ValueError("estimator is not fitted")
-                if getattr(est, "joint_horizon", False):
-                    raise ValueError(
-                        "joint multi-step forecast emits horizon x F values "
-                        "per window; the anomaly engine scores one row per "
-                        "timestamp — use the direct-horizon LSTMForecast "
-                        "for anomaly serving"
-                    )
-                n_features = int(est.n_features_)
-                n_targets = int(est.n_features_out_)
-                tcols = target_cols.get(name)
-                if tcols is None:
-                    if n_targets != n_features:
-                        raise ValueError(
-                            f"targets are a {n_targets}-of-{n_features} "
-                            "subset but no target-column mapping was "
-                            "provided (target tags must be derivable from "
-                            "input tags)"
-                        )
-                    tcols = np.arange(n_features, dtype=np.int32)
-                else:
-                    tcols = np.asarray(tcols, np.int32)
-                    if tcols.shape != (n_targets,):
-                        raise ValueError(
-                            f"target-column mapping has {tcols.shape[0]} "
-                            f"entries for {n_targets} targets"
-                        )
-                    if tcols.size and (
-                        tcols.min() < 0 or tcols.max() >= n_features
-                    ):
-                        raise ValueError(
-                            "target-column mapping indexes outside the "
-                            f"{n_features}-wide input"
-                        )
-                detector = analyzed.detector
-                if detector is None:
-                    es = _identity(n_targets)
-                elif getattr(detector.scaler, "params_", "unset") is None:
-                    if detector.require_thresholds:
-                        # host path refuses to score this state (HTTP 400);
-                        # the engine must not serve it either
-                        raise ValueError(
-                            "error scaler unfitted and require_thresholds set"
-                        )
-                    # diff.anomaly's documented fallback: raw |residuals|
-                    es = _identity(n_targets)
-                else:
-                    es = _affine(detector.scaler, n_targets)
-                prec = precision_mod.validate(precisions.get(name))
-                params = jax.device_get(est.params_)
-                params_scale = None
-                if prec == "bf16":
-                    # weights live as bf16 on host AND device (half the
-                    # stacked bytes); the closure computes the forward
-                    # pass in bf16 and everything else in f32
-                    params = jax.tree_util.tree_map(
-                        lambda a: np.asarray(a, dtype=jnp.bfloat16), params
-                    )
-                elif prec == "int8":
-                    pair = quantized.get(name)
-                    if pair is not None and not _sidecar_matches(
-                        pair[0], params
-                    ):
-                        # treedef AND per-leaf shapes: a stale sidecar
-                        # whose structure matches but whose leaves were
-                        # shaped by an older retrain must fall back to
-                        # on-the-fly quantization here — trusted, it
-                        # would blow up np.stack in _Bucket.__init__
-                        # and take the whole boot down with it
-                        logger.warning(
-                            "Machine %r: stored int8 sidecar disagrees "
-                            "with the model params (tree or leaf "
-                            "shapes); quantizing on the fly instead",
-                            name,
-                        )
-                        pair = None
-                    if pair is None:
-                        pair = precision_mod.quantize_tree_int8(params)
-                    params, params_scale = pair
-                    params = jax.tree_util.tree_map(
-                        lambda a: np.asarray(a, np.int8), params
-                    )
-                    params_scale = jax.tree_util.tree_map(
-                        lambda s: np.asarray(s, np.float32), params_scale
-                    )
-                entry = _MachineEntry(
-                    name=name,
-                    params=params,
-                    sx=_affine(analyzed.input_scaler, n_features),
-                    sy=_affine(analyzed.target_scaler, n_targets),
-                    es=es,
-                    has_detector=detector is not None,
-                    tcols=tcols,
-                    params_scale=params_scale,
+                est, sig, entry = _lift_machine(
+                    name, model,
+                    target_cols.get(name),
+                    precisions.get(name),
+                    quantized.get(name),
                 )
             except (ValueError, AttributeError, TypeError) as exc:
                 logger.info("Serving engine skips %r: %s", name, exc)
                 self.skipped[name] = str(exc)
                 continue
-            sig = json.dumps(
-                {
-                    "config": est._spec.config,
-                    "loss": est._spec.loss,
-                    "F": n_features,
-                    "T": n_targets,
-                    "L": est.lookback_window,
-                    "la": est.lookahead,
-                    # precision partitions the fleet into dtype-homogeneous
-                    # buckets (§19): machines sharing an architecture at
-                    # DIFFERENT rungs stack into different trees, so no
-                    # program — cold, hot, or fused — ever mixes dtypes
-                    "precision": prec,
-                },
-                sort_keys=True,
-                default=str,
-            )
             groups.setdefault(sig, []).append((est, entry))
 
         for sig, members in sorted(groups.items()):
@@ -2489,10 +2624,101 @@ class ServingEngine:
         return applied
 
     def can_score(self, name: str) -> bool:
-        return name in self._by_name
+        return name in self._by_name or name in self._lazy
 
     def machines(self) -> List[str]:
-        return sorted(self._by_name)
+        if not self._lazy:
+            return sorted(self._by_name)
+        return sorted(set(self._by_name) | set(self._lazy))
+
+    # -- host-RAM spill tier (§22) -------------------------------------------
+    def has_lazy(self, name: str) -> bool:
+        return name in self._lazy
+
+    def lazy_machines(self) -> List[str]:
+        return sorted(self._lazy)
+
+    def spill_bundle(self, name: str) -> Dict[str, Any]:
+        """The machine's spill bundle — host entry tree + scorer + opaque
+        loader context — from the host cache (a memcpy away from
+        dispatch) or, on miss, the store path: loader → verify →
+        deserialize → ``_lift_machine``. Store errors propagate typed
+        (the server quarantines on them). Bundles are what the §22
+        acceptance measures: hit-vs-store is the spill tier's win."""
+        loader = self._lazy.get(name)
+        if loader is None:
+            raise KeyError(f"machine {name!r} is not registered lazy")
+        return self.host_cache.get_or_load(
+            name, lambda: self._build_bundle(name, loader)
+        )
+
+    def _build_bundle(self, name: str, loader) -> Tuple[Dict[str, Any], int]:
+        """The store path: loader (verify + deserialize) → lift → host
+        entry tree + per-arch scorer. Returns ``(bundle, nbytes)`` for
+        the host cache's byte ledger."""
+        loaded = loader()
+        try:
+            est, sig, entry = _lift_machine(
+                name,
+                loaded["model"],
+                loaded.get("target_cols"),
+                loaded.get("precision"),
+                loaded.get("quantized"),
+            )
+        except (ValueError, AttributeError, TypeError) as exc:
+            # same skip rule as the eager boot: the machine serves, just
+            # not through a jitted program. The host-only bundle still
+            # caches (the deserialize is the expensive part either way);
+            # its footprint comes from the loader's artifact-size hint.
+            logger.info("Spill tier serves %r host-path only: %s", name, exc)
+            bundle = {
+                "entry": None,
+                "sig": None,
+                "scorer": None,
+                "skip": str(exc),
+                "context": loaded.get("context"),
+            }
+            return bundle, int(loaded.get("nbytes") or 0)
+        scorer = self._spill_scorers.get(sig)
+        if scorer is None:
+            # last-write-wins registration: equal scorers, see ctor
+            scorer = _SpillScorer(est, json.loads(sig)["precision"])
+            self._spill_scorers[sig] = scorer
+        tree = _entry_host_tree(entry)
+        bundle = {
+            "entry": tree,
+            "sig": sig,
+            "scorer": scorer,
+            "context": loaded.get("context"),
+        }
+        # the byte ledger must bound REAL RAM: the parked context (the
+        # server's _Machine) pins its own host copy of the params beside
+        # the entry tree, and the loader's artifact-size hint is its
+        # honest order-of-magnitude proxy — counting the tree alone
+        # would let the tier hold ~2x GORDO_HOST_CACHE_MB
+        context_nbytes = int(loaded.get("nbytes") or 0)
+        return bundle, _tree_nbytes(tree) + context_nbytes
+
+    def prefetch(self, names: List[str]) -> Dict[str, int]:
+        """Async placement hint (§22): queue background host-cache loads
+        for lazy machines expected to land here. Unknown / non-lazy
+        names are ignored (hints are advisory)."""
+        queued = skipped = unknown = 0
+        for name in names:
+            loader = self._lazy.get(name)
+            if loader is None:
+                unknown += 1
+                continue
+            if self.host_cache.prefetch(
+                name,
+                lambda name=name, loader=loader: self._build_bundle(
+                    name, loader
+                ),
+            ):
+                queued += 1
+            else:
+                skipped += 1
+        return {"queued": queued, "skipped": skipped, "unknown": unknown}
 
     def _prepare(self, bucket: _Bucket, X: np.ndarray) -> Tuple[np.ndarray, int]:
         X = np.asarray(getattr(X, "values", X), np.float32)
@@ -2529,7 +2755,14 @@ class ServingEngine:
         longer than ``max_rows_dispatch`` rows score in overlapping chunks
         (overlap = the windowing offset, so chunked and unchunked results
         are identical) — backfills never compile outsized programs."""
-        bucket, idx = self._by_name[name]
+        resolved = self._by_name.get(name)
+        if resolved is None and name in self._lazy:
+            # spill tier (§22): lazily-registered machine — host cache
+            # (or store) entry + per-arch replicated program, same seams
+            return self._anomaly_spill(name, X)
+        if resolved is None:
+            raise KeyError(name)
+        bucket, idx = resolved
         # resilience seams, both no-ops in the common case: expired work
         # must not queue behind the bucket's leader latch (the 504 path),
         # and the chaos harness injects latency/error/corruption HERE —
@@ -2540,6 +2773,21 @@ class ServingEngine:
             deadline.check("engine.dispatch")
             faults.inject("engine-dispatch", name)
             X = faults.corrupt("engine-dispatch", name, X)
+        return self._chunked_score(
+            bucket, X,
+            lambda x_padded, m_valid: bucket.submit(idx, x_padded, m_valid),
+        )
+
+    def _chunked_score(self, windowed, X, score_chunk) -> ScoreResult:
+        """THE chunk-and-stitch rule, shared by the stacked path and the
+        spill tier so the two can never drift on the overlap math or
+        the deadline placement. ``windowed`` provides ``lookback``/
+        ``lookahead``/``n_features`` (a ``_Bucket`` or a
+        ``_SpillScorer``); ``score_chunk(x_padded, m_valid)`` dispatches
+        one prepared chunk. Windowed models: chunk c+1 starts ``offset``
+        rows before chunk c ends, so its first prediction row is exactly
+        one past chunk c's last — no gap, no duplicate, bit-identical
+        stitching."""
         X = np.asarray(getattr(X, "values", X), np.float32)
         if X.ndim == 1:
             X = X[None, :]
@@ -2549,13 +2797,10 @@ class ServingEngine:
             # latency, or a real one) must surface as 504, not as an
             # answer delivered after the caller gave up
             deadline.check("engine.dispatch")
-            x_padded, m_valid = self._prepare(bucket, X)
-            return bucket.submit(idx, x_padded, m_valid)
+            x_padded, m_valid = self._prepare(windowed, X)
+            return score_chunk(x_padded, m_valid)
 
-        # windowed models: chunk c+1 starts `offset` rows before chunk c
-        # ends, so its first prediction row is exactly one past chunk c's
-        # last — no gap, no duplicate, bit-identical stitching
-        L, la = bucket.lookback, bucket.lookahead
+        L, la = windowed.lookback, windowed.lookahead
         offset = 0 if la is None else L - 1 + la
         if cap <= offset:
             raise ValueError(
@@ -2573,8 +2818,8 @@ class ServingEngine:
             chunk = X[start : start + cap]
             if len(chunk) <= offset:  # fully covered by the previous chunk
                 break
-            x_padded, m_valid = self._prepare(bucket, chunk)
-            parts.append(bucket.submit(idx, x_padded, m_valid))
+            x_padded, m_valid = self._prepare(windowed, chunk)
+            parts.append(score_chunk(x_padded, m_valid))
             start += cap - offset
         return ScoreResult(
             model_input=np.concatenate([p.model_input for p in parts]),
@@ -2585,6 +2830,53 @@ class ServingEngine:
             total_anomaly_score=np.concatenate(
                 [p.total_anomaly_score for p in parts]
             ),
+        )
+
+    def _anomaly_spill(self, name: str, X) -> ScoreResult:
+        """Score a lazily-registered machine through the spill tier: host
+        cache hit = memcpy (host→device put) + one replicated dispatch;
+        miss = the store path first. Same resilience seams, chunking
+        rule, and scoring closure as the stacked path — spill scores are
+        bit-identical to the same machine served eagerly (gated by the
+        §22 tests)."""
+        with spans.stage("dispatch", machine=name):
+            deadline.check("engine.dispatch")
+            faults.inject("engine-dispatch", name)
+            X = faults.corrupt("engine-dispatch", name, X)
+        bundle = self.spill_bundle(name)
+        scorer: _SpillScorer = bundle["scorer"]
+        if scorer is None:
+            raise SpillNotLiftable(bundle.get("skip") or name)
+        return self._chunked_score(
+            scorer, X,
+            lambda x_padded, m_valid: self._spill_score_once(
+                name, bundle, scorer, x_padded, m_valid
+            ),
+        )
+
+    def _spill_score_once(
+        self, name, bundle, scorer: _SpillScorer, x_padded, m_valid
+    ) -> ScoreResult:
+        rows = x_padded.shape[0]
+        program = scorer.program(rows, 1)
+        started = time.perf_counter()
+        with spans.stage("dispatch", path="spill", machine=name):
+            # the memcpy the spill tier exists for: a host→device put of
+            # one machine's tree, instead of a disk read + deserialize
+            tree = jax.device_put(bundle["entry"])
+            outputs = program(tree, x_padded[None])
+        with spans.stage("fetch", path="spill"):
+            x_tail, pred, scaled, total = jax.device_get(outputs)
+        _M_DISPATCH_SECONDS.labels("spill").observe(
+            time.perf_counter() - started
+        )
+        _M_REQUESTS.labels("spill").inc()
+        _M_PRECISION.labels(scorer.precision).inc()
+        return ScoreResult(
+            model_input=x_tail[0][:m_valid],
+            model_output=pred[0][:m_valid],
+            tag_anomaly_scores=scaled[0][:m_valid],
+            total_anomaly_score=total[0][:m_valid],
         )
 
     def predict(self, name: str, X) -> np.ndarray:
@@ -2667,4 +2959,13 @@ class ServingEngine:
                 if self.compile_cache is not None
                 else None
             ),
+            # host-RAM spill tier (§22): lazily-registered machines, the
+            # byte-bounded host cache's hit/miss/eviction economy, and
+            # how many per-arch spill programs exist (O(arch), never
+            # O(machines))
+            "spill": {
+                "lazy_machines": len(self._lazy),
+                "scorers": len(self._spill_scorers),
+                "host_cache": self.host_cache.stats(),
+            },
         }

@@ -1,0 +1,330 @@
+"""Host-RAM spill tier: the memory level between device residency and
+the model store (docs/ARCHITECTURE.md §22).
+
+At fleet scale the engine cannot keep every machine's params stacked on
+device — ``GORDO_MEGABATCH_RESIDENCY`` bounds the fused working set, and
+a 100k-machine fleet is orders of magnitude past it. Before this tier,
+everything non-resident still lived in the full stacked tree; with lazy
+fleet boot (§22) non-resident machines are not materialized at all, and
+serving one means a store round trip: disk read + manifest verify +
+deserialize + entry build. This cache holds the END PRODUCT of that trip
+— the deserialized, pre-stacked host arrays a dispatch needs — so a
+demoted or cold machine pays a memcpy (host→device put) instead of the
+store path. Mesh-TensorFlow frames layout/placement as a space of
+choices (PAPERS.md); device-resident vs host-RAM vs store is the same
+space one memory level down, and the Gemma-on-TPU serving comparisons
+show the hit ratio of exactly this tier dominating cost once weights
+outgrow fast memory.
+
+Bounded by BYTES (``GORDO_HOST_CACHE_MB``), not entries: entry sizes
+follow the fleet's shape spread, and an operator reasons in RAM. ``0``
+disables the tier cleanly — every spill request pays the store path.
+
+Concurrency: one lock (``engine.host_cache``, §17) guards the LRU dict
+and the byte ledger; loads, device puts, and program compiles all run
+OUTSIDE it (it is a request-hot-path lock — blocking under it would
+stall every concurrent spill request). The prefetch worker is a lazy
+daemon thread fed by a bounded queue: placement hints are advisory, so
+a full queue drops hints rather than blocking the hinter.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..analysis import lockcheck
+from ..observability import spans
+from ..observability.registry import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+_M_EVENTS = REGISTRY.counter(
+    "gordo_engine_host_cache_events_total",
+    "Host-RAM spill tier lifecycle: hit (entry served from host RAM), "
+    "miss (store path paid), store (a load completed and was cached), "
+    "evict (LRU eviction under the byte cap), oversize (entry larger "
+    "than the whole cap — served but never cached), prefetch (a "
+    "placement-hint load completed), prefetch_skip (hint already "
+    "cached/in flight), prefetch_drop (hint queue full), "
+    "prefetch_error (hint load failed)",
+    labels=("event",),
+)
+_M_BYTES = REGISTRY.gauge(
+    "gordo_engine_host_cache_bytes",
+    "Bytes of deserialized pre-stacked host arrays held by the spill "
+    "tier (bounded by GORDO_HOST_CACHE_MB)",
+)
+_M_ENTRIES = REGISTRY.gauge(
+    "gordo_engine_host_cache_entries",
+    "Machines whose host entry is resident in the spill tier",
+)
+_M_LOAD_SECONDS = REGISTRY.histogram(
+    "gordo_engine_host_cache_load_seconds",
+    "Store-path duration on a spill-tier miss (disk read + manifest "
+    "verify + deserialize + entry build) — the cost a hit's memcpy "
+    "replaces",
+)
+
+# bounded hint queue: prefetch is advisory; a burst of hints beyond this
+# is dropped (counted), never a blocked hinter or an unbounded backlog
+_PREFETCH_QUEUE_MAX = 1024
+
+
+class HostTierCache:
+    """Byte-bounded LRU of per-machine host entries + async prefetch.
+
+    ``cap_bytes <= 0`` disables the tier: ``get`` always misses, ``put``
+    is a no-op, prefetch hints are dropped — callers pay the store path
+    every time, which is exactly the pre-spill behavior.
+    """
+
+    def __init__(self, cap_bytes: int):
+        self.cap_bytes = max(0, int(cap_bytes))
+        self._lock = lockcheck.named_lock("engine.host_cache")
+        self._entries: "OrderedDict[str, Tuple[Any, int]]" = OrderedDict()
+        self._bytes = 0
+        # in-flight prefetch names (claimed under the lock) so a hint
+        # storm for one machine loads it once
+        self._inflight: set = set()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=_PREFETCH_QUEUE_MAX)
+        self._worker: Optional[threading.Thread] = None
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.loads = 0
+        self.prefetches = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.cap_bytes > 0
+
+    # -- core ----------------------------------------------------------------
+    def get(self, name: str) -> Optional[Any]:
+        """The cached host entry (LRU-touched) or None. Counts hit/miss
+        so the residency economy is readable off one counter pair."""
+        with self._lock:
+            lockcheck.assert_guard("engine.host_cache")
+            cached = self._entries.get(name)
+            if cached is not None:
+                self._entries.move_to_end(name)
+                self.hits += 1
+            else:
+                self.misses += 1
+        if cached is None:
+            _M_EVENTS.labels("miss").inc()
+            return None
+        _M_EVENTS.labels("hit").inc()
+        return cached[0]
+
+    def peek(self, name: str) -> Optional[Any]:
+        """The cached entry WITHOUT touching LRU order or hit/miss
+        counters — probe endpoints (healthz) must not perturb the
+        residency economy they report on."""
+        with self._lock:
+            lockcheck.assert_guard("engine.host_cache")
+            cached = self._entries.get(name)
+        return None if cached is None else cached[0]
+
+    def put(self, name: str, entry: Any, nbytes: int) -> bool:
+        """Cache ``entry`` (``nbytes`` = its host-array footprint),
+        evicting LRU entries to stay under the cap. Returns False when
+        the tier is off or the entry alone exceeds the whole cap (served
+        uncached — one plant-sized machine must not flush the tier)."""
+        nbytes = max(0, int(nbytes))
+        if not self.enabled:
+            return False
+        if nbytes > self.cap_bytes:
+            _M_EVENTS.labels("oversize").inc()
+            return False
+        evicted = []
+        with self._lock:
+            lockcheck.assert_guard("engine.host_cache")
+            old = self._entries.pop(name, None)
+            if old is not None:
+                self._bytes -= old[1]
+            while self._bytes + nbytes > self.cap_bytes and self._entries:
+                victim, (_, vbytes) = self._entries.popitem(last=False)
+                self._bytes -= vbytes
+                self.evictions += 1
+                evicted.append(victim)
+            self._entries[name] = (entry, nbytes)
+            self._bytes += nbytes
+            total, count = self._bytes, len(self._entries)
+        for victim in evicted:
+            _M_EVENTS.labels("evict").inc()
+            # the spill tier is one level below §15 megabatch residency:
+            # its evictions ride the same timeline event family so one
+            # stream shows the whole residency economy
+            spans.event(
+                "megabatch_residency", action="host_evict", machine=victim
+            )
+        _M_BYTES.set(total)
+        _M_ENTRIES.set(count)
+        return True
+
+    def get_or_load(self, name: str, loader: Callable[[], Tuple[Any, int]]):
+        """Hit, or pay the store path: ``loader() -> (entry, nbytes)``
+        runs OUTSIDE the lock (it reads disk and deserializes). Two
+        racing loaders both load; the last ``put`` wins — wasteful but
+        correct, and rarer than a lock held across disk I/O would be
+        expensive."""
+        cached = self.get(name)
+        if cached is not None:
+            return cached
+        import time as _time
+
+        started = _time.perf_counter()
+        entry, nbytes = loader()
+        _M_LOAD_SECONDS.observe(_time.perf_counter() - started)
+        with self._lock:
+            self.loads += 1
+        _M_EVENTS.labels("store").inc()
+        self.put(name, entry, nbytes)
+        return entry
+
+    def drop(self, name: str) -> bool:
+        """Remove one entry (demotion seam: a machine whose artifact
+        changed generation must not serve stale host arrays)."""
+        with self._lock:
+            lockcheck.assert_guard("engine.host_cache")
+            old = self._entries.pop(name, None)
+            if old is not None:
+                self._bytes -= old[1]
+            total, count = self._bytes, len(self._entries)
+        _M_BYTES.set(total)
+        _M_ENTRIES.set(count)
+        return old is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            lockcheck.assert_guard("engine.host_cache")
+            self._entries.clear()
+            self._bytes = 0
+        _M_BYTES.set(0)
+        _M_ENTRIES.set(0)
+
+    # -- async prefetch (placement hints) ------------------------------------
+    def prefetch(
+        self, name: str, loader: Callable[[], Tuple[Any, int]]
+    ) -> bool:
+        """Queue a background load for ``name`` (a placement hint: the
+        router/harness knows which machines will land here). Returns True
+        when the hint was queued; already-cached / in-flight names and
+        full queues are skipped (counted) — hints are advisory."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            lockcheck.assert_guard("engine.host_cache")
+            if name in self._entries or name in self._inflight:
+                skip = True
+            else:
+                self._inflight.add(name)
+                skip = False
+        if skip:
+            _M_EVENTS.labels("prefetch_skip").inc()
+            return False
+        # capture the hinting request's trace context at the enqueue
+        # seam: the background load's events and log records attribute
+        # to the placement hint that asked for it (§13 seam rule)
+        ctx = spans.capture()
+        try:
+            self._queue.put_nowait((name, loader, ctx))
+        except queue.Full:
+            with self._lock:
+                self._inflight.discard(name)
+            _M_EVENTS.labels("prefetch_drop").inc()
+            return False
+        self._ensure_worker()
+        return True
+
+    def _ensure_worker(self) -> None:
+        # whole check under the lock: retirement (_prefetch_loop's
+        # empty-check + _worker=None) is atomic under the same lock, so
+        # a spawn decision can never interleave with a half-finished
+        # retirement and leave a queued hint with no worker
+        with self._lock:
+            worker = self._worker
+            if worker is not None and worker.is_alive():
+                return
+            self._worker = threading.Thread(
+                target=self._prefetch_loop,
+                name="gordo-host-prefetch",
+                daemon=True,
+            )
+            self._worker.start()
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            try:
+                name, loader, ctx = self._queue.get(timeout=30.0)
+            except queue.Empty:
+                # idle worker retires — but VISIBLY (under the lock, so
+                # _ensure_worker's alive check and this retirement are
+                # ordered) and only with a provably empty queue: a hint
+                # enqueued while the timeout fired either re-enters the
+                # loop here or sees _worker=None and respawns. Without
+                # this, that hint would strand in the queue with its
+                # name claimed in _inflight forever.
+                with self._lock:
+                    if not self._queue.empty():
+                        continue
+                    self._worker = None
+                return
+            try:
+                with spans.bind(ctx):
+                    # the demotion race: a drop()/evict landing between
+                    # this load and its put just re-caches the entry
+                    # (fresh load = fresh bytes), and a put racing a
+                    # concurrent get_or_load is last-write-wins — both
+                    # end consistent
+                    entry, nbytes = loader()
+                    if self.put(name, entry, nbytes):
+                        with self._lock:
+                            self.prefetches += 1
+                        _M_EVENTS.labels("prefetch").inc()
+            except Exception:
+                _M_EVENTS.labels("prefetch_error").inc()
+                logger.warning(
+                    "Host-cache prefetch of %r failed", name, exc_info=True
+                )
+            finally:
+                with self._lock:
+                    self._inflight.discard(name)
+                self._queue.task_done()
+
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Wait for queued prefetches to finish (tests/harness)."""
+        import time as _time
+
+        end = _time.monotonic() + timeout
+        while _time.monotonic() < end:
+            with self._lock:
+                busy = bool(self._inflight) or not self._queue.empty()
+            if not busy:
+                return True
+            _time.sleep(0.01)
+        return False
+
+    # -- views ---------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "cap_bytes": self.cap_bytes,
+                "bytes": self._bytes,
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "loads": self.loads,
+                "prefetches": self.prefetches,
+            }
+
+    def resident(self) -> Tuple[str, ...]:
+        """LRU-ordered resident names, oldest first (tests)."""
+        with self._lock:
+            return tuple(self._entries)

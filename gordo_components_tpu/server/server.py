@@ -74,7 +74,7 @@ from ..serializer import dumps as serializer_dumps
 from ..serializer import load, load_metadata
 from ..store import generations as store_generations
 from .. import wire
-from .engine import ScoreResult, ServingEngine
+from .engine import ScoreResult, ServingEngine, SpillNotLiftable
 
 logger = logging.getLogger(__name__)
 
@@ -101,6 +101,9 @@ _URL_MAP = Map(
         Rule("/healthz", endpoint="healthz"),
         Rule("/metadata", endpoint="metadata"),
         Rule("/metrics", endpoint="metrics"),
+        # host-RAM spill tier placement hint (§22): POST {"machines":
+        # [...]} queues async host-cache loads for lazy machines
+        Rule("/prefetch", endpoint="prefetch"),
         Rule("/slo", endpoint="slo"),
         Rule("/models", endpoint="models"),
         Rule("/reload", endpoint="reload"),
@@ -203,12 +206,13 @@ class _Machine:
 
 
 def scan_models_root(models_root: str) -> Dict[str, str]:
-    """``{subdir_name: path}`` for every immediate subdir that looks like a
-    model artifact: a generation root (has a ``CURRENT`` pointer — the
-    store's gen-NNNN layout) or a flat legacy dir (has ``definition.json``).
-    The ONE scan rule, shared by CLI startup and ``/reload`` so the two
-    can never drift. Hidden dirs (``.staging-*`` crash debris, checkpoint
-    dirs) never qualify."""
+    """``{subdir_name: path}`` for every immediate subdir that passes the
+    store's ``is_artifact_dir`` rule: a generation root (``CURRENT``
+    pointer — the gen-NNNN layout) or a flat legacy dir
+    (``definition.json``). The ONE scan rule, shared by CLI startup,
+    ``/reload`` AND ``build_fleet_index`` (the predicate lives in the
+    store layer) so none of the three can drift. Hidden dirs
+    (``.staging-*`` crash debris, checkpoint dirs) never qualify."""
     import os
 
     seen: Dict[str, str] = {}
@@ -216,9 +220,7 @@ def scan_models_root(models_root: str) -> Dict[str, str]:
         path = os.path.join(models_root, entry)
         if entry.startswith(".") or not os.path.isdir(path):
             continue
-        if store_generations.is_generation_root(path) or os.path.exists(
-            os.path.join(path, "definition.json")
-        ):
+        if store_generations.is_artifact_dir(path):
             seen[entry] = path
     return seen
 
@@ -249,19 +251,28 @@ class _ServerState:
     long request could free the very stacked tree that request is
     scoring against."""
 
-    __slots__ = ("machines", "single", "engine", "_inflight", "_cond")
+    __slots__ = ("machines", "single", "engine", "lazy_names",
+                 "_inflight", "_cond")
 
     def __init__(
         self,
         machines: Dict[str, _Machine],
         shard_fleet: bool = False,
         compile_cache=None,
+        lazy_loaders: Optional[Dict[str, Any]] = None,
     ):
         self._inflight = 0
         self._cond = lockcheck.named_condition("server.state_cond")
         self.machines = machines
+        # lazy fleet (§22): machines known from the FLEET_INDEX sidecar
+        # but not materialized — the engine loads them through the
+        # host-RAM spill tier on first touch
+        lazy_loaders = lazy_loaders or {}
+        self.lazy_names = frozenset(lazy_loaders)
         self.single = (
-            next(iter(machines.values())) if len(machines) == 1 else None
+            next(iter(machines.values()))
+            if len(machines) == 1 and not lazy_loaders
+            else None
         )
         mesh = None
         if shard_fleet:
@@ -298,7 +309,17 @@ class _ServerState:
             # adopting a generation — boot, /reload, rollback — is
             # O(load) against a warmed store (ARCHITECTURE §14)
             compile_cache=compile_cache,
+            # host-RAM spill tier (§22): lazily-indexed machines load on
+            # first touch through the byte-bounded host cache
+            lazy=lazy_loaders,
         )
+        if lazy_loaders:
+            logger.info(
+                "Lazy fleet boot: %d machine(s) eager, %d lazy behind "
+                "the host-RAM spill tier (GORDO_HOST_CACHE_MB=%d)",
+                len(machines), len(lazy_loaders),
+                self.engine.host_cache_mb,
+            )
         # cross-machine megabatching (ARCHITECTURE §15): env-resolved in
         # the engine (GORDO_MEGABATCH / GORDO_FILL_WINDOW_US /
         # GORDO_MEGABATCH_RESIDENCY); logged at boot so an operator can
@@ -370,6 +391,7 @@ class ModelServer:
         drain_timeout: float = 10.0,
         compile_cache_store: Optional[str] = None,
         worker_id: Optional[int] = None,
+        lazy_boot: Optional[bool] = None,
     ):
         """``models_root``: optional directory whose immediate subdirs are
         model dirs; enables ``POST /reload`` so machines built AFTER server
@@ -397,6 +419,12 @@ class ModelServer:
         standalone. Workers stamp every response ``X-Gordo-Worker`` and
         report the id on ``/healthz`` so the router (and its smoke
         tests) can verify WHICH process answered.
+
+        ``lazy_boot``: boot from ``models_root``'s ``FLEET_INDEX.json``
+        sidecar (§22) — O(index read) instead of O(load the fleet); a
+        small eager subset materializes, the rest serves through the
+        host-RAM spill tier with artifact verification on first touch.
+        Default: the ``GORDO_LAZY_BOOT`` env var, else off.
         """
         from ..compile_cache import resolve_store
 
@@ -424,6 +452,20 @@ class ModelServer:
         # reference's crash-looping pod that heals when its artifact is
         # rebuilt
         self._quarantined_dirs: Dict[str, str] = {}
+        # lazy fleet boot (§22): with a FLEET_INDEX sidecar at
+        # models_root, boot is O(read the index) — the index names the
+        # fleet, a small eager subset (GORDO_BOOT_EAGER) materializes
+        # now, and everything else loads through the host-RAM spill tier
+        # on first touch, artifact verification included. Opt-in
+        # (GORDO_LAZY_BOOT / --lazy-boot / lazy_boot=True): an eager boot
+        # of a small fleet stays exactly as before.
+        if lazy_boot is None:
+            lazy_boot = os.environ.get(
+                "GORDO_LAZY_BOOT", "0"
+            ).strip().lower() in ("1", "true", "on", "yes")
+        self.lazy_boot = bool(lazy_boot) and bool(models_root)
+        lazy_dirs: Dict[str, str] = {}
+        lazy_gens: Dict[str, Any] = {}
         if isinstance(model_dirs, str):
             # single-model mode: nothing to degrade to — a broken dir is
             # a startup error, exactly as before
@@ -431,6 +473,24 @@ class ModelServer:
             machine.name = machine.metadata.get("name", "default")
             machines = {machine.name: machine}
         else:
+            model_dirs = dict(model_dirs)
+            if self.lazy_boot:
+                eager_dirs, lazy_dirs, lazy_gens = self._lazy_partition(
+                    models_root
+                )
+                if eager_dirs is None:
+                    # no (readable) index: fall back to the eager scan —
+                    # the caller's resolved dirs, or a fresh scan when an
+                    # index-driven boot passed none (a damaged index must
+                    # never make a fleet unbootable)
+                    self.lazy_boot = False
+                    if not model_dirs:
+                        model_dirs = scan_models_root(models_root)
+                else:
+                    for name, path in eager_dirs.items():
+                        model_dirs.setdefault(name, path)
+                    for name in model_dirs:
+                        lazy_dirs.pop(name, None)
             machines = {}
             for name, path in model_dirs.items():
                 try:
@@ -443,13 +503,23 @@ class ModelServer:
                         name, f"{type(exc).__name__}: {exc}", "load"
                     )
                     self._quarantined_dirs[name] = path
-            if not machines:
+            if not machines and not lazy_dirs:
                 raise ValueError(
                     "No machine loaded successfully; quarantined: "
                     f"{sorted(self._quarantined_dirs)}"
                 )
         self.project = project
         self.models_root = models_root
+        # the lazy half of the fleet: name -> model dir, re-read from the
+        # index on reload; loaders are built fresh per state generation.
+        # _lazy_gens remembers each lazy machine's index `generation` —
+        # reload compares it against the fresh index and DROPS changed
+        # machines from the host cache, so a rebuilt lazy artifact can
+        # never keep serving its stale cached spill bundle (§22)
+        self._lazy_dirs: Dict[str, str] = lazy_dirs
+        self._lazy_gens: Dict[str, Any] = {
+            name: lazy_gens.get(name) for name in lazy_dirs
+        }
         # explicitly-registered machines survive every rescan, whatever
         # directory they live in (a reload must not drop --model-dir
         # machines that sit outside models_root, or rename ones registered
@@ -459,6 +529,7 @@ class ModelServer:
         self._state = _ServerState(
             machines, shard_fleet=shard_fleet,
             compile_cache=self.compile_cache,
+            lazy_loaders=self._lazy_loaders(),
         )
         # SLO engine (§18): declared objectives over the request
         # histograms this server already records, evaluated by
@@ -557,7 +628,37 @@ class ModelServer:
             )
         with self._reload_lock:
             state = self._state
-            seen = scan_models_root(self.models_root)
+            new_lazy: Dict[str, str] = {}
+            new_lazy_gens: Dict[str, Any] = {}
+            if self.lazy_boot:
+                eager_dirs, lazy_index, new_lazy_gens = (
+                    self._lazy_partition(self.models_root)
+                )
+                if eager_dirs is None:
+                    # the index vanished: this reload degrades to the full
+                    # scan (and this server to an eager fleet) rather than
+                    # failing — same never-unbootable rule as boot
+                    logger.warning(
+                        "Lazy reload: no readable FLEET_INDEX at %s; "
+                        "degrading to a full scan", self.models_root,
+                    )
+                    self.lazy_boot = False
+                    seen = scan_models_root(self.models_root)
+                else:
+                    # index-driven rescan, O(index + eager): machines
+                    # already materialized stay eager (their mtime check
+                    # below spots rebuilds); everything else stays behind
+                    # the spill tier — first touch verifies
+                    seen = {}
+                    for name in state.machines:
+                        if name in lazy_index:
+                            seen[name] = lazy_index.pop(name)
+                        elif name in eager_dirs:
+                            seen[name] = eager_dirs.pop(name)
+                    seen.update(eager_dirs)
+                    new_lazy = lazy_index
+            else:
+                seen = scan_models_root(self.models_root)
             pinned_paths = {
                 os.path.realpath(m.model_dir) for m in self._pinned.values()
             }
@@ -634,7 +735,38 @@ class ModelServer:
                 self._quarantined_dirs.pop(name, None)
                 self.quarantine.recover(name)
             removed = sorted(set(state.machines) - set(machines))
+            # §22: lazy membership changes (index grew/shrank) also swap
+            # the generation — names report in added/removed like eager
+            # ones, total counts both halves of the fleet
+            lazy_added = sorted(
+                name for name in new_lazy
+                if name not in state.lazy_names and name not in machines
+            )
+            lazy_removed = sorted(
+                name for name in state.lazy_names
+                if name not in new_lazy and name not in machines
+            )
+            added.extend(lazy_added)
+            removed = sorted(set(removed) | set(lazy_removed))
+            # §22 staleness: a lazy machine whose index `generation`
+            # moved was REBUILT — its cached spill bundle (and parked
+            # _Machine) hold the old generation's bytes. Dropping it
+            # here makes the next touch pay the verified store path,
+            # which resolves CURRENT fresh; O(index), no artifact I/O.
+            # (The contract this rides: a fleet rebuild refreshes the
+            # index — write_fleet_index — exactly like it bumps CURRENT.)
+            lazy_refreshed = sorted(
+                name for name in new_lazy
+                if name in state.lazy_names
+                and self._lazy_gens.get(name) != new_lazy_gens.get(name)
+            )
+            for name in lazy_refreshed:
+                state.engine.host_cache.drop(name)
+            self._lazy_gens = {
+                name: new_lazy_gens.get(name) for name in new_lazy
+            }
             if added or removed or refreshed:
+                self._lazy_dirs = new_lazy
                 # same compile cache as boot: the new generation's warm-up
                 # below loads executables instead of compiling them, so a
                 # reload (or a rollback adopted via reload) pays zero
@@ -642,6 +774,7 @@ class ModelServer:
                 new_state = _ServerState(
                     machines, shard_fleet=self.shard_fleet,
                     compile_cache=self.compile_cache,
+                    lazy_loaders=self._lazy_loaders(),
                 )
                 # warm new/changed bucket programs BEFORE publishing the
                 # generation: the old state serves meanwhile, so no request
@@ -682,9 +815,12 @@ class ModelServer:
             return {
                 "added": sorted(added),
                 "removed": removed,
-                "refreshed": sorted(refreshed),
+                # lazy generation moves report as refreshed too — they
+                # changed what the next request serves, without costing
+                # an engine swap (the host-cache drop is the refresh)
+                "refreshed": sorted(set(refreshed) | set(lazy_refreshed)),
                 "errors": errors,
-                "total": len(machines),
+                "total": len(machines) + len(new_lazy),
             }
 
     @staticmethod
@@ -693,6 +829,107 @@ class ModelServer:
             state.engine.warmup()
         except Exception:  # warm-up is best-effort; scoring still compiles
             logger.warning("Post-reload engine warm-up failed", exc_info=True)
+
+    # -- lazy fleet boot + host-RAM spill tier (§22) --------------------------
+    def _lazy_partition(self, models_root: str):
+        """FLEET_INDEX-driven boot partition: ``(eager_dirs, lazy_dirs,
+        lazy_gens)`` from the index sidecar, or ``(None, {}, {})`` when
+        there is no readable index (callers fall back to the eager scan
+        — a damaged or absent index must never make a fleet
+        unbootable). The first ``GORDO_BOOT_EAGER`` machines (index
+        order = sorted names) materialize now — they warm the common
+        architecture's programs — and the rest serve lazily through the
+        host-RAM spill tier, each artifact verified on its first touch
+        instead of at boot. ``lazy_gens`` carries every index name's
+        ``generation`` field — reload's O(index) staleness signal for
+        the lazy half (eager machines get the mtime check instead)."""
+        index = store_generations.read_fleet_index(models_root)
+        if index is None:
+            return None, {}, {}
+        try:
+            eager_n = int(os.environ.get("GORDO_BOOT_EAGER", "0"))
+        except ValueError:
+            eager_n = 0
+        eager: Dict[str, str] = {}
+        lazy: Dict[str, str] = {}
+        gens: Dict[str, Any] = {}
+        for name in sorted(index):
+            entry = index[name] if isinstance(index[name], dict) else {}
+            path = os.path.join(models_root, entry.get("path") or name)
+            gens[name] = entry.get("generation")
+            if len(eager) < eager_n:
+                eager[name] = path
+            else:
+                lazy[name] = path
+        return eager, lazy, gens
+
+    def _lazy_loaders(self) -> Dict[str, Any]:
+        """Fresh loader closures for the current lazy set — stateless, so
+        each state generation gets its own dict (and its own host cache:
+        a reload's new engine starts cold, which is exactly the staleness
+        story — no dropped-generation bytes can be served)."""
+        return {
+            name: self._lazy_loader(name, path)
+            for name, path in self._lazy_dirs.items()
+        }
+
+    @staticmethod
+    def _lazy_loader(name: str, path: str):
+        def load_lazy() -> Dict[str, Any]:
+            # the store path the spill tier fronts: _Machine verifies the
+            # manifest BEFORE deserializing (first-touch verification —
+            # the lazy boot skipped it), then the engine lifts the model
+            # into its host entry tree. The _Machine itself parks in the
+            # bundle as opaque context so metadata endpoints serve
+            # without a second deserialize; eviction drops both.
+            machine = _Machine(name, path)
+            nbytes = 0
+            try:
+                artifact = store_generations.resolve_artifact_dir(path)
+                with os.scandir(artifact) as entries:
+                    nbytes = sum(
+                        e.stat().st_size for e in entries if e.is_file()
+                    )
+            except OSError:
+                pass
+            return {
+                "model": machine.model,
+                "target_cols": machine.target_columns,
+                "precision": machine.precision,
+                "quantized": machine.quantized,
+                "context": machine,
+                # footprint hint for host-only bundles (the engine
+                # measures liftable ones off their stacked tree)
+                "nbytes": nbytes,
+            }
+
+        return load_lazy
+
+    def _materialize_lazy(self, name: str, state: _ServerState) -> _Machine:
+        """A lazy machine's ``_Machine``, through the spill tier (host
+        cache hit = free; miss = the verified store path). Load failures
+        quarantine exactly like an eager boot failure would — with the
+        same probe-based recovery, since the artifact may be rebuilt."""
+        probing = False
+        if self.quarantine.is_quarantined(name):
+            if not self.quarantine.probe_allowed(name):
+                self._abort_quarantined(name)
+            probing = True
+            logger.info("Quarantine recovery probe (lazy load) for %r", name)
+        try:
+            bundle = state.engine.spill_bundle(name)
+        except HTTPException:
+            raise
+        except Exception as exc:
+            logger.exception("Lazy materialization of %r failed", name)
+            self.quarantine.quarantine(
+                name, f"{type(exc).__name__}: {exc}", "load"
+            )
+            self._abort_quarantined(name)
+        if probing:
+            self.quarantine.recover(name)
+            logger.info("Machine %r recovered from quarantine", name)
+        return bundle["context"]
 
     def quiesce(self, drain_timeout: Optional[float] = None) -> bool:
         """Graceful-shutdown sequence (SIGTERM → here → exit): close the
@@ -867,6 +1104,11 @@ class ModelServer:
         try:
             return state.machines[name]
         except KeyError:
+            if name in state.lazy_names:
+                # spill tier (§22): known from the fleet index but not
+                # materialized — first touch loads (and verifies) it
+                # through the host cache
+                return self._materialize_lazy(name, state)
             if self.quarantine.is_quarantined(name):
                 # the machine EXISTS but failed to load: 503 (try later),
                 # not 404 (never heard of it) — a watchman probing this
@@ -891,6 +1133,41 @@ class ModelServer:
             if args.get("machine") is not None:
                 # machine-scoped health: 404 if absent, 503 if quarantined
                 name = args["machine"]
+                if (
+                    name in state.lazy_names
+                    and name not in state.machines
+                    and not self.quarantine.is_quarantined(name)
+                ):
+                    # spill tier (§22): a healthz probe must NOT force a
+                    # store load (a watchman sweeping 100k machines would
+                    # thrash the tier) — report off the host cache when
+                    # the bundle is resident, else just "lazy"
+                    bundle = state.engine.host_cache.peek(name)
+                    if bundle is not None:
+                        served = bundle["context"]
+                        return _json(
+                            {
+                                "ok": True,
+                                "status": "ok",
+                                "lazy": True,
+                                "resident": True,
+                                "generation": served.generation,
+                                "verified": True,
+                                "precision": served.precision,
+                            }
+                        )
+                    return _json(
+                        {
+                            "ok": True,
+                            "status": "lazy",
+                            "lazy": True,
+                            "resident": False,
+                            "generation": None,
+                            # verified on first touch, not yet touched
+                            "verified": None,
+                            "precision": None,
+                        }
+                    )
                 if self.quarantine.is_quarantined(name):
                     return _json(
                         {
@@ -927,7 +1204,10 @@ class ModelServer:
             quarantined = self.quarantine.quarantined()
             suspects = self.quarantine.suspects()
             draining = self.admission.closed is not None
-            ready = len(state.machines) > 0 and not draining
+            ready = (
+                len(state.machines) + len(state.lazy_names) > 0
+                and not draining
+            )
             degraded = bool(quarantined or suspects)
             return _json(
                 {
@@ -947,6 +1227,9 @@ class ModelServer:
                     # name what would be rolled back by `gordo rollback`
                     "store": {
                         "verified": len(state.machines),
+                        # §22: machines the index names that have not been
+                        # touched (verification deferred to first touch)
+                        "lazy": len(state.lazy_names),
                         "unverified": sorted(self._quarantined_dirs),
                         "generations": {
                             name: machine.generation
@@ -1033,7 +1316,28 @@ class ModelServer:
                 return _json(recorded.to_chrome_trace())
             return _json(recorded.to_dict())
         if endpoint == "models":
-            return _json({"project": self.project, "models": sorted(state.machines)})
+            return _json(
+                {
+                    "project": self.project,
+                    "models": sorted(
+                        set(state.machines) | state.lazy_names
+                    ),
+                }
+            )
+        if endpoint == "prefetch":
+            # placement hint (§22): queue async host-cache loads for lazy
+            # machines the caller expects to land here. Advisory — the
+            # response says what was queued, nothing blocks on the loads.
+            if request.method != "POST":
+                _abort(405, "POST required")
+            try:
+                payload = json.loads(request.get_data(as_text=True) or "{}")
+            except json.JSONDecodeError:
+                _abort(400, "Request body is not valid JSON")
+            names = payload.get("machines")
+            if not isinstance(names, list):
+                _abort(400, 'Payload must contain "machines": [...]')
+            return _json(state.engine.prefetch([str(n) for n in names]))
         if endpoint == "reload":
             if request.method != "POST":
                 _abort(405, "POST required")
@@ -1222,7 +1526,10 @@ class ModelServer:
         def run():
             with spans.stage("score", machine=machine.name):
                 if state.engine.can_score(machine.name):
-                    return state.engine.predict(machine.name, X)
+                    try:
+                        return state.engine.predict(machine.name, X)
+                    except SpillNotLiftable:
+                        pass  # §22: host path, as an eager boot would
                 deadline.check("server.predict")
                 return machine.model.predict(X)
 
@@ -1378,7 +1685,12 @@ class ModelServer:
         it; a host-path machine shows a flat score span)."""
         with spans.stage("score", machine=machine.name):
             if state.engine.can_score(machine.name):
-                return state.engine.anomaly(machine.name, X)
+                try:
+                    return state.engine.anomaly(machine.name, X)
+                except SpillNotLiftable:
+                    # lazy machine the engine can't lift (§22): score it
+                    # through the same host path an eager boot would use
+                    pass
             # host path: the engine's own pre-dispatch deadline check
             # doesn't cover these machines, so gate here before the slow
             # scoring
@@ -1474,6 +1786,7 @@ def build_app(
     quarantine_cooldown: float = 30.0,
     compile_cache_store: Optional[str] = None,
     worker_id: Optional[int] = None,
+    lazy_boot: Optional[bool] = None,
 ) -> ModelServer:
     """App factory (reference: ``server.build_app``)."""
     return ModelServer(
@@ -1482,6 +1795,7 @@ def build_app(
         quarantine_cooldown=quarantine_cooldown,
         compile_cache_store=compile_cache_store,
         worker_id=worker_id,
+        lazy_boot=lazy_boot,
     )
 
 
@@ -1496,6 +1810,7 @@ def run_server(
     max_inflight: Optional[int] = None,
     compile_cache_store: Optional[str] = None,
     worker_id: Optional[int] = None,
+    lazy_boot: Optional[bool] = None,
 ) -> None:
     """Serve with werkzeug's multithreaded server.
 
@@ -1530,6 +1845,7 @@ def run_server(
         model_dirs, project=project, models_root=models_root,
         shard_fleet=shard_fleet, max_inflight=max_inflight,
         compile_cache_store=compile_cache_store, worker_id=worker_id,
+        lazy_boot=lazy_boot,
     )
     # warm each bucket's scoring program BEFORE accepting traffic: the
     # first request must pay dispatch (ms), not XLA compile (tens of s).

@@ -38,6 +38,14 @@ logger = logging.getLogger(__name__)
 GEN_PREFIX = "gen-"
 CURRENT_FILE = "CURRENT"
 KEEP_GENERATIONS_ENV = "GORDO_STORE_KEEP_GENERATIONS"
+# generation-level fleet index sidecar (ARCHITECTURE §22): one JSON file
+# at the MODELS ROOT naming every machine dir + its current generation,
+# so a 100k-machine server boot is O(read this file), not O(scan +
+# verify + deserialize the fleet). Per-machine artifacts are verified on
+# first touch instead; a stale index entry surfaces there as the usual
+# typed store error, never as silently-wrong bytes.
+FLEET_INDEX_FILE = "FLEET_INDEX.json"
+FLEET_INDEX_VERSION = 1
 _GEN_RE = re.compile(r"^gen-(\d{4,})$")
 
 _M_ROLLBACKS = REGISTRY.counter(
@@ -122,6 +130,7 @@ def commit_generation(
     write_fn: Callable[[str], Any],
     name: Optional[str] = None,
     keep: Optional[int] = None,
+    manifest: Optional[Dict[str, Any]] = None,
 ) -> str:
     """Write a new generation under ``root`` and adopt it: ``write_fn``
     fills a staging dir, the atomic-commit machinery manifests and
@@ -131,14 +140,16 @@ def commit_generation(
     ``keep`` bounds retained generations (newest kept; default from
     ``GORDO_STORE_KEEP_GENERATIONS``, else 3 — always ≥ 2 so one
     rollback target survives). ``name`` targets the ``store-commit``
-    fault seam (pass the machine name)."""
+    fault seam (pass the machine name). ``manifest`` is the
+    manifest-batching seam: a precomputed payload reused across
+    byte-identical bulk commits (see ``atomic_commit``)."""
     if keep is None:
         keep = int(os.environ.get(KEEP_GENERATIONS_ENV, "3"))
     keep = max(2, keep)
     os.makedirs(root, exist_ok=True)
     gen_name = next_generation_name(root)
     gen_dir = os.path.join(root, gen_name)
-    with atomic_commit(gen_dir, name=name) as staging:
+    with atomic_commit(gen_dir, name=name, manifest=manifest) as staging:
         write_fn(staging)
     _swap_current(root, gen_name)
     _prune(root, keep)
@@ -201,6 +212,102 @@ def rollback_generation(root: str) -> str:
         f"{root}: no previous generation verifies (current {current}, "
         f"candidates {previous or 'none'})"
     )
+
+
+# -- fleet index sidecar (ARCHITECTURE §22) ----------------------------------
+def write_fleet_index(
+    models_root: str, machines: Dict[str, Dict[str, Any]]
+) -> str:
+    """Atomically write ``FLEET_INDEX.json`` at ``models_root``.
+
+    ``machines``: ``{name: {"path": <relpath>, "generation": <gen|None>,
+    "precision": <str|None>}}`` — the boot-relevant facts only. The index
+    is ADVISORY: a lazy boot trusts it for the machine LIST and verifies
+    each artifact on first touch, so a stale entry costs one quarantined
+    machine, never wrong bytes."""
+    import json
+
+    payload = {
+        "format_version": FLEET_INDEX_VERSION,
+        "count": len(machines),
+        "machines": {
+            name: {
+                "path": entry.get("path", name),
+                "generation": entry.get("generation"),
+                "precision": entry.get("precision"),
+            }
+            for name, entry in sorted(machines.items())
+        },
+    }
+    path = os.path.join(models_root, FLEET_INDEX_FILE)
+    atomic_write_file(path, json.dumps(payload, indent=1, sort_keys=True))
+    return path
+
+
+def read_fleet_index(models_root: str) -> Optional[Dict[str, Dict[str, Any]]]:
+    """The index's machine table, or ``None`` when absent/unreadable/
+    wrong-version (callers fall back to the full scan — a damaged index
+    must never make a fleet unbootable)."""
+    import json
+
+    path = os.path.join(models_root, FLEET_INDEX_FILE)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError) as exc:
+        logger.warning("Unreadable %s (%s); falling back to scan", path, exc)
+        return None
+    if (
+        not isinstance(payload, dict)
+        or payload.get("format_version") != FLEET_INDEX_VERSION
+        or not isinstance(payload.get("machines"), dict)
+    ):
+        logger.warning(
+            "%s is not a version-%d fleet index; falling back to scan",
+            path, FLEET_INDEX_VERSION,
+        )
+        return None
+    return payload["machines"]
+
+
+def is_artifact_dir(path: str) -> bool:
+    """THE artifact-dir rule: a generation root (``CURRENT`` pointer) or
+    a flat legacy dir (``definition.json``). ONE predicate shared by the
+    server's ``scan_models_root`` and :func:`build_fleet_index`, so the
+    eager scan and the index can never disagree about what counts as a
+    fleet member. (Hidden-dir skipping belongs to the models-root
+    LISTING, not to this per-dir rule — both callers apply it.)"""
+    return is_generation_root(path) or os.path.exists(
+        os.path.join(path, "definition.json")
+    )
+
+
+def build_fleet_index(models_root: str) -> Dict[str, Dict[str, Any]]:
+    """The one-time O(fleet) pass an index write needs: every immediate
+    subdir that passes :func:`is_artifact_dir` — the server's scan rule,
+    shared by construction — with its current generation."""
+    machines: Dict[str, Dict[str, Any]] = {}
+    try:
+        entries = sorted(os.listdir(models_root))
+    except OSError:
+        return machines
+    for entry in entries:
+        path = os.path.join(models_root, entry)
+        if entry.startswith(".") or not os.path.isdir(path):
+            continue
+        if not is_artifact_dir(path):
+            continue
+        if is_generation_root(path):
+            try:
+                gen = current_generation(path)
+            except ArtifactIncomplete:
+                gen = None  # torn pointer: listed, quarantines at touch
+            machines[entry] = {"path": entry, "generation": gen}
+        else:
+            machines[entry] = {"path": entry, "generation": None}
+    return machines
 
 
 def artifact_status(path: str) -> Dict[str, Any]:

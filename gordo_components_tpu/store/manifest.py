@@ -26,10 +26,20 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 from ..observability.registry import REGISTRY
 from .errors import ArtifactCorrupt, ArtifactIncomplete, ManifestMissing
+
+
+def fsync_enabled() -> bool:
+    """``GORDO_STORE_FSYNC=0`` disables commit-path fsyncs (durability
+    escape hatch for bulk synthetic-fleet generation; atomicity is kept).
+    Lives here rather than ``atomic.py`` because that module imports this
+    one; ``atomic.fsync_enabled`` re-exports it."""
+    return os.environ.get(
+        "GORDO_STORE_FSYNC", "1"
+    ).strip().lower() not in ("0", "false", "off", "no")
 
 MANIFEST_FILE = "MANIFEST.json"
 FORMAT_VERSION = 1
@@ -75,14 +85,41 @@ def render_manifest(payload: Dict[str, Any]) -> bytes:
     return (json.dumps(payload, indent=2, sort_keys=True) + "\n").encode()
 
 
-def write_manifest(artifact_dir: str, fsync: bool = True) -> Dict[str, Any]:
+def write_manifest(
+    artifact_dir: str,
+    fsync: bool = True,
+    payload: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
     """Hash the directory's files and write ``MANIFEST.json`` beside them
-    (fsync'd by default — the manifest is the commit record)."""
-    payload = manifest_for_dir(artifact_dir)
+    (fsync'd by default — the manifest is the commit record).
+
+    ``payload``: optional precomputed manifest (manifest batching — see
+    ``atomic_commit``). It is checked STRUCTURALLY against the directory
+    (same file names, same sizes) before being written; a mismatch raises
+    :class:`ArtifactIncomplete` — a batched manifest that disagrees with
+    the staged bytes must abort the commit, never publish a lie."""
+    if payload is None:
+        payload = manifest_for_dir(artifact_dir)
+    else:
+        staged = {
+            entry.name: entry.stat().st_size
+            for entry in os.scandir(artifact_dir)
+            if entry.is_file() and entry.name != MANIFEST_FILE
+        }
+        declared = {
+            name: entry.get("size")
+            for name, entry in payload.get("files", {}).items()
+        }
+        if staged != declared:
+            raise ArtifactIncomplete(
+                f"{artifact_dir}: precomputed manifest disagrees with the "
+                f"staged files (staged {sorted(staged)} sizes vs declared "
+                f"{sorted(declared)})"
+            )
     path = os.path.join(artifact_dir, MANIFEST_FILE)
     with open(path, "wb") as fh:
         fh.write(render_manifest(payload))
-        if fsync:
+        if fsync and fsync_enabled():
             fh.flush()
             os.fsync(fh.fileno())
     return payload
