@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke smoke images builder-image server-image watchman-image
 
 # invariant linter (docs/ARCHITECTURE.md §17/§21): lock discipline
 # against the declared hierarchy, blocking-calls-under-hot-locks,
@@ -126,6 +126,15 @@ autopilot-smoke:
 capacity-smoke:
 	JAX_PLATFORMS=cpu python tools/capacity_smoke.py
 
+# multi-host mesh serving check (§23): a 6-machine fleet sharded across
+# a 2-process serving mesh — layout-routed scoring byte-identical (f32)
+# to the single-host reference, SIGKILL of one shard host degrading to
+# the surviving shard's spill fallback rung with ZERO client-visible
+# errors, and a warm re-boot of the same layout paying ZERO fresh XLA
+# compiles through the shared compile-cache store
+mesh-smoke:
+	JAX_PLATFORMS=cpu python tools/mesh_smoke.py
+
 # the full smoke battery: invariant lint + exposition + resilience +
 # store integrity + serving data plane + span attribution + cold-start
 # economics + cross-machine megabatching + the horizontal serving tier
@@ -134,7 +143,8 @@ capacity-smoke:
 # + the closed-loop autopilot (convergence / journal / elastic tier)
 # + the fleet-scale hot paths (index boot / spill tier / placement /
 #   bounded scrape)
-smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke
+# + multi-host mesh serving (layout routing / fallback rung / warm boots)
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke
 
 images: builder-image server-image watchman-image
 

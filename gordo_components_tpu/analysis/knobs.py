@@ -86,6 +86,22 @@ KNOBS: Dict[str, Knob] = dict(
               "lazy fleet boot: machines (index order) materialized "
               "eagerly at boot to warm the common architecture's "
               "programs; the rest stay behind the spill tier", "serving"),
+        # -- mesh serving (§23) ------------------------------------------
+        _knob("GORDO_MESH_SHARDS", "0", "int",
+              "multi-host serving mesh (§23): total shard count the "
+              "stacked fleet partitions across by ring position; 0 = "
+              "single-host serving (`--mesh-shards` on `run-server` / "
+              "`run-fleet-server`)", "serving"),
+        _knob("GORDO_MESH_SHARD", "worker-id mod shards", "int",
+              "mesh serving: THIS process's shard id (0-based); each "
+              "shard stacks only its owned machines and serves the rest "
+              "through the spill fallback rung (`--mesh-shard` on "
+              "`run-server`)", "serving"),
+        _knob("GORDO_MESH_MIN_SHARD_MACHINES", "2×shards", "int",
+              "mesh serving's declared layout policy: fleets smaller "
+              "than this stay replicated on every shard (the cross-host "
+              "split would cost more than it frees); larger fleets "
+              "shard by ring position", "serving"),
         # -- compile caches ----------------------------------------------
         _knob("GORDO_COMPILE_CACHE", "~/.cache/gordo-tpu/jax-compile",
               "path",

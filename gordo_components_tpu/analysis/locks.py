@@ -31,6 +31,7 @@ LOCK_RANKS: Dict[str, int] = {
     "server.reload": 10,        # server.py _reload_lock: one reload at a time
     "autopilot.state": 12,      # controller.py _lock: tick/decision state
     "autopilot.elastic": 13,    # elastic.py _lock: one scale op at a time
+    "parallel.shard_plan": 14,  # shard_plan.py plan cache (boot/reload/router)
     "router.op": 15,            # rollout.py _op_lock: one rollout/rollback
     "server.admission": 20,     # admission.py gate condition
     "server.state_cond": 25,    # server.py _ServerState in-flight tracking
@@ -102,6 +103,7 @@ LOCK_ATTRS: Dict[Tuple[str, str], str] = {
     ("observability/slo.py", "_lock"): "observability.slo",
     ("autopilot/controller.py", "_lock"): "autopilot.state",
     ("autopilot/elastic.py", "_lock"): "autopilot.elastic",
+    ("parallel/shard_plan.py", "_PLAN_LOCK"): "parallel.shard_plan",
     ("router/rollout.py", "_op_lock"): "router.op",
     ("router/rollout.py", "_lock"): "router.rollout_state",
     ("router/placement.py", "_lock"): "router.placement",
@@ -146,6 +148,10 @@ GUARDED_FIELDS: Dict[Tuple[str, str], str] = {
     ("router/placement.py", "_rates"): "router.placement",
     ("router/placement.py", "_rotation"): "router.placement",
     ("router/placement.py", "_hot"): "router.placement",
+    # mesh serving (§23): worker→shard table the candidate walk reorders
+    # by, and the process-wide layout-plan cache
+    ("router/placement.py", "_worker_shards"): "router.placement",
+    ("parallel/shard_plan.py", "_PLAN_CACHE"): "parallel.shard_plan",
     ("router/workers.py", "_workers"): "router.workers",
     ("router/workers.py", "_respawns"): "router.workers",
     # SLO burn-rate history + breach edge state (§18)

@@ -32,7 +32,7 @@ CHECKER = "metrics-conventions"
 COMPONENTS = (
     "server", "engine", "client", "build", "builds", "fleet", "watchman",
     "router", "resilience", "store", "compile_cache", "span", "stage",
-    "drift", "lint", "slo", "autopilot",
+    "drift", "lint", "slo", "autopilot", "mesh",
 )
 
 # §7 label allowlist: low-cardinality enums only. ``machine``/``worker``/
@@ -40,12 +40,13 @@ COMPONENTS = (
 # ``window`` is the two-value fast/slow burn-rate window enum (§18).
 # ``precision`` is the three-value f32/bf16/int8 ladder enum (§19).
 # ``actuator``/``direction`` are the autopilot's decision enums (§20).
+# ``shard`` is bounded by the serving mesh's shard count (§23).
 ALLOWED_LABELS = frozenset(
     {
         "endpoint", "status", "kind", "outcome", "path", "event", "phase",
         "reason", "stage", "name", "trigger", "format", "worker",
         "machine", "target", "cause", "point", "to", "where", "error",
-        "window", "precision", "actuator", "direction",
+        "window", "precision", "actuator", "direction", "shard",
     }
 )
 

@@ -85,6 +85,13 @@ HEADERS: Dict[str, HeaderSpec] = {
             response=True,
         ),
         HeaderSpec(
+            "X-Gordo-Shard",
+            "which mesh shard answered — the owner in steady state; a "
+            "different shard means the spill fallback rung served a "
+            "dead owner's machine (§23)",
+            response=True,
+        ),
+        HeaderSpec(
             "X-Gordo-Timeline",
             "request: router negotiates timeline capture (stamps '1'); "
             "response: base64(JSON) encoded timeline, size-capped (§18)",

@@ -150,6 +150,9 @@ class ElasticWorkers:
             # placement can choose the retiree (in-flight forwards finish
             # against a still-serving process)
             self.placement.remove_worker(name)
+            set_shard = getattr(self.placement, "set_worker_shard", None)
+            if callable(set_shard):
+                set_shard(name, None)
             thread = threading.Thread(
                 target=self._retire_op, args=(name,),
                 name="gordo-autopilot-scale", daemon=True,
@@ -180,6 +183,14 @@ class ElasticWorkers:
             # ring-join LAST: traffic may now land on a proven-ready
             # worker (bounded key movement steals ~1/N of each incumbent)
             self.placement.add_worker(spec.name)
+            shard_for = getattr(self.placement, "mesh_shard_for", None)
+            set_shard = getattr(self.placement, "set_worker_shard", None)
+            if callable(shard_for) and callable(set_shard):
+                shard = shard_for(spec.worker_id)
+                if shard is not None:
+                    # §23: mesh routers record the new worker's shard so
+                    # the candidate walk prefers it for its owned machines
+                    set_shard(spec.name, shard)
             self._finish_op("spawned", spec.name)
         except Exception:
             logger.exception("Elastic spawn of %s failed", spec.name)

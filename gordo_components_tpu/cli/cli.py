@@ -574,10 +574,22 @@ def cache_purge_cmd(store_dir, stale_only):
                    "tier (GORDO_HOST_CACHE_MB) with artifact verification "
                    "on first touch. Requires --models-dir. Overrides "
                    "GORDO_LAZY_BOOT")
+@click.option("--mesh-shards", default=None, type=int,
+              envvar="GORDO_MESH_SHARDS",
+              help="multi-host mesh serving (§23): total shard count the "
+                   "fleet's stacked machine axis partitions across by "
+                   "ring position; this process stacks only its owned "
+                   "slice and serves the rest via the spill fallback "
+                   "rung. 0/unset = single-host serving")
+@click.option("--mesh-shard", default=None, type=int,
+              envvar="GORDO_MESH_SHARD",
+              help="this process's shard id (0-based) in the "
+                   "--mesh-shards mesh; defaults to worker-id mod shards")
 @_TRACE_DIR_OPT
 def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
                    max_inflight, faults, compile_cache_store, megabatch,
-                   fill_window_us, worker_id, lazy_boot, trace_dir):
+                   fill_window_us, worker_id, lazy_boot, mesh_shards,
+                   mesh_shard, trace_dir):
     """Serve built model(s) over REST."""
     import os
 
@@ -590,6 +602,12 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
         os.environ["GORDO_MEGABATCH"] = "1" if megabatch else "0"
     if fill_window_us is not None:
         os.environ["GORDO_FILL_WINDOW_US"] = str(fill_window_us)
+    # §23: exported so every /reload generation re-derives the SAME
+    # shard partition this boot used
+    if mesh_shards is not None:
+        os.environ["GORDO_MESH_SHARDS"] = str(mesh_shards)
+    if mesh_shard is not None:
+        os.environ["GORDO_MESH_SHARD"] = str(mesh_shard)
     if lazy_boot is not None:
         os.environ["GORDO_LAZY_BOOT"] = "1" if lazy_boot else "0"
     if lazy_boot is None:
@@ -684,9 +702,17 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
               help="forwarded to every worker (see run-server)")
 @click.option("--max-inflight", default=None, type=int,
               help="per-WORKER admission bound (see run-server)")
+@click.option("--mesh-shards", default=0, show_default=True, type=int,
+              envvar="GORDO_MESH_SHARDS",
+              help="multi-host mesh serving (§23): partition the fleet's "
+                   "stacked machine axis across this many shards — "
+                   "worker i serves shard i mod shards and the router "
+                   "prefers each machine's owning shard (falls back to "
+                   "any worker's spill tier if the owner dies). 0 = the "
+                   "replicated tier exactly as before")
 def run_fleet_server_cmd(models_dir, workers, host, port, worker_base_port,
                          project, replicas, hot_rps, probe_interval,
-                         megabatch, max_inflight):
+                         megabatch, max_inflight, mesh_shards):
     """Horizontal serving tier: spawn and supervise WORKERS server
     processes over one models tree, routing /prediction traffic by
     consistent-hash machine→worker placement. Worker health probes drive
@@ -702,6 +728,11 @@ def run_fleet_server_cmd(models_dir, workers, host, port, worker_base_port,
         worker_args += ["--max-inflight", str(max_inflight)]
     if workers < 1:
         raise click.UsageError("--workers must be >= 1")
+    if mesh_shards and mesh_shards > workers:
+        raise click.UsageError(
+            f"--mesh-shards ({mesh_shards}) needs at least that many "
+            f"--workers to cover every shard (got {workers})"
+        )
     run_fleet_server(
         models_dir,
         workers=workers,
@@ -713,6 +744,7 @@ def run_fleet_server_cmd(models_dir, workers, host, port, worker_base_port,
         hot_rps=hot_rps,
         probe_interval=probe_interval,
         worker_args=worker_args,
+        mesh_shards=max(0, mesh_shards),
     )
 
 

@@ -24,6 +24,16 @@ bump or a device swap simply *misses* (new name) rather than loading an
 incompatible binary; the stored ``KEY.json`` is compared byte-for-byte on
 load as the second line of defense (a tampered or hash-colliding entry
 reads as *stale*, never as a program).
+
+Mesh serving (§23) rides the existing schema: mesh topology and
+``process_count`` are already here, and a fleet-sharded engine's
+programs key on its OWN shard's stacked machine count (part of the
+program identity), so a shard's warm re-boot is recompile-free by
+construction — and two shards whose slices happen to stack the same
+machine count legitimately SHARE entries, because machine parameters
+are runtime arguments, not baked into the executable. Nothing
+per-shard is added to the key on purpose: adding one would break that
+sharing without buying any correctness.
 """
 
 from __future__ import annotations

@@ -30,6 +30,35 @@ from .workers import (
 
 logger = logging.getLogger(__name__)
 
+
+def _fleet_at_least(models_root: str, n: int) -> bool:
+    """Whether ``models_root`` holds at least ``n`` model dirs — the one
+    fact the mesh layout policy needs. Same walk rule as the server's
+    ``scan_models_root`` with the shared store-layer predicate, but
+    SHORT-CIRCUITED at ``n``: a 100k-machine tree costs O(n) predicate
+    checks at router boot, not a full scan."""
+    import os
+
+    from ..store import generations as store_generations
+
+    if n <= 0:
+        return True
+    count = 0
+    try:
+        entries = os.listdir(models_root)  # unsorted: order is irrelevant
+    except OSError:
+        return True  # unreadable root: workers decide; don't un-mesh
+    for entry in entries:
+        path = os.path.join(models_root, entry)
+        if entry.startswith(".") or not os.path.isdir(path):
+            continue
+        if store_generations.is_artifact_dir(path):
+            count += 1
+            if count >= n:
+                return True
+    return False
+
+
 __all__ = [
     "ControlPlane",
     "FleetRouter",
@@ -60,9 +89,19 @@ def assemble_fleet(
     respawn: bool = True,
     boot_grace: float = 60.0,
     forward_timeout: float = 60.0,
+    mesh_shards: int = 0,
 ) -> FleetRouter:
     """Wire supervisor + control plane + placement + router together
-    (nothing started yet — callers own start/stop ordering)."""
+    (nothing started yet — callers own start/stop ordering).
+
+    ``mesh_shards`` > 0 makes this a MESH router (§23): the shard plan
+    (``parallel.shard_plan`` — imported lazily, so non-mesh routers
+    never pull the jax-backed parallel package) resolves each machine's
+    owning shard, workers cover shards round-robin by slot id, and
+    placement walks the owner shard's workers before the spill-fallback
+    rest. The workers themselves must be spawned with the matching
+    ``--mesh-shards``/``--mesh-shard`` flags (``run_fleet_server`` does
+    both sides from one knob)."""
     supervisor = WorkerSupervisor(specs, factory)
     control = ControlPlane(
         supervisor,
@@ -77,7 +116,44 @@ def assemble_fleet(
         hot_rps=hot_rps,
         hot=hot,
     )
-    return FleetRouter(
+    mesh_refresh = None
+    if mesh_shards and int(mesh_shards) > 0:
+        from ..parallel.shard_plan import resolve_plan, worker_shard
+
+        plan = resolve_plan(int(mesh_shards))
+
+        def mesh_refresh():
+            """Apply the SAME declared layout policy the workers apply:
+            a fleet below the sharding threshold stays replicated on
+            every shard, so the router must NOT prefer an "owner" group
+            (that would halve a hot machine's replica spread while
+            every worker serves it eagerly). Called at assemble time
+            and after every /reload — fleet membership can cross the
+            threshold at runtime, and each worker's rescan re-derives
+            its side of exactly this decision."""
+            sharded = plan.n_shards > 1 and (
+                models_root is None
+                or _fleet_at_least(models_root, plan.min_shard_machines)
+            )
+            flipped = placement.set_mesh(
+                plan.shard_of if sharded else None,
+                {
+                    name: worker_shard(spec.worker_id, plan.n_shards)
+                    for name, spec in supervisor.specs.items()
+                }
+                if sharded else None,
+                plan.n_shards if sharded else None,
+            )
+            if flipped or not sharded:
+                logger.info(
+                    "Mesh placement policy: %s",
+                    "sharded by ring position" if sharded else
+                    f"replicated (fleet below the "
+                    f"{plan.min_shard_machines}-machine threshold)",
+                )
+
+        mesh_refresh()
+    router = FleetRouter(
         supervisor,
         control,
         placement=placement,
@@ -85,6 +161,10 @@ def assemble_fleet(
         models_root=models_root,
         forward_timeout=forward_timeout,
     )
+    # §23: the reload endpoint re-derives the layout policy after fleet
+    # membership changes (None on non-mesh routers)
+    router.mesh_refresh = mesh_refresh
+    return router
 
 
 def run_fleet_server(
@@ -100,13 +180,20 @@ def run_fleet_server(
     probe_interval: float = 2.0,
     ready_timeout: float = 300.0,
     worker_args: Sequence[str] = (),
+    mesh_shards: int = 0,
 ) -> None:
     """``gordo run-fleet-server``: spawn N worker server processes over
     one ``models_dir`` (sharing its compile-cache store), wait for them,
     start the control plane, and serve the router. SIGTERM shuts the
     whole tier down: the router stops routing, then every worker gets
     its own SIGTERM (graceful drain) before the process exits — killing
-    the router must never orphan N worker processes."""
+    the router must never orphan N worker processes.
+
+    ``mesh_shards`` > 0 boots a MESH tier (§23): worker ``i`` serves
+    shard ``i mod mesh_shards`` (only its owned machines stack eagerly;
+    the rest serve through the spill fallback rung), and the router's
+    placement walks owner-shard workers first — one knob drives both
+    sides of the layout, so they can never disagree."""
     import signal
     import threading
 
@@ -115,10 +202,19 @@ def run_fleet_server(
     specs = worker_specs(workers, worker_base_port, host=worker_host)
 
     def factory(spec: WorkerSpec) -> SubprocessWorker:
+        extra = list(worker_args)
+        if mesh_shards and int(mesh_shards) > 0:
+            from ..parallel.shard_plan import worker_shard
+
+            extra += [
+                "--mesh-shards", str(int(mesh_shards)),
+                "--mesh-shard",
+                str(worker_shard(spec.worker_id, int(mesh_shards))),
+            ]
         return SubprocessWorker(
             spec,
             server_worker_argv(
-                spec, models_dir, project=project, extra=worker_args
+                spec, models_dir, project=project, extra=extra
             ),
         )
 
@@ -129,6 +225,7 @@ def run_fleet_server(
         models_root=models_dir,
         replicas=replicas,
         hot_rps=hot_rps,
+        mesh_shards=mesh_shards,
     )
     supervisor, control = app.supervisor, app.control
     supervisor.start_all()

@@ -210,6 +210,9 @@ class Placement:
         hot_window_s: float = 10.0,
         hot: Iterable[str] = (),
         clock=time.monotonic,
+        shard_of=None,
+        worker_shards: Optional[Dict[str, int]] = None,
+        mesh_shards: Optional[int] = None,
     ):
         self.ring = HashRing(workers, vnodes=vnodes)
         self.replicas = max(1, int(replicas))
@@ -225,6 +228,20 @@ class Placement:
         # tail of candidates() reads this tuple instead of re-walking
         # (and re-sorting) anything per request
         self._order_cache = (-1, ())
+        # multi-host mesh serving (§23): ``shard_of(machine) -> shard``
+        # (the deterministic shard plan — pure arithmetic, immutable) and
+        # the worker → shard table. When both are set, candidates()
+        # stable-partitions its order so the machine's OWNING shard's
+        # workers come first and everything else is the fallback rung —
+        # a dead owner degrades to spill-tier serving, never to a 503.
+        self._shard_of = shard_of
+        self._worker_shards: Dict[str, int] = dict(worker_shards or {})
+        # the mesh's TRUE shard count, declared — never inferred from
+        # the live table (a retire would shrink the inference and hand
+        # new elastic slots the wrong shard); immutable after boot
+        self._mesh_shards: Optional[int] = (
+            int(mesh_shards) if mesh_shards else None
+        )
 
     # -- membership ----------------------------------------------------------
     def add_worker(self, worker: str) -> None:
@@ -238,6 +255,61 @@ class Placement:
     def workers(self) -> List[str]:
         with self._lock:
             return self.ring.workers()
+
+    # -- mesh shards (§23) ---------------------------------------------------
+    def set_mesh(
+        self,
+        shard_of,
+        worker_shards: Optional[Dict[str, int]],
+        mesh_shards: Optional[int],
+    ) -> bool:
+        """Install (or clear, with ``None``s) the mesh layout
+        atomically — the §23 policy seam. Applied at assemble time and
+        RE-DERIVED after every router ``/reload``: fleet membership can
+        cross the sharding threshold at runtime, and router and workers
+        must flip between sharded and replicated together. Returns True
+        when the policy flipped."""
+        with self._lock:
+            lockcheck.assert_guard("router.placement")
+            was_sharded = self._shard_of is not None
+            self._shard_of = shard_of
+            self._worker_shards = dict(worker_shards or {})
+            self._mesh_shards = int(mesh_shards) if mesh_shards else None
+            return was_sharded != (shard_of is not None)
+
+    def set_worker_shard(self, worker: str, shard: Optional[int]) -> None:
+        """Record (or clear, with ``None``) which mesh shard a worker
+        serves — the elastic tier registers new workers here alongside
+        their ring join."""
+        with self._lock:
+            lockcheck.assert_guard("router.placement")
+            if shard is None:
+                self._worker_shards.pop(worker, None)
+            else:
+                self._worker_shards[worker] = int(shard)
+
+    def shard_of(self, machine: str) -> Optional[int]:
+        """The mesh shard owning ``machine`` (None = mesh serving off).
+        Snapshot under the lock: set_mesh can clear the callable
+        concurrently (a /reload flipping the policy)."""
+        with self._lock:
+            shard_of = self._shard_of
+        if shard_of is None:
+            return None
+        return shard_of(machine)
+
+    def mesh_shard_for(self, worker_id: int) -> Optional[int]:
+        """Round-robin shard assignment for a NEW worker slot — the
+        elastic tier's seam (matches ``shard_plan.worker_shard`` over
+        the mesh's declared shard count, so it agrees with the
+        ``--mesh-shard`` flag the spawned worker boots with); None when
+        mesh serving is off. Snapshot under the lock: set_mesh clears
+        both fields concurrently."""
+        with self._lock:
+            if self._shard_of is None or not self._mesh_shards:
+                return None
+            n_shards = self._mesh_shards
+        return int(worker_id) % n_shards
 
     # -- hot tracking --------------------------------------------------------
     def note_request(self, machine: str) -> None:
@@ -329,7 +401,24 @@ class Placement:
                     for worker in order[start:] + order[:start]
                     if worker not in seen
                 ]
-            return replica_set + tail
+            ordered = replica_set + tail
+            if self._shard_of is not None and self._worker_shards:
+                # §23: the owning shard's workers first (ring order kept
+                # within each group — rotation/failover still apply), the
+                # rest after as the spill fallback rung. One pure-
+                # arithmetic shard_of call plus a stable partition: the
+                # per-request cost stays O(log v).
+                shard = self._shard_of(machine)
+                owners = [
+                    worker for worker in ordered
+                    if self._worker_shards.get(worker) == shard
+                ]
+                if owners:
+                    ordered = owners + [
+                        worker for worker in ordered
+                        if self._worker_shards.get(worker) != shard
+                    ]
+            return ordered
 
     def replica_set(self, machine: str) -> List[str]:
         """The UNROTATED replica set (stable view for status/tests)."""
@@ -350,4 +439,6 @@ class Placement:
                 "replicas": self.replicas,
                 "hot_rps": self.hot_rps,
                 "hot_machines": sorted(self._hot),
+                # §23: worker → mesh shard (empty = mesh serving off)
+                "worker_shards": dict(sorted(self._worker_shards.items())),
             }

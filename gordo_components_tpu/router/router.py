@@ -95,6 +95,9 @@ _PASS_RESPONSE_HEADERS = (
     "Retry-After",
     DRAINING_HEADER,
     "X-Gordo-Worker",
+    # §23: which mesh shard answered — a non-owner value is the visible
+    # signature of the spill fallback rung serving a dead shard
+    "X-Gordo-Shard",
 )
 _DROP_FORWARD_HEADERS = frozenset(
     ("host", "connection", "keep-alive", "content-length",
@@ -156,6 +159,10 @@ class FleetRouter:
         self.supervisor = supervisor
         self.control = control
         self.placement = placement or Placement(sorted(supervisor.specs))
+        # §23: assemble_fleet installs a callback that re-derives the
+        # mesh layout policy (sharded vs replicated) after /reload —
+        # fleet membership can cross the sharding threshold at runtime
+        self.mesh_refresh = None
         self.project = project
         self.models_root = models_root
         self.forward_timeout = forward_timeout
@@ -345,7 +352,18 @@ class FleetRouter:
         if endpoint == "reload":
             if request.method != "POST":
                 return _json({"error": "POST required"}, status=405)
-            return _json(self.rollout.rolling_reload())
+            result = self.rollout.rolling_reload()
+            if self.mesh_refresh is not None:
+                # the adopted generation may have crossed the sharding
+                # threshold: re-derive the layout policy the workers'
+                # rescans just re-derived on their side
+                try:
+                    self.mesh_refresh()
+                except Exception:
+                    logger.exception(
+                        "Mesh layout refresh after reload failed"
+                    )
+            return _json(result)
         if endpoint == "rollback":
             if request.method != "POST":
                 return _json({"error": "POST required"}, status=405)
@@ -518,7 +536,8 @@ class FleetRouter:
                 )
                 return
             merged = stitch.merge_remote(
-                timeline, remote, rel_start, rel_end, process=worker_name
+                timeline, remote, rel_start, rel_end,
+                process=_stitch_lane(worker_name, remote),
             )
             _M_STITCH.labels("merged" if merged else "invalid").inc()
         elif truncated:
@@ -604,7 +623,7 @@ class FleetRouter:
             merged = stitch.merge_remote(
                 timeline, remote,
                 float(window[0]), float(window[1]),
-                process=worker_name,
+                process=_stitch_lane(worker_name, remote),
             )
         except (ValueError, TypeError, IndexError) as exc:
             timeline.meta["stitch_failed"] = f"unparseable: {exc}"
@@ -744,6 +763,18 @@ class FleetRouter:
             self._session.close()
         except Exception:  # lint: allow-swallow(pooled-session teardown; the router is already shutting down)
             pass
+
+
+def _stitch_lane(worker_name: str, remote: Dict[str, Any]) -> str:
+    """Process-lane name for a stitched worker timeline: mesh-sharded
+    workers (§23) stamp their shard into the timeline meta, and the
+    Perfetto export then renders one lane PER SHARD — a fallback-served
+    request visibly lands in a different shard's lane."""
+    meta = remote.get("meta")
+    shard = meta.get("shard") if isinstance(meta, dict) else None
+    if shard is None:
+        return worker_name
+    return f"{worker_name}@shard-{shard}"
 
 
 def _json(

@@ -73,7 +73,7 @@ import time
 import weakref
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -163,6 +163,21 @@ _M_PRECISION = REGISTRY.counter(
     "(f32 / bf16 / int8 — the per-machine precision ladder, "
     "ARCHITECTURE §19); a mixed fleet shows its downgraded tail here",
     labels=("precision",),
+)
+_M_MESH_REQUESTS = REGISTRY.counter(
+    "gordo_mesh_requests_total",
+    "Requests scored by a mesh-sharded engine (§23), by rung: owned = "
+    "served from this shard's stacked fleet; fallback = a machine "
+    "another shard owns, served here through the host-RAM spill tier — "
+    "the ladder rung that keeps a dead shard's machines answering",
+    labels=("shard", "path"),
+)
+_M_MESH_MACHINES = REGISTRY.gauge(
+    "gordo_mesh_shard_machines",
+    "Machines this shard owns in its stacked serving engine (mesh-"
+    "sharded mode §23; every other machine serves via the fallback "
+    "rung)",
+    labels=("shard",),
 )
 _M_MEGA_EVENTS = REGISTRY.counter(
     "gordo_engine_megabatch_events_total",
@@ -2399,8 +2414,28 @@ class ServingEngine:
         quantized: Optional[Dict[str, Tuple[Any, Any]]] = None,
         lazy: Optional[Dict[str, Any]] = None,
         host_cache_mb: Optional[int] = None,
+        mesh_shard: Optional[Tuple[int, int]] = None,
+        mesh_remote: Optional[Iterable[str]] = None,
     ):
         self.mesh = mesh
+        # multi-host mesh serving (§23): ``(shard_id, n_shards)`` when
+        # this engine is one shard of a fleet-sharded serving mesh — its
+        # eager ``models`` are the machines the shard-plan ring assigns
+        # here, and every ``lazy`` machine is another shard's, reachable
+        # through the spill tier as the fallback rung. Purely an
+        # accounting/observability tag at this layer: the data plane
+        # (buckets, megabatch residency, pipelined dispatch) is the
+        # unchanged single-host engine over the owned subset.
+        self.mesh_shard = (
+            (int(mesh_shard[0]), int(mesh_shard[1]))
+            if mesh_shard is not None
+            else None
+        )
+        # §23 accounting boundary: the machines OTHER shards own (served
+        # here only through the fallback rung). Owned-but-lazy machines
+        # (a §22 index boot) are NOT in this set — their spill-served
+        # requests count as "owned", because the owner IS serving them.
+        self.mesh_remote = frozenset(mesh_remote or ())
         # host-RAM spill tier (§22): machines registered LAZY are not
         # materialized (no model object, no stacked slot, no device
         # bytes) until their first request — which loads them through the
@@ -2535,6 +2570,10 @@ class ServingEngine:
             "Machines the engine could not lift (serving via the slow host "
             "path; see /metrics JSON engine.host_path_machines for reasons)",
         ).set(len(self.skipped))
+        if self.mesh_shard is not None:
+            _M_MESH_MACHINES.labels(str(self.mesh_shard[0])).set(
+                len(self._by_name)
+            )
 
     # -- public API ----------------------------------------------------------
     def warmup(self, rows: Optional[int] = None) -> int:
@@ -2763,6 +2802,9 @@ class ServingEngine:
         if resolved is None:
             raise KeyError(name)
         bucket, idx = resolved
+        if self.mesh_shard is not None:
+            # §23: this shard owns the machine — the steady-state rung
+            _M_MESH_REQUESTS.labels(str(self.mesh_shard[0]), "owned").inc()
         # resilience seams, both no-ops in the common case: expired work
         # must not queue behind the bucket's leader latch (the 504 path),
         # and the chaos harness injects latency/error/corruption HERE —
@@ -2843,6 +2885,25 @@ class ServingEngine:
             deadline.check("engine.dispatch")
             faults.inject("engine-dispatch", name)
             X = faults.corrupt("engine-dispatch", name, X)
+        if self.mesh_shard is not None:
+            if name in self.mesh_remote:
+                # §23 fallback rung: another shard owns this machine —
+                # it is being served HERE (owner dead, or the router
+                # degraded), so say so in the series and the request's
+                # own timeline
+                _M_MESH_REQUESTS.labels(
+                    str(self.mesh_shard[0]), "fallback"
+                ).inc()
+                spans.event(
+                    "mesh_fallback", machine=name,
+                    shard=self.mesh_shard[0],
+                )
+            else:
+                # this shard's own machine through the spill tier (§22
+                # lazy boot): the owner is serving it — steady state
+                _M_MESH_REQUESTS.labels(
+                    str(self.mesh_shard[0]), "owned"
+                ).inc()
         bundle = self.spill_bundle(name)
         scorer: _SpillScorer = bundle["scorer"]
         if scorer is None:
@@ -2957,6 +3018,19 @@ class ServingEngine:
             "compile_cache": (
                 dict(self.compile_cache.counters)
                 if self.compile_cache is not None
+                else None
+            ),
+            # multi-host mesh serving (§23): which shard this engine is,
+            # what it owns eagerly, and how much of its traffic arrived
+            # through the fallback rung (None = single-host serving)
+            "mesh": (
+                {
+                    "shard": self.mesh_shard[0],
+                    "shards": self.mesh_shard[1],
+                    "owned_machines": len(self._by_name),
+                    "remote_machines": len(self.mesh_remote),
+                }
+                if self.mesh_shard is not None
                 else None
             ),
             # host-RAM spill tier (§22): lazily-registered machines, the

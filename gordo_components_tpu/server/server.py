@@ -48,7 +48,7 @@ import math
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 from werkzeug.exceptions import HTTPException, NotFound
@@ -260,6 +260,8 @@ class _ServerState:
         shard_fleet: bool = False,
         compile_cache=None,
         lazy_loaders: Optional[Dict[str, Any]] = None,
+        mesh_shard: Optional[Tuple[int, int]] = None,
+        mesh_remote: Optional[set] = None,
     ):
         self._inflight = 0
         self._cond = lockcheck.named_condition("server.state_cond")
@@ -312,6 +314,13 @@ class _ServerState:
             # host-RAM spill tier (§22): lazily-indexed machines load on
             # first touch through the byte-bounded host cache
             lazy=lazy_loaders,
+            # multi-host mesh serving (§23): this process's (shard,
+            # shards) identity — eager machines are the shard's owned
+            # slice, and ``mesh_remote`` names the OTHER shards' machines
+            # behind the spill fallback rung (owned-but-lazy machines
+            # stay "owned" in the accounting)
+            mesh_shard=mesh_shard,
+            mesh_remote=mesh_remote,
         )
         if lazy_loaders:
             logger.info(
@@ -392,6 +401,8 @@ class ModelServer:
         compile_cache_store: Optional[str] = None,
         worker_id: Optional[int] = None,
         lazy_boot: Optional[bool] = None,
+        mesh_shards: Optional[int] = None,
+        mesh_shard: Optional[int] = None,
     ):
         """``models_root``: optional directory whose immediate subdirs are
         model dirs; enables ``POST /reload`` so machines built AFTER server
@@ -425,6 +436,17 @@ class ModelServer:
         small eager subset materializes, the rest serves through the
         host-RAM spill tier with artifact verification on first touch.
         Default: the ``GORDO_LAZY_BOOT`` env var, else off.
+
+        ``mesh_shards`` / ``mesh_shard``: multi-host mesh serving (§23)
+        — this process is shard ``mesh_shard`` of an
+        ``mesh_shards``-process serving mesh. The deterministic shard
+        plan (``parallel.shard_plan``) partitions the fleet's stacked
+        machine axis by ring position: only the owned slice stacks
+        eagerly; every other shard's machines stay reachable through the
+        host-RAM spill tier (the fallback rung a dead shard degrades
+        to). Defaults: ``GORDO_MESH_SHARDS`` / ``GORDO_MESH_SHARD``
+        (shard falls back to ``worker_id mod shards``); 0/unset shards =
+        single-host serving, exactly as before.
         """
         from ..compile_cache import resolve_store
 
@@ -432,6 +454,61 @@ class ModelServer:
             raw_worker = os.environ.get("GORDO_WORKER_ID")
             worker_id = int(raw_worker) if raw_worker else None
         self.worker_id = worker_id
+
+        # multi-host mesh serving (§23): resolve this process's place in
+        # the serving mesh. The plan itself is pure arithmetic over the
+        # knob — router and every worker derive the identical layout.
+        from ..parallel import shard_plan as shard_plan_mod
+
+        if mesh_shards is None:
+            mesh_shards = shard_plan_mod.mesh_shards_env()
+        if mesh_shard is None:
+            mesh_shard = shard_plan_mod.mesh_shard_env()
+        self.mesh_shards = max(0, int(mesh_shards or 0))
+        self.mesh_shard: Optional[int] = None
+        self._mesh_plan = None
+        # machines OTHER shards own (moved behind the spill tier by
+        # _mesh_partition) — the engine's owned-vs-fallback accounting
+        # boundary; empty when mesh serving is off or replicated
+        self._mesh_remote: set = set()
+        if (
+            self.mesh_shards > 0
+            and not isinstance(model_dirs, str)
+            and models_root
+        ):
+            if mesh_shard is None and worker_id is not None:
+                mesh_shard = shard_plan_mod.worker_shard(
+                    worker_id, self.mesh_shards
+                )
+            if mesh_shard is None:
+                logger.warning(
+                    "GORDO_MESH_SHARDS=%d but neither GORDO_MESH_SHARD "
+                    "nor a worker id names this process's shard; serving "
+                    "single-host", self.mesh_shards,
+                )
+                self.mesh_shards = 0
+            elif not 0 <= int(mesh_shard) < self.mesh_shards:
+                logger.warning(
+                    "GORDO_MESH_SHARD=%s outside the %d-shard mesh; "
+                    "serving single-host", mesh_shard, self.mesh_shards,
+                )
+                self.mesh_shards = 0
+            else:
+                self.mesh_shard = int(mesh_shard)
+                self._mesh_plan = shard_plan_mod.resolve_plan(
+                    self.mesh_shards
+                )
+        elif self.mesh_shards > 0:
+            # single-dir mode serves exactly one explicit model, and a
+            # rootless boot (--model-dir only) registered EVERY machine
+            # explicitly — registration overrides the layout, so there
+            # is nothing to partition; demoting explicit machines behind
+            # the spill tier would mislabel them as fallback traffic
+            logger.warning(
+                "Mesh serving needs --models-dir (a rescannable fleet "
+                "root); explicitly-registered machines serve single-host"
+            )
+            self.mesh_shards = 0
 
         self.shard_fleet = shard_fleet
         self.compile_cache = resolve_store(
@@ -491,6 +568,12 @@ class ModelServer:
                         model_dirs.setdefault(name, path)
                     for name in model_dirs:
                         lazy_dirs.pop(name, None)
+            # §23: machines other shards own never load here — they move
+            # behind the spill tier (the fallback rung), loaders built
+            # below like any lazy machine
+            self._mesh_partition(
+                model_dirs, lazy_dirs, lazy_gens, models_root
+            )
             machines = {}
             for name, path in model_dirs.items():
                 try:
@@ -530,6 +613,8 @@ class ModelServer:
             machines, shard_fleet=shard_fleet,
             compile_cache=self.compile_cache,
             lazy_loaders=self._lazy_loaders(),
+            mesh_shard=self._mesh_tuple(),
+            mesh_remote=set(self._mesh_remote),
         )
         # SLO engine (§18): declared objectives over the request
         # histograms this server already records, evaluated by
@@ -659,6 +744,13 @@ class ModelServer:
                     new_lazy = lazy_index
             else:
                 seen = scan_models_root(self.models_root)
+            # §23: a rescan re-derives the SAME deterministic partition —
+            # machines other shards own go back behind the spill tier
+            # (their artifact mtime rides along as the staleness signal
+            # that drops a rebuilt machine's cached spill bundle below)
+            self._mesh_partition(
+                seen, new_lazy, new_lazy_gens, self.models_root
+            )
             pinned_paths = {
                 os.path.realpath(m.model_dir) for m in self._pinned.values()
             }
@@ -673,6 +765,14 @@ class ModelServer:
                 # without this no CLI-started server would ever adopt a
                 # fleet rebuild's generations. Same refusal rule as the
                 # scan path: a torn rebuild keeps the old verified model.
+                if name in self._mesh_remote and name in new_lazy:
+                    # §23: the rescan's partition re-homed this in-root
+                    # machine behind the spill tier (the fleet crossed
+                    # the sharding threshold, or ownership moved on a
+                    # reshard) — re-adding it eagerly would double-serve
+                    # it and defeat the layout. Outside-root pins never
+                    # enter the partition, so registration still wins.
+                    continue
                 current = state.machines.get(name, pinned)
                 try:
                     if _artifact_mtime(current.model_dir) != current.mtime:
@@ -775,6 +875,8 @@ class ModelServer:
                     machines, shard_fleet=self.shard_fleet,
                     compile_cache=self.compile_cache,
                     lazy_loaders=self._lazy_loaders(),
+                    mesh_shard=self._mesh_tuple(),
+                    mesh_remote=set(self._mesh_remote),
                 )
                 # warm new/changed bucket programs BEFORE publishing the
                 # generation: the old state serves meanwhile, so no request
@@ -829,6 +931,80 @@ class ModelServer:
             state.engine.warmup()
         except Exception:  # warm-up is best-effort; scoring still compiles
             logger.warning("Post-reload engine warm-up failed", exc_info=True)
+
+    # -- multi-host mesh serving (§23) ----------------------------------------
+    def _mesh_tuple(self) -> Optional[Tuple[int, int]]:
+        """(shard, shards) when this server is one shard of a serving
+        mesh, else None — the engine's accounting tag."""
+        if self._mesh_plan is None or self.mesh_shard is None:
+            return None
+        return (self.mesh_shard, self.mesh_shards)
+
+    def _mesh_partition(
+        self,
+        eager_dirs: Dict[str, str],
+        lazy_dirs: Dict[str, str],
+        lazy_gens: Dict[str, Any],
+        models_root: Optional[str] = None,
+    ) -> None:
+        """Apply the shard plan to a resolved fleet: machines other
+        shards own move from the eager set behind the host-RAM spill
+        tier (the §23 fallback rung — still servable HERE if their
+        owner dies, at spill cost instead of an error). The declared
+        policy keeps small fleets replicated everywhere; the artifact
+        mtime rides along as each moved machine's staleness signal so a
+        reload drops rebuilt machines' cached spill bundles. Machines
+        registered OUTSIDE ``models_root`` stay eager whatever shard
+        owns them: explicit registration overrides the layout — a
+        rescan cannot re-discover their dirs, so moving them behind the
+        (rescan-rebuilt) lazy set would drop them on the first /reload.
+        ``self._mesh_remote`` records the moved names — the engine's
+        owned-vs-fallback accounting boundary."""
+        self._mesh_remote = set()
+        if self._mesh_plan is None or self.mesh_shard is None:
+            return
+        from ..parallel.shard_plan import POLICY_SHARDED
+
+        fleet = sorted(set(eager_dirs) | set(lazy_dirs))
+        if self._mesh_plan.policy(len(fleet)) != POLICY_SHARDED:
+            logger.info(
+                "Mesh serving: %d-machine fleet below the sharding "
+                "threshold (%d) — replicated on every shard",
+                len(fleet), self._mesh_plan.min_shard_machines,
+            )
+            return
+        root_real = (
+            os.path.realpath(models_root) + os.sep if models_root else None
+        )
+        moved = 0
+        for name in sorted(eager_dirs):
+            if self._mesh_plan.shard_of(name) == self.mesh_shard:
+                continue
+            path = eager_dirs[name]
+            if root_real and not (
+                os.path.realpath(path) + os.sep
+            ).startswith(root_real):
+                continue  # pinned outside the root: registration wins
+            eager_dirs.pop(name)
+            lazy_dirs[name] = path
+            try:
+                lazy_gens[name] = _artifact_mtime(path)
+            except OSError:
+                lazy_gens.setdefault(name, None)
+            self._mesh_remote.add(name)
+            moved += 1
+        # lazy-registered machines other shards own (index boots) are
+        # remote too — the accounting boundary is ownership, not tier
+        self._mesh_remote.update(
+            name for name in lazy_dirs
+            if self._mesh_plan.shard_of(name) != self.mesh_shard
+        )
+        logger.info(
+            "Mesh-sharded serving: shard %d/%d owns %d of %d machine(s); "
+            "%d reachable via the spill fallback rung",
+            self.mesh_shard, self.mesh_shards, len(eager_dirs),
+            len(fleet), moved,
+        )
 
     # -- lazy fleet boot + host-RAM spill tier (§22) --------------------------
     def _lazy_partition(self, models_root: str):
@@ -1027,6 +1203,11 @@ class ModelServer:
                 # which fleet slot answered — the router's routing smoke
                 # (and any operator curl) verifies placement with this
                 response.headers["X-Gordo-Worker"] = str(self.worker_id)
+            if self.mesh_shard is not None:
+                # §23: which mesh shard answered — the owner in steady
+                # state; a different shard than the plan's owner means
+                # the spill fallback rung served this request
+                response.headers["X-Gordo-Shard"] = str(self.mesh_shard)
             if self.admission.closed is not None:
                 # draining marker on EVERYTHING this server still answers
                 # (sheds and healthz alike): the router re-routes marked
@@ -1041,6 +1222,10 @@ class ModelServer:
                 timeline.meta["endpoint"] = endpoint
                 if self.worker_id is not None:
                     timeline.meta["worker"] = self.worker_id
+                if self.mesh_shard is not None:
+                    # §23: the stitched router lane renders per-shard —
+                    # the merge reads this off the remote timeline
+                    timeline.meta["shard"] = self.mesh_shard
                 timeline.finish(
                     status=str(status),
                     error=f"HTTP {status}" if status >= 500 else "",
@@ -1219,6 +1404,19 @@ class ModelServer:
                     "live": True,
                     "ready": ready,
                     "worker_id": self.worker_id,
+                    # §23: this process's slice of the serving mesh —
+                    # owned machines stack eagerly, the remainder serves
+                    # via the spill fallback rung (null = single-host)
+                    "mesh": (
+                        {
+                            "shard": self.mesh_shard,
+                            "shards": self.mesh_shards,
+                            "owned": len(state.machines),
+                            "remote_or_lazy": len(state.lazy_names),
+                        }
+                        if self.mesh_shard is not None
+                        else None
+                    ),
                     "quarantined": quarantined,
                     "suspect": suspects,
                     # artifact-integrity facet: every served machine passed
@@ -1787,6 +1985,8 @@ def build_app(
     compile_cache_store: Optional[str] = None,
     worker_id: Optional[int] = None,
     lazy_boot: Optional[bool] = None,
+    mesh_shards: Optional[int] = None,
+    mesh_shard: Optional[int] = None,
 ) -> ModelServer:
     """App factory (reference: ``server.build_app``)."""
     return ModelServer(
@@ -1796,6 +1996,8 @@ def build_app(
         compile_cache_store=compile_cache_store,
         worker_id=worker_id,
         lazy_boot=lazy_boot,
+        mesh_shards=mesh_shards,
+        mesh_shard=mesh_shard,
     )
 
 

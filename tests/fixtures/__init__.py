@@ -1,0 +1,3 @@
+# Shared test fixtures. ``multiproc`` is the multi-process mesh fixture
+# (spawn/rendezvous/teardown for the Gloo-ring drills); data files
+# (ported_gordo_config.yaml) live beside it.

@@ -1,0 +1,74 @@
+"""Scripted HTTP worker with a DELIBERATELY skewed wall clock.
+
+Run as: ``python skewed_worker.py <port> <skew_seconds> [<shard>]``
+
+A genuinely separate process standing in for a mesh worker on a host
+whose wall clock is ``skew_seconds`` off the router's — the case the
+§18 stitch clamp exists for. It answers the worker protocol's minimum
+(healthz / models / scoring) and, when the router negotiates timeline
+capture (``X-Gordo-Timeline: 1``), stamps a stitched timeline whose
+``started`` wall second lies ``skew_seconds`` in the future, carrying a
+``device_execute`` span and (optionally) a mesh ``shard`` in its meta —
+the router must clamp the lane into its observed forward window, never
+render it outside the ``route`` span.
+"""
+
+import base64
+import json
+import sys
+import time
+
+from werkzeug.serving import make_server
+from werkzeug.wrappers import Request, Response
+
+PORT = int(sys.argv[1])
+SKEW_S = float(sys.argv[2])
+SHARD = int(sys.argv[3]) if len(sys.argv) > 3 else None
+
+
+@Request.application
+def app(request):
+    def reply(payload, headers=None):
+        response = Response(
+            json.dumps(payload), mimetype="application/json"
+        )
+        response.headers["X-Gordo-Worker"] = "skewed"
+        for key, value in (headers or {}).items():
+            response.headers[key] = value
+        return response
+
+    if request.path == "/healthz":
+        return reply(
+            {"ok": True, "status": "ok", "live": True, "ready": True}
+        )
+    if request.path == "/models":
+        return reply({"models": ["mach-skew"]})
+    headers = {}
+    if request.headers.get("X-Gordo-Timeline"):
+        timeline = {
+            "trace_id": request.headers.get("X-Gordo-Trace-Id", "t"),
+            # the deliberate skew: this process claims it started work
+            # SKEW_S seconds away from now on the wall clock
+            "started": time.time() + SKEW_S,
+            "duration_ms": 5.0,
+            "meta": (
+                {"shard": SHARD} if SHARD is not None else {}
+            ),
+            "spans": [
+                {
+                    "name": "device_execute",
+                    "start_ms": 1.0,
+                    "duration_ms": 3.0,
+                    "thread": "collector",
+                }
+            ],
+            "events": [],
+        }
+        headers["X-Gordo-Timeline"] = base64.b64encode(
+            json.dumps(timeline, separators=(",", ":")).encode("utf-8")
+        ).decode("ascii")
+    return reply({"worker": "skewed"}, headers=headers)
+
+
+if __name__ == "__main__":
+    make_server("127.0.0.1", PORT, app).serve_forever()

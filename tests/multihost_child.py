@@ -373,6 +373,91 @@ def ckpt_roundtrip_mode(ckpt_dir: str) -> None:
     print(f"ckpt-roundtrip@{pid} OK", flush=True)
 
 
+def serve_shard_mode() -> None:
+    """SPMD mesh-serving drill (ARCHITECTURE §23): every process joins
+    one ``global_fleet_mesh``, a bucket-shaped stacked tree shards its
+    MACHINE axis across the processes (``shard_plan`` padding +
+    ``NamedSharding`` — each process materializes only its own slice via
+    ``make_array_from_process_local_data``), and every process enqueues
+    the SAME gather-by-idx scoring program in lockstep — the cross-shard
+    gather is the collective, and it lives ONLY inside the jitted
+    program, exactly like the serving engine's sharded bucket. Requests
+    deliberately index machines on BOTH processes' slices; the
+    replicated output is parity-checked per process against a local
+    dense reference."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from gordo_components_tpu.parallel.distributed import global_fleet_mesh
+    from gordo_components_tpu.parallel.mesh import pad_to_multiple
+    from gordo_components_tpu.parallel.shard_plan import FleetShardPlan
+
+    mesh = global_fleet_mesh()
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    plan = FleetShardPlan(nproc)
+    n_machines = 6  # deliberately no multiple of anything: padding runs
+    features, rows, k = 3, 8, 4
+    # machine axis padded so it tiles the GLOBAL device mesh evenly (the
+    # per-process slices are the plan's shard_bounds scaled to devices)
+    height = pad_to_multiple(n_machines, mesh.size)
+    rng = np.random.default_rng(0)
+    stacked_full = {
+        "w": rng.normal(size=(height, features, features)).astype(
+            np.float32
+        ),
+        "b": rng.normal(size=(height, features)).astype(np.float32),
+    }
+    sharding = plan.global_sharding(mesh)
+    per_proc = height // nproc
+    lo, hi = pid * per_proc, (pid + 1) * per_proc
+
+    def globalize(full):
+        return jax.make_array_from_process_local_data(
+            sharding, full[lo:hi]
+        )
+
+    stacked = {name: globalize(a) for name, a in stacked_full.items()}
+
+    def score_one(tree, idx, x):
+        machine = jax.tree_util.tree_map(lambda a: a[idx], tree)
+        pred = x @ machine["w"] + machine["b"]
+        return jnp.linalg.norm(jnp.abs(pred - x), axis=-1)
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+    program = jax.jit(
+        jax.vmap(score_one, in_axes=(None, 0, 0)),
+        in_shardings=(sharding, replicated, replicated),
+        out_shardings=replicated,
+    )
+    # every request targets a different machine, spanning both halves of
+    # the padded axis — the gather crosses the process boundary
+    idx = (np.arange(k, dtype=np.int32) * (n_machines // 2 + 1)) % n_machines
+    xs = rng.normal(size=(k, rows, features)).astype(np.float32)
+    out = np.asarray(
+        jax.device_get(program(stacked, idx, xs))
+    )
+    reference = np.stack(
+        [
+            np.linalg.norm(
+                np.abs(
+                    xs[j] @ stacked_full["w"][idx[j]]
+                    + stacked_full["b"][idx[j]]
+                    - xs[j]
+                ),
+                axis=-1,
+            )
+            for j in range(k)
+        ]
+    )
+    np.testing.assert_allclose(out, reference, atol=1e-5)
+    print(
+        f"serve-shard@{pid}: {k} requests gathered across "
+        f"{nproc} process shards OK (height {height})",
+        flush=True,
+    )
+
+
 def main() -> None:
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 
@@ -417,6 +502,9 @@ def main() -> None:
         return
     if len(sys.argv) >= 5 and sys.argv[4] == "--ring":
         ring_attention_mode()
+        return
+    if len(sys.argv) >= 5 and sys.argv[4] == "--serve-shard":
+        serve_shard_mode()
         return
 
     from jax.sharding import NamedSharding, PartitionSpec

@@ -139,55 +139,16 @@ def test_global_fleet_mesh_spans_devices():
 
 
 def _run_multihost_children(extra_argv, timeout, extra_env=None, n_procs=2):
-    """Spawn the ``n_procs``-process multihost_child group on a fresh port
-    and collect (codes, outputs). The free-port probe is TOCTOU-racy, so
-    callers retry once on nonzero exits. Children inherit the persistent
-    compilation cache dir (conftest sets it via jax.config, which
-    subprocesses don't see) so repeat runs skip XLA recompiles. Every
-    process gets a FIXED 4 virtual devices, so the global mesh is
-    4 x n_procs (2 procs -> 8, 4 procs -> 16 = the v5e-16 layout)."""
-    import socket
-    import subprocess
-    import sys
+    """The multi-process mesh fixture (tests/fixtures/multiproc.py) —
+    kept under its historical local name so this module's many call
+    sites read unchanged. See the fixture for the spawn/rendezvous/
+    teardown contract (port-race retry, fixed 4 virtual devices per
+    process, inherited compilation cache, group kill on timeout)."""
+    from fixtures.multiproc import run_mesh_children
 
-    import jax as _jax
-
-    child = os.path.join(os.path.dirname(__file__), "multihost_child.py")
-    env = {
-        **os.environ,
-        **(extra_env or {}),
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        # None when the parent runs cacheless (GORDO_TEST_NO_COMPILE_CACHE)
-        "JAX_COMPILATION_CACHE_DIR": (
-            _jax.config.jax_compilation_cache_dir or ""
-        ),
-    }
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, child, str(pid), str(n_procs), str(port)]
-            + extra_argv,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            env=env,
-        )
-        for pid in range(n_procs)
-    ]
-    outputs, codes = [], []
-    for proc in procs:
-        try:
-            out, _ = proc.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            out, _ = proc.communicate()
-        outputs.append(out)
-        codes.append(proc.returncode)
-    return codes, outputs
+    return run_mesh_children(
+        extra_argv, timeout, extra_env=extra_env, n_procs=n_procs
+    )
 
 
 @pytest.mark.slow

@@ -1,0 +1,410 @@
+"""Multi-host mesh serving (docs/ARCHITECTURE.md §23): the shard-plan
+layout layer, shard-aware placement, the mesh-sharded server mode, and
+cross-process trace stitching under deliberate clock skew.
+
+The fast tests here are tier-1: the shard plan is pure arithmetic, the
+placement walk is in-process, and the mesh server boots over a handful
+of 1-epoch models through the werkzeug test client (no sockets). The
+two real-multi-process drills — the SPMD ``--serve-shard`` child and
+the skewed-clock stitch — spawn genuine subprocesses; only the SPMD one
+is ``slow`` (it pays a jax.distributed rendezvous)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+from werkzeug.test import Client
+
+from gordo_components_tpu.builder import provide_saved_model
+from gordo_components_tpu.parallel.shard_plan import (
+    POLICY_REPLICATED,
+    POLICY_SHARDED,
+    FleetShardPlan,
+    mesh_shards_env,
+    resolve_plan,
+    shard_name,
+    worker_shard,
+)
+from gordo_components_tpu.router.placement import Placement
+from gordo_components_tpu.server import build_app
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-04T00:00:00+00:00",
+    "tag_list": ["tag-a", "tag-b", "tag-c"],
+}
+MODEL_CONFIG = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                  "dims": [4], "epochs": 1,
+                                  "batch_size": 32}},
+        ]
+    }
+}
+# 6 machines / 2 shards: this name set splits 3/3 on the SHA-1 ring
+# (deterministic — the plan is a pure function of the names)
+FLEET = [f"mesh-{i:03d}" for i in range(6)]
+
+
+# -- shard plan: the layout layer -----------------------------------------
+
+
+def test_shard_plan_deterministic_and_partitions():
+    plan_a = FleetShardPlan(2, min_shard_machines=0)
+    plan_b = FleetShardPlan(2, min_shard_machines=0)
+    assign = plan_a.assign(FLEET)
+    assert assign == plan_b.assign(FLEET)
+    assert set(assign.values()) <= {0, 1}
+    # owned() partitions the fleet: disjoint, union = everything
+    owned = [plan_a.owned(FLEET, shard) for shard in (0, 1)]
+    assert sorted(owned[0] + owned[1]) == sorted(FLEET)
+    assert not set(owned[0]) & set(owned[1])
+    assert plan_a.counts(FLEET) == [len(owned[0]), len(owned[1])]
+
+
+def test_shard_plan_policy_threshold():
+    plan = FleetShardPlan(2, min_shard_machines=10)
+    assert plan.policy(6) == POLICY_REPLICATED
+    assert plan.policy(10) == POLICY_SHARDED
+    # replicated fleets are owned EVERYWHERE
+    assert plan.owned(FLEET, 0) == sorted(FLEET)
+    assert plan.owned(FLEET, 1) == sorted(FLEET)
+    # a 1-shard mesh never shards
+    assert FleetShardPlan(1).policy(10_000) == POLICY_REPLICATED
+
+
+def test_shard_plan_bounded_movement_on_reshard():
+    """Ring inheritance: growing the mesh 2 -> 3 shards moves roughly
+    1/3 of the machines, never a wholesale reshuffle."""
+    names = [f"m-{i:04d}" for i in range(300)]
+    before = FleetShardPlan(2, min_shard_machines=0).assign(names)
+    after = FleetShardPlan(3, min_shard_machines=0).assign(names)
+    moved = sum(1 for n in names if before[n] != after[n])
+    assert 0 < moved < len(names) * 0.6
+
+
+def test_shard_plan_spmd_bounds_tile_padded_axis():
+    plan = FleetShardPlan(4, min_shard_machines=0)
+    height = plan.padded_height(6)
+    assert height % 4 == 0 and height >= 6
+    bounds = plan.shard_bounds(6)
+    assert bounds[0][0] == 0 and bounds[-1][1] == height
+    assert all(hi - lo == height // 4 for lo, hi in bounds)
+    # contiguity: each slice starts where the previous ended
+    assert all(bounds[i][1] == bounds[i + 1][0] for i in range(3))
+
+
+def test_worker_shard_round_robin_cover():
+    assert [worker_shard(i, 2) for i in range(5)] == [0, 1, 0, 1, 0]
+    with pytest.raises(ValueError):
+        worker_shard(0, 0)
+    with pytest.raises(ValueError):
+        FleetShardPlan(2).owned(FLEET, 7)
+    assert shard_name(3) == "shard-3"
+
+
+def test_resolve_plan_env_gate_and_cache(monkeypatch):
+    monkeypatch.delenv("GORDO_MESH_SHARDS", raising=False)
+    assert mesh_shards_env() == 0
+    assert resolve_plan() is None
+    monkeypatch.setenv("GORDO_MESH_SHARDS", "0")
+    assert resolve_plan() is None
+    monkeypatch.setenv("GORDO_MESH_SHARDS", "2")
+    plan = resolve_plan()
+    assert plan is not None and plan.n_shards == 2
+    # the plan cache: same knobs -> the same immutable instance
+    assert resolve_plan() is plan
+
+
+# -- placement: the owner shard's workers walk first ----------------------
+
+
+def _mesh_placement(n_workers=4, n_shards=2):
+    workers = [f"worker-{i}" for i in range(n_workers)]
+    plan = FleetShardPlan(n_shards, min_shard_machines=0)
+    return (
+        Placement(
+            workers,
+            shard_of=plan.shard_of,
+            worker_shards={
+                w: worker_shard(i, n_shards) for i, w in enumerate(workers)
+            },
+            mesh_shards=n_shards,
+        ),
+        plan,
+    )
+
+
+def test_placement_owner_shard_workers_first():
+    placement, plan = _mesh_placement()
+    for machine in FLEET:
+        shard = plan.shard_of(machine)
+        candidates = placement.candidates(machine)
+        assert sorted(candidates) == [f"worker-{i}" for i in range(4)]
+        owners = {f"worker-{i}" for i in range(4) if i % 2 == shard}
+        # stable partition: every owner-shard worker precedes every
+        # fallback worker
+        assert set(candidates[: len(owners)]) == owners
+        assert placement.shard_of(machine) == shard
+
+
+def test_placement_shard_table_mutation_and_describe():
+    placement, plan = _mesh_placement()
+    machine = FLEET[0]
+    shard = plan.shard_of(machine)
+    # retire every owner-shard worker from the table: the candidate walk
+    # degrades to the plain ring order (the fallback rung) instead of
+    # erroring
+    for i in range(4):
+        if i % 2 == shard:
+            placement.set_worker_shard(f"worker-{i}", None)
+    candidates = placement.candidates(machine)
+    assert sorted(candidates) == [f"worker-{i}" for i in range(4)]
+    table = placement.stats()["worker_shards"]
+    assert all(value != shard for value in table.values())
+    # the elastic seam assigns by the DECLARED shard count — a shrunken
+    # live table (retired workers) must not change new slots' shards,
+    # or the router would disagree with the worker's --mesh-shard flag
+    assert placement.mesh_shard_for(6) == 6 % 2
+    assert placement.mesh_shard_for(7) == 7 % 2
+
+
+def test_placement_set_mesh_flips_policy():
+    """The /reload policy seam: fleet membership crossing the sharding
+    threshold flips the router between sharded and replicated routing
+    atomically, matching what the workers' rescans derive."""
+    placement, plan = _mesh_placement()
+    assert placement.stats()["worker_shards"] != {}
+    assert placement.set_mesh(None, None, None) is True
+    assert placement.stats()["worker_shards"] == {}
+    assert placement.shard_of("anything") is None
+    assert placement.mesh_shard_for(4) is None
+    # clearing twice is a no-op, not a flip
+    assert placement.set_mesh(None, None, None) is False
+    assert placement.set_mesh(
+        plan.shard_of, {"worker-0": 0, "worker-1": 1}, 2
+    ) is True
+    assert placement.mesh_shard_for(5) == 1
+
+
+def test_fleet_at_least_counts_artifact_dirs(mesh_fleet, tmp_path):
+    from gordo_components_tpu.router import _fleet_at_least
+
+    root = os.path.dirname(next(iter(mesh_fleet.values())))
+    assert _fleet_at_least(root, 1)
+    assert _fleet_at_least(root, len(FLEET))
+    assert not _fleet_at_least(root, len(FLEET) + 1)
+    assert _fleet_at_least(root, 0)
+    # unreadable root: the workers decide — never silently un-mesh
+    assert _fleet_at_least(str(tmp_path / "missing"), 3)
+
+
+def test_placement_without_mesh_unchanged():
+    placement = Placement([f"worker-{i}" for i in range(3)])
+    assert placement.shard_of("anything") is None
+    assert placement.mesh_shard_for(5) is None
+    assert placement.stats()["worker_shards"] == {}
+
+
+# -- the mesh-sharded server mode -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh_fleet(tmp_path_factory):
+    root = tmp_path_factory.mktemp("mesh-fleet")
+    dirs = {}
+    for name in FLEET:
+        dirs[name] = provide_saved_model(
+            name, MODEL_CONFIG, DATA_CONFIG, str(root / name),
+            evaluation_config={"cv_mode": "build_only"},
+        )
+    return dirs
+
+
+def _post(client, path, payload):
+    return client.post(
+        path, data=json.dumps(payload),
+        content_type="application/json",
+    )
+
+
+_X = [[0.1, 0.2, 0.3]] * 4
+
+
+def test_mesh_server_partition_headers_and_parity(mesh_fleet, monkeypatch):
+    monkeypatch.delenv("GORDO_MESH_MIN_SHARD_MACHINES", raising=False)
+    plan = FleetShardPlan(2)
+    owned0 = set(plan.owned(FLEET, 0))
+    assert 0 < len(owned0) < len(FLEET)
+    root = os.path.dirname(next(iter(mesh_fleet.values())))
+    reference = Client(build_app(dict(mesh_fleet), project="proj"))
+    shard0 = Client(
+        build_app(dict(mesh_fleet), project="proj", models_root=root,
+                  mesh_shards=2, mesh_shard=0)
+    )
+
+    health = shard0.get("/healthz").get_json()
+    assert health["mesh"] == {
+        "shard": 0, "shards": 2,
+        "owned": len(owned0),
+        "remote_or_lazy": len(FLEET) - len(owned0),
+    }
+    # the reference single-host server carries no mesh facet
+    assert reference.get("/healthz").get_json()["mesh"] is None
+
+    owned_machine = sorted(owned0)[0]
+    remote_machine = sorted(set(FLEET) - owned0)[0]
+    for machine in (owned_machine, remote_machine):
+        response = _post(
+            shard0, f"/gordo/v0/proj/{machine}/prediction", {"X": _X}
+        )
+        assert response.status_code == 200
+        # every answer says which shard served it — including the
+        # fallback rung serving another shard's machine
+        assert response.headers["X-Gordo-Shard"] == "0"
+        expected = _post(
+            reference, f"/gordo/v0/proj/{machine}/prediction", {"X": _X}
+        ).get_json()["data"]["model-output"]
+        # f32 parity gate: owned-slice scoring AND the spill fallback
+        # rung both match the single-host path exactly
+        assert response.get_json()["data"]["model-output"] == expected
+
+    # engine-level accounting: the mesh facet counts the split
+    engine = shard0.get("/metrics").get_json()["engine"]["mesh"]
+    assert engine["shard"] == 0 and engine["shards"] == 2
+    assert engine["owned_machines"] == len(owned0)
+    assert engine["remote_machines"] == len(FLEET) - len(owned0)
+
+
+def test_mesh_server_below_threshold_replicates(mesh_fleet, monkeypatch):
+    monkeypatch.setenv("GORDO_MESH_MIN_SHARD_MACHINES", "100")
+    root = os.path.dirname(next(iter(mesh_fleet.values())))
+    shard1 = Client(
+        build_app(dict(mesh_fleet), project="proj", models_root=root,
+                  mesh_shards=2, mesh_shard=1)
+    )
+    health = shard1.get("/healthz").get_json()
+    # declared policy: a 6-machine fleet below the threshold stays
+    # replicated — every machine eager on every shard
+    assert health["mesh"]["owned"] == len(FLEET)
+    assert health["mesh"]["remote_or_lazy"] == 0
+
+
+def test_mesh_server_invalid_shard_degrades_single_host(mesh_fleet):
+    root = os.path.dirname(next(iter(mesh_fleet.values())))
+    app = build_app(dict(mesh_fleet), project="proj", models_root=root,
+                    mesh_shards=2, mesh_shard=9)
+    assert app.mesh_shards == 0 and app.mesh_shard is None
+    health = Client(app).get("/healthz").get_json()
+    assert health["mesh"] is None
+
+
+def test_mesh_server_without_models_root_serves_single_host(mesh_fleet):
+    """Explicit registration overrides the layout: a rootless boot
+    (--model-dir only) must not demote machines behind the spill tier
+    — there is no rescannable fleet to partition."""
+    app = build_app(dict(mesh_fleet), project="proj",
+                    mesh_shards=2, mesh_shard=0)
+    assert app.mesh_shards == 0 and app.mesh_shard is None
+    health = Client(app).get("/healthz").get_json()
+    assert health["mesh"] is None and health["ready"] is True
+
+
+# -- stitched lanes: per-shard naming + the clock-skew clamp --------------
+
+
+def test_stitch_lane_names_shard():
+    from gordo_components_tpu.router.router import _stitch_lane
+
+    assert _stitch_lane("worker-2", {"meta": {"shard": 1}}) == \
+        "worker-2@shard-1"
+    assert _stitch_lane("worker-2", {"meta": {}}) == "worker-2"
+    assert _stitch_lane("worker-2", {}) == "worker-2"
+
+
+@pytest.mark.parametrize("skew", [300.0, -300.0])
+def test_cross_process_stitch_clamps_skewed_worker(skew):
+    """Satellite: the §18 clamp-into-forward-window path against a REAL
+    separate process whose wall clock is deliberately ±5 minutes off —
+    the merged worker lane must land inside the router's observed
+    forward window (and carry its mesh shard in the lane name), never
+    render 300 s outside the route span."""
+    from fixtures.multiproc import free_port
+
+    from gordo_components_tpu.observability import flightrec
+    from gordo_components_tpu.observability.tracing import TRACE_HEADER
+    from gordo_components_tpu.router import (
+        SubprocessWorker,
+        WorkerSpec,
+        assemble_fleet,
+    )
+
+    port = free_port()
+    worker_py = os.path.join(
+        os.path.dirname(__file__), "fixtures", "skewed_worker.py"
+    )
+    spec = WorkerSpec("worker-0", 0, "127.0.0.1", port)
+
+    def factory(spec):
+        return SubprocessWorker(
+            spec,
+            [sys.executable, worker_py, str(spec.port), str(skew), "1"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    router = assemble_fleet([spec], factory, project="skew", respawn=False)
+    was_enabled = flightrec.RECORDER.enabled
+    flightrec.RECORDER.set_enabled(True)
+    try:
+        router.supervisor.start_all()
+        assert router.supervisor.wait_ready(timeout=30) == ["worker-0"]
+        client = Client(router)
+        response = _post(
+            client, "/gordo/v0/skew/mach-skew/prediction", {"X": _X}
+        )
+        assert response.status_code == 200
+        trace_id = response.headers[TRACE_HEADER]
+        timeline = flightrec.RECORDER.get(trace_id)
+        assert timeline is not None
+        remote = [span for span in timeline.spans if span.process]
+        assert remote, "worker timeline was not stitched"
+        lane = {span.process for span in remote}
+        # the mesh shard stamps the Perfetto lane name
+        assert lane == {"worker-0@shard-1"}
+        assert timeline.meta.get("stitched") == ["worker-0@shard-1"]
+        execute = next(
+            span for span in remote if span.name == "device_execute"
+        )
+        # the clamp: despite the ±300 s wall-clock skew, the remote
+        # span renders INSIDE the route's forward window — within this
+        # (sub-second) request, not minutes away
+        assert 0.0 <= execute.start <= timeline.duration + 0.01
+        assert execute.start < 30.0
+    finally:
+        flightrec.RECORDER.set_enabled(was_enabled)
+        router.supervisor.stop_all(grace=5)
+        router.close()
+
+
+# -- the true-SPMD drill: collectives only inside jit ---------------------
+
+
+@pytest.mark.slow
+def test_serve_shard_spmd_two_processes():
+    """2 processes, one global fleet mesh: the stacked machine axis
+    shards across them (shard-plan padding + NamedSharding) and a
+    lockstep jitted gather-by-idx scores machines living on BOTH
+    slices; each rank parity-checks against a dense local reference."""
+    from fixtures.multiproc import run_mesh_children_retry
+
+    codes, outputs = run_mesh_children_retry(
+        ["--serve-shard"], timeout=420, n_procs=2
+    )
+    assert codes == [0, 0], "\n".join(outputs)
+    for pid, out in enumerate(outputs):
+        assert f"serve-shard@{pid}" in out, out

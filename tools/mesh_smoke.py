@@ -1,0 +1,435 @@
+#!/usr/bin/env python
+"""Mesh-serving smoke: the multi-host sharded fleet end to end
+(``make mesh-smoke``, docs/ARCHITECTURE.md §23).
+
+The experiment (ISSUE 15 acceptance scenario): a 6-machine fleet whose
+stacked params partition across a 2-process serving mesh — worker ``i``
+is shard ``i``, stacking ONLY the machines the deterministic shard plan
+assigns it, with every other machine reachable through its host-RAM
+spill tier (the fallback rung). A live mesh tier must then:
+
+- place by layout: the router walks each machine's OWNING shard's
+  workers first, verified via the ``X-Gordo-Shard`` response header
+  matching the plan;
+- score at PARITY: every machine's mesh-served scores byte-identical
+  (f32) to the single-host reference path over the same artifacts;
+- survive the loss of one shard HOST (SIGKILL, no respawn): its
+  machines degrade to the surviving shard's spill fallback rung with
+  ZERO client-visible errors — and say so in ``X-Gordo-Shard`` and the
+  ``gordo_mesh_requests_total{path="fallback"}`` series;
+- warm re-boot recompile-free: a second boot of the SAME mesh layout
+  against the shared compile-cache store pays ZERO fresh XLA compiles
+  on every shard (mesh topology is already in the cache key schema).
+
+Exit codes: 0 = all checks passed, 1 = at least one failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATA_CONFIG = {
+    "type": "RandomDataset",
+    "train_start_date": "2023-01-01T00:00:00+00:00",
+    "train_end_date": "2023-01-04T00:00:00+00:00",
+    "tag_list": ["tag-a", "tag-b", "tag-c"],
+}
+MODEL_CONFIG = {
+    "Pipeline": {
+        "steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                  "dims": [6], "epochs": 1,
+                                  "batch_size": 32}},
+        ]
+    }
+}
+# this name set splits 3/3 across a 2-shard ring (the plan is a pure
+# function of the names — see tests/test_mesh_serving.py)
+MACHINES = tuple(f"mesh-{i:03d}" for i in range(6))
+N_SHARDS = 2
+
+_failures: list = []
+
+
+def check(ok: bool, message: str) -> None:
+    marker = "ok  " if ok else "FAIL"
+    print(f"  {marker} {message}")
+    if not ok:
+        _failures.append(message)
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _worker_compiles(session, base_url: str) -> float:
+    """Fresh-XLA-compile count a worker has paid (absent series = 0)."""
+    body = session.get(f"{base_url}/metrics", timeout=10).json()
+    series = (
+        body.get("registry", {})
+        .get("gordo_engine_compile_seconds", {})
+        .get("series", {})
+    )
+    return sum(entry["count"] for entry in series.values())
+
+
+def _mesh_series(session, base_url: str) -> dict:
+    """gordo_mesh_requests_total label-string -> count."""
+    body = session.get(f"{base_url}/metrics", timeout=10).json()
+    return (
+        body.get("registry", {})
+        .get("gordo_mesh_requests_total", {})
+        .get("series", {})
+    )
+
+
+class _Traffic:
+    """Background scoring traffic round-robin over the fleet; collects
+    every outcome for the zero-drop gates."""
+
+    def __init__(self, base: str, payload: str, n_threads: int = 4):
+        import requests
+
+        self.base = base
+        self.payload = payload
+        self.n_threads = n_threads
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.outcomes: list = []
+        self._threads: list = []
+        self._sessions = [requests.Session() for _ in range(n_threads)]
+
+    def _run(self, t: int) -> None:
+        headers = {"Content-Type": "application/json"}
+        session = self._sessions[t]
+        i = 0
+        while not self._stop.is_set():
+            machine = MACHINES[(t + i) % len(MACHINES)]
+            i += 1
+            try:
+                response = session.post(
+                    f"{self.base}/gordo/v0/mesh-smoke/{machine}/prediction",
+                    data=self.payload, headers=headers, timeout=60,
+                )
+                outcome = response.status_code
+            except Exception as exc:
+                outcome = f"EXC:{type(exc).__name__}"
+            with self._lock:
+                self.outcomes.append(outcome)
+            time.sleep(0.02)
+
+    def start(self) -> None:
+        self._threads = [
+            threading.Thread(target=self._run, args=(t,), daemon=True)
+            for t in range(self.n_threads)
+        ]
+        for thread in self._threads:
+            thread.start()
+
+    def mark(self) -> int:
+        with self._lock:
+            return len(self.outcomes)
+
+    def since(self, mark: int) -> list:
+        with self._lock:
+            return list(self.outcomes[mark:])
+
+    def stop(self) -> list:
+        self._stop.set()
+        for thread in self._threads:
+            thread.join(timeout=10)
+        for session in self._sessions:
+            session.close()
+        with self._lock:
+            return list(self.outcomes)
+
+
+def _boot_mesh(models_root: str, log_dir: str, tag: str):
+    """One 2-worker mesh tier over ``models_root``: worker i = shard i,
+    router placement layout-aware. Returns (router, specs, front, base)."""
+    import logging
+    import threading as _threading
+
+    from werkzeug.serving import make_server
+
+    from gordo_components_tpu.router import (
+        SubprocessWorker,
+        assemble_fleet,
+        server_worker_argv,
+        worker_specs,
+    )
+
+    logging.getLogger("werkzeug").setLevel(logging.WARNING)
+    specs = worker_specs(N_SHARDS, 0, host="127.0.0.1")
+    specs = [spec._replace(port=_free_port()) for spec in specs]
+
+    def factory(spec):
+        log = open(
+            os.path.join(log_dir, f"{tag}-{spec.name}.log"), "ab"
+        )
+        return SubprocessWorker(
+            spec,
+            server_worker_argv(
+                spec, models_root, project="mesh-smoke",
+                extra=[
+                    "--mesh-shards", str(N_SHARDS),
+                    "--mesh-shard", str(spec.worker_id % N_SHARDS),
+                ],
+            ),
+            env={"JAX_PLATFORMS": "cpu", "GORDO_DRAIN_TIMEOUT": "10"},
+            stdout=log, stderr=log,
+        )
+
+    router = assemble_fleet(
+        specs, factory, project="mesh-smoke", models_root=models_root,
+        respawn=False, breaker_recovery=3.0, boot_grace=120.0,
+        mesh_shards=N_SHARDS,
+    )
+    router.supervisor.start_all()
+    ready = router.supervisor.wait_ready(timeout=300)
+    if len(ready) != N_SHARDS:
+        for spec in specs:
+            log_path = os.path.join(log_dir, f"{tag}-{spec.name}.log")
+            if os.path.exists(log_path):
+                with open(log_path) as fh:
+                    print(f"--- {spec.name} log tail ---\n"
+                          + "".join(fh.readlines()[-20:]), file=sys.stderr)
+        raise RuntimeError(f"only {len(ready)}/{N_SHARDS} workers ready")
+    router.control.start(interval=0.5)
+    front = make_server("127.0.0.1", 0, router, threaded=True)
+    front_thread = _threading.Thread(
+        target=front.serve_forever, daemon=True
+    )
+    front_thread.start()
+    base = f"http://127.0.0.1:{front.server_port}"
+    return router, specs, front, front_thread, base
+
+
+def _stop_mesh(router, front, front_thread, grace: float = 10.0) -> None:
+    router.control.stop()
+    front.shutdown()
+    front_thread.join(timeout=5)
+    router.supervisor.stop_all(grace=grace)
+    router.close()
+
+
+def main() -> int:
+    import tempfile
+
+    import requests
+    from werkzeug.test import Client
+
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.parallel.shard_plan import FleetShardPlan
+    from gordo_components_tpu.server import build_app
+
+    plan = FleetShardPlan(N_SHARDS)
+    owners = plan.assign(MACHINES)
+    counts = plan.counts(MACHINES)
+    session = requests.Session()
+    payload = json.dumps({"X": [[0.1, 0.2, 0.3]] * 4})
+    headers = {"Content-Type": "application/json"}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        models_root = os.path.join(tmp, "models")
+        os.makedirs(models_root)
+        log_dir = os.path.join(tmp, "logs")
+        os.makedirs(log_dir)
+        print(f"building {len(MACHINES)} throwaway machines ...",
+              file=sys.stderr)
+        for name in MACHINES:
+            provide_saved_model(
+                name, MODEL_CONFIG, DATA_CONFIG,
+                os.path.join(models_root, name),
+                evaluation_config={"cv_mode": "build_only"},
+            )
+
+        # single-host reference scores (in-process, same artifacts): the
+        # parity target every mesh-served response must match bytewise
+        print("[1/4] single-host reference + mesh layout", file=sys.stderr)
+        reference = Client(
+            build_app(
+                {
+                    name: os.path.join(models_root, name)
+                    for name in MACHINES
+                },
+                project="mesh-smoke",
+            )
+        )
+        expected = {}
+        for name in MACHINES:
+            body = reference.post(
+                f"/gordo/v0/mesh-smoke/{name}/prediction",
+                data=payload, content_type="application/json",
+            )
+            expected[name] = body.get_json()["data"]["model-output"]
+        check(all(count > 0 for count in counts),
+              f"shard plan covers both shards ({counts} machines/shard)")
+
+        print(f"spawning the {N_SHARDS}-shard mesh tier ...",
+              file=sys.stderr)
+        router, specs, front, front_thread, base = _boot_mesh(
+            models_root, log_dir, "boot1"
+        )
+        traffic = _Traffic(base, payload)
+        try:
+            # each shard's healthz declares the plan's partition
+            facets = {}
+            for spec in specs:
+                facets[spec.worker_id] = session.get(
+                    f"{spec.base_url}/healthz", timeout=10
+                ).json().get("mesh")
+            check(
+                all(
+                    facets[i]
+                    and facets[i]["shard"] == i
+                    and facets[i]["shards"] == N_SHARDS
+                    and facets[i]["owned"] == counts[i]
+                    for i in range(N_SHARDS)
+                ),
+                f"every shard owns its planned slice "
+                f"(healthz mesh facets: {facets})",
+            )
+
+            # [2/4] layout-routed scoring at byte parity
+            print("[2/4] owner-shard routing + f32 parity",
+                  file=sys.stderr)
+            routed_ok, parity_ok = True, True
+            for name in MACHINES:
+                response = session.post(
+                    f"{base}/gordo/v0/mesh-smoke/{name}/prediction",
+                    data=payload, headers=headers, timeout=60,
+                )
+                routed_ok &= (
+                    response.status_code == 200
+                    and response.headers.get("X-Gordo-Shard")
+                    == str(owners[name])
+                )
+                parity_ok &= (
+                    response.json()["data"]["model-output"]
+                    == expected[name]
+                )
+            check(routed_ok,
+                  "every machine answers 200 from its OWNING shard "
+                  "(X-Gordo-Shard matches the plan)")
+            check(parity_ok,
+                  "mesh-served scores byte-identical (f32) to the "
+                  "single-host reference")
+
+            # [3/4] SIGKILL one shard host: fallback rung, zero errors
+            print("[3/4] shard-host SIGKILL -> spill fallback rung",
+                  file=sys.stderr)
+            traffic.start()
+            time.sleep(1.0)
+            victim = next(
+                spec for spec in specs if spec.worker_id == 1
+            )
+            survivor = next(
+                spec for spec in specs if spec.worker_id == 0
+            )
+            fallback_before = sum(
+                count for key, count in _mesh_series(
+                    session, survivor.base_url
+                ).items() if 'path="fallback"' in key
+            )
+            mark = traffic.mark()
+            os.kill(router.supervisor.worker(victim.name).pid,
+                    signal.SIGKILL)
+            time.sleep(4.0)
+            outcomes = traffic.since(mark)
+            bad = [o for o in outcomes if o != 200]
+            check(len(outcomes) > 20,
+                  f"traffic kept flowing through the shard loss "
+                  f"({len(outcomes)} requests)")
+            check(not bad,
+                  f"ZERO client-visible errors through the shard loss "
+                  f"(bad: {bad[:5]} of {len(outcomes)})")
+            traffic.stop()
+            orphan = next(
+                name for name in MACHINES if owners[name] == 1
+            )
+            response = session.post(
+                f"{base}/gordo/v0/mesh-smoke/{orphan}/prediction",
+                data=payload, headers=headers, timeout=60,
+            )
+            check(
+                response.status_code == 200
+                and response.headers.get("X-Gordo-Shard") == "0",
+                f"dead shard 1's machine {orphan} now served by shard 0 "
+                f"(the fallback rung)",
+            )
+            check(response.json()["data"]["model-output"]
+                  == expected[orphan],
+                  "fallback-rung scores ALSO byte-identical to the "
+                  "reference")
+            fallback_after = sum(
+                count for key, count in _mesh_series(
+                    session, survivor.base_url
+                ).items() if 'path="fallback"' in key
+            )
+            check(fallback_after > fallback_before,
+                  f"gordo_mesh_requests_total{{path=fallback}} counted "
+                  f"the degraded serving ({fallback_before} -> "
+                  f"{fallback_after})")
+        finally:
+            traffic.stop()
+            _stop_mesh(router, front, front_thread)
+
+        # [4/4] warm re-boot of the SAME layout: zero fresh XLA compiles
+        print("[4/4] warm mesh re-boot: zero fresh compiles",
+              file=sys.stderr)
+        router, specs, front, front_thread, base = _boot_mesh(
+            models_root, log_dir, "boot2"
+        )
+        try:
+            parity_ok, errors = True, []
+            for name in MACHINES:
+                response = session.post(
+                    f"{base}/gordo/v0/mesh-smoke/{name}/prediction",
+                    data=payload, headers=headers, timeout=60,
+                )
+                if response.status_code != 200:
+                    errors.append((name, response.status_code))
+                else:
+                    parity_ok &= (
+                        response.json()["data"]["model-output"]
+                        == expected[name]
+                    )
+            check(not errors and parity_ok,
+                  f"re-booted mesh serves the whole fleet at parity "
+                  f"(errors: {errors})")
+            compiles = {
+                spec.name: _worker_compiles(session, spec.base_url)
+                for spec in specs
+            }
+            check(all(count == 0 for count in compiles.values()),
+                  f"warm re-boot paid ZERO fresh XLA compiles on every "
+                  f"shard (counts: {compiles})")
+        finally:
+            _stop_mesh(router, front, front_thread)
+        session.close()
+
+    if _failures:
+        print(f"\nMESH SMOKE FAILED: {len(_failures)} check(s)",
+              file=sys.stderr)
+        return 1
+    print("\nmesh smoke passed: layout-routed at parity, shard loss "
+          "degrades to the fallback rung with zero errors, warm re-boot "
+          "recompile-free")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
