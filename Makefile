@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke smoke images builder-image server-image watchman-image
 
 # invariant linter (docs/ARCHITECTURE.md §17/§21): lock discipline
 # against the declared hierarchy, blocking-calls-under-hot-locks,
@@ -135,6 +135,16 @@ capacity-smoke:
 mesh-smoke:
 	JAX_PLATFORMS=cpu python tools/mesh_smoke.py
 
+# telemetry warehouse check (§24): Zipf load through 2 shard workers —
+# the merged /telemetry traffic sketch ranks machines exactly as the
+# load generator sent them, the measured-cost ledger reports nonzero
+# device bytes per precision rung and nonzero host-tier bytes, the
+# ?view=export layout-input document schema-validates and reproduces
+# the Zipf head, and a paired noise-floored gate holds the accounting
+# overhead <= 3% of request throughput
+telemetry-smoke:
+	JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
+
 # the full smoke battery: invariant lint + exposition + resilience +
 # store integrity + serving data plane + span attribution + cold-start
 # economics + cross-machine megabatching + the horizontal serving tier
@@ -144,7 +154,9 @@ mesh-smoke:
 # + the fleet-scale hot paths (index boot / spill tier / placement /
 #   bounded scrape)
 # + multi-host mesh serving (layout routing / fallback rung / warm boots)
-smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke
+# + the telemetry warehouse (traffic top-K / cost ledger / export /
+#   accounting overhead)
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke
 
 images: builder-image server-image watchman-image
 

@@ -53,7 +53,11 @@ BENCH_SERVE_AP_MACHINES (8 — that block's fleet size),
 BENCH_SERVE_CAPACITY (1 — include the 10k-machine fleet-scale capacity
 block, §22: index boot, spill tier, incremental ring, bounded scrape;
 0 skips its ~5 minutes) / GORDO_CAPACITY_MACHINES (10000) /
-GORDO_CAPACITY_SECONDS (8). The engine's own
+GORDO_CAPACITY_SECONDS (8),
+BENCH_SERVE_TELEMETRY (1 — include the telemetry warehouse block, §24:
+scrape latency, warehouse write cost, sketch coverage, cost-ledger
+headline; 0 skips it) / GORDO_TELEMETRY_BENCH_MACHINES (300) /
+GORDO_TELEMETRY_BENCH_SECONDS (6). The engine's own
 GORDO_MEGABATCH / GORDO_FILL_WINDOW_US / GORDO_MEGABATCH_RESIDENCY knobs
 apply as in production (ARCHITECTURE §15).
 """
@@ -1696,6 +1700,127 @@ def measure_capacity() -> dict:
     return report
 
 
+def measure_telemetry() -> dict:
+    """Telemetry warehouse block (ISSUE 16, ARCHITECTURE §24): the
+    observability plane's own cost and coverage at a shaped Zipf load
+    through the real 2-worker router tier —
+
+    - scrape latency: wall time of the merged ``/telemetry`` view and
+      of the ``?view=export`` layout-input render (router fan-out +
+      merge + schema-sized JSON, the price a scraper pays per poll);
+    - warehouse write economy: on-disk bytes, record count, and bytes
+      per record after the load (what the GORDO_TELEMETRY_MB budget
+      actually buys in retained history);
+    - traffic sketch coverage: tracked machines vs fleet size and the
+      hot machine's 1m EWMA rate;
+    - the measured-cost ledger headline: per-rung stacked device
+      bytes, host-cache tier bytes, and compile seconds banked.
+
+    Env: BENCH_SERVE_TELEMETRY=0 skips;
+    GORDO_TELEMETRY_BENCH_MACHINES (300) and
+    GORDO_TELEMETRY_BENCH_SECONDS (6) size the run."""
+    import shutil
+    import tempfile
+
+    import requests
+
+    from gordo_components_tpu.observability import telemetry as tel
+    from gordo_components_tpu.observability import traffic as traffic_mod
+    from tools import capacity_harness as ch
+
+    machines_n = int(
+        os.environ.get("GORDO_TELEMETRY_BENCH_MACHINES", "300")
+    )
+    seconds = float(os.environ.get("GORDO_TELEMETRY_BENCH_SECONDS", "6"))
+    saved = {
+        k: os.environ.get(k)
+        for k in ("GORDO_TELEMETRY", "GORDO_TELEMETRY_INTERVAL")
+    }
+    os.environ["GORDO_TELEMETRY"] = "1"
+    os.environ["GORDO_TELEMETRY_INTERVAL"] = "0"  # every scrape ticks
+    root = tempfile.mkdtemp(prefix="gordo-bench-telemetry-")
+    tier = None
+    try:
+        ch.generate_fleet(root, machines_n)
+        machines = sorted(
+            name for name in os.listdir(root)
+            if name.startswith("cap-")
+        )
+        tier = ch.RouterTier(root, n_workers=2, eager=8)
+        tier.warm(machines)
+        traffic_mod.ACCOUNTANT.reset()
+        traffic_mod.ACCOUNTANT.tick()  # EWMA baseline for the load
+        load = ch.run_load(tier.base_url, machines, seconds, threads=6)
+
+        t0 = time.perf_counter()
+        view = requests.get(
+            f"{tier.base_url}/telemetry", params={"window": 600},
+            timeout=30,
+        ).json()
+        view_ms = (time.perf_counter() - t0) * 1000
+        t0 = time.perf_counter()
+        doc = requests.get(
+            f"{tier.base_url}/telemetry",
+            params={"window": 600, "view": "export"}, timeout=30,
+        ).json()
+        export_ms = (time.perf_counter() - t0) * 1000
+
+        warehouse = view.get("warehouse") or {}
+        records = int(warehouse.get("records") or 0)
+        traffic_view = view.get("traffic") or {}
+        top = traffic_view.get("machines") or []
+        engine_costs = (view.get("costs") or {}).get("engine") or {}
+        compile_costs = (view.get("costs") or {}).get("compile") or {}
+        return {
+            "machines": machines_n,
+            "load": load,
+            "view_scrape_ms": round(view_ms, 2),
+            "export_scrape_ms": round(export_ms, 2),
+            "export_valid": not tel.validate_layout_input(doc),
+            "export_machines": len(doc.get("machines") or ()),
+            "warehouse": warehouse,
+            "tracked_machines": len(top),
+            "hot_rate_1m": (top[0].get("rates") or {}).get("1m")
+            if top else None,
+            "rungs": {
+                rung: {
+                    "device_bytes": entry.get("device_bytes"),
+                    "requests": entry.get("requests"),
+                }
+                for rung, entry in (
+                    engine_costs.get("rungs") or {}
+                ).items()
+            },
+            "host_cache_bytes": (
+                engine_costs.get("host_cache") or {}
+            ).get("bytes"),
+            "compile_seconds_total": compile_costs.get("seconds_total"),
+            "headlines": {
+                "rps": load.get("rps"),
+                "view_scrape_ms": round(view_ms, 2),
+                "export_scrape_ms": round(export_ms, 2),
+                "warehouse_bytes": warehouse.get("bytes"),
+                "warehouse_records": records,
+                "bytes_per_record": (
+                    round(warehouse.get("bytes", 0) / records, 1)
+                    if records else None
+                ),
+                "tracked_machines": len(top),
+                "export_valid": not tel.validate_layout_input(doc),
+            },
+        }
+    finally:
+        if tier is not None:
+            tier.close()
+        traffic_mod.ACCOUNTANT.reset()
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> None:
     from gordo_components_tpu.utils.backend import (
         enable_persistent_compile_cache,
@@ -1737,6 +1862,11 @@ def main() -> None:
     # BENCH_SERVE_CAPACITY=0 skips — it takes ~5 minutes)
     if os.environ.get("BENCH_SERVE_CAPACITY", "1") == "1":
         result["capacity"] = measure_capacity()
+    # telemetry warehouse: scrape latency, warehouse write economy,
+    # sketch coverage, and the cost-ledger headline at a shaped Zipf
+    # load (ISSUE 16, §24; BENCH_SERVE_TELEMETRY=0 skips it)
+    if os.environ.get("BENCH_SERVE_TELEMETRY", "1") == "1":
+        result["telemetry"] = measure_telemetry()
     if degraded:
         result["degraded"] = (
             "accelerator tunnel down; measured on the CPU backend — "
@@ -1800,6 +1930,9 @@ def main() -> None:
             # fleet-scale capacity headlines: §22 before/after numbers
             # (index boot, spill tier, incremental ring, bounded scrape)
             "capacity": (result.get("capacity") or {}).get("headlines"),
+            # telemetry warehouse headlines: scrape cost, write
+            # economy, sketch coverage, export validity (§24)
+            "telemetry": (result.get("telemetry") or {}).get("headlines"),
         })
     except Exception:
         pass  # history is never worth failing an artifact over

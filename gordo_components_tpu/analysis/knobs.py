@@ -182,6 +182,30 @@ KNOBS: Dict[str, Knob] = dict(
               "min seconds between scrape-driven SLO evaluation ticks "
               "(`/metrics` and `/slo` reads piggyback evaluation)",
               "observability"),
+        _knob("GORDO_TELEMETRY", "1", "bool",
+              "fleet telemetry warehouse (§24): `0` disables the "
+              "snapshotter, traffic accounting, and `/telemetry` "
+              "(answers disabled)", "observability"),
+        _knob("GORDO_TELEMETRY_DIR", "unset", "path",
+              "warehouse segment directory; unset = "
+              "`<models_root>/.telemetry/worker-<id>` (in-memory only "
+              "when no models root either)", "observability"),
+        _knob("GORDO_TELEMETRY_MB", "64", "int",
+              "hard byte budget for the on-disk warehouse in MiB; "
+              "whole oldest segments are deleted to stay under it",
+              "observability"),
+        _knob("GORDO_TELEMETRY_INTERVAL", "15", "float",
+              "min seconds between scrape-driven warehouse snapshot "
+              "ticks (`/metrics` and `/telemetry` reads piggyback)",
+              "observability"),
+        _knob("GORDO_TELEMETRY_TOPK", "512", "int",
+              "Space-Saving sketch capacity: how many heavy-hitter "
+              "machines the traffic accountant tracks exactly-ish "
+              "(error bounded by total/capacity)", "observability"),
+        _knob("GORDO_TELEMETRY_SEGMENT_KB", "256", "int",
+              "warehouse segment rotation threshold in KiB (smaller = "
+              "finer-grained budget trims, more files)",
+              "observability"),
         # -- autopilot (§20) ---------------------------------------------
         _knob("GORDO_AUTOPILOT", "unset", "bool",
               "closed-loop controller: `1` enables at boot, unset boots "
@@ -298,6 +322,19 @@ KNOBS: Dict[str, Knob] = dict(
               "capacity harness: fleet size for the `slow`-marked full "
               "sweep (tests/test_capacity_slow.py) — scale down for a "
               "faster manual run", "bench"),
+        _knob("GORDO_TELEMETRY_SMOKE_MACHINES", "120", "int",
+              "telemetry smoke (§24): synthetic-fleet size for "
+              "`tools/telemetry_smoke.py`", "bench"),
+        _knob("GORDO_TELEMETRY_SMOKE_SECONDS", "5", "float",
+              "telemetry smoke: seconds of Zipf load through the "
+              "2-worker router tier", "bench"),
+        _knob("GORDO_TELEMETRY_BENCH_MACHINES", "300", "int",
+              "bench `telemetry` block (§24): synthetic-fleet size",
+              "bench"),
+        _knob("GORDO_TELEMETRY_BENCH_SECONDS", "6", "float",
+              "bench `telemetry` block: seconds of Zipf load before "
+              "the scrape-cost and warehouse-economy measurements",
+              "bench"),
         # -- test / validation harnesses ---------------------------------
         _knob("GORDO_LOCKCHECK", "0", "bool",
               "runtime lock-order validator: named locks record real "

@@ -46,6 +46,7 @@ LOCK_RANKS: Dict[str, int] = {
     "resilience.quarantine": 62,  # quarantine.py ledger
     "resilience.faults": 64,    # faults.py injection plan
     "client.io": 66,            # client.py pooled-loop lifecycle
+    "observability.telemetry": 67,  # telemetry.py warehouse index + segments
     "observability.slo": 68,    # slo.py evaluator history + breach state
     # -- engine data plane (innermost: these sit under everything above
     # via reload-time warmup and request-path scoring)
@@ -55,6 +56,9 @@ LOCK_RANKS: Dict[str, int] = {
     "engine.mega": 82,          # _Bucket._mega_lock residency routing
     "engine.host_cache": 84,    # host_cache.py LRU dict + byte ledger (§22)
     "engine.shard_dispatch": 90,  # process-global collective-launch lock
+    # innermost of all: the traffic accountant's note() runs on the
+    # request path inside scoring (§24) — nothing may nest under it
+    "observability.traffic": 95,  # traffic.py sketch + EWMA pending state
 }
 
 # Request-hot-path locks: blocking calls under these stall live traffic
@@ -76,6 +80,7 @@ HOT_LOCKS = frozenset(
         "engine.mega",
         "engine.host_cache",
         "engine.shard_dispatch",
+        "observability.traffic",
     }
 )
 
@@ -101,6 +106,8 @@ LOCK_ATTRS: Dict[Tuple[str, str], str] = {
     ("router/router.py", "_models_lock"): "router.models",
     ("router/router.py", "_stitch_lock"): "router.stitch",
     ("observability/slo.py", "_lock"): "observability.slo",
+    ("observability/telemetry.py", "_lock"): "observability.telemetry",
+    ("observability/traffic.py", "_lock"): "observability.traffic",
     ("autopilot/controller.py", "_lock"): "autopilot.state",
     ("autopilot/elastic.py", "_lock"): "autopilot.elastic",
     ("parallel/shard_plan.py", "_PLAN_LOCK"): "parallel.shard_plan",
@@ -161,6 +168,11 @@ GUARDED_FIELDS: Dict[Tuple[str, str], str] = {
     # autopilot actuator state + decision journal (§20)
     ("autopilot/controller.py", "_state"): "autopilot.state",
     ("autopilot/controller.py", "_decisions"): "autopilot.state",
+    # telemetry warehouse query index / byte ledger + the traffic
+    # accountant's between-ticks pending counts and EWMA table (§24)
+    ("observability/telemetry.py", "_index"): "observability.telemetry",
+    ("observability/traffic.py", "_pending"): "observability.traffic",
+    ("observability/traffic.py", "_rates"): "observability.traffic",
 }
 
 

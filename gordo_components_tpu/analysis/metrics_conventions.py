@@ -32,7 +32,7 @@ CHECKER = "metrics-conventions"
 COMPONENTS = (
     "server", "engine", "client", "build", "builds", "fleet", "watchman",
     "router", "resilience", "store", "compile_cache", "span", "stage",
-    "drift", "lint", "slo", "autopilot", "mesh",
+    "drift", "lint", "slo", "autopilot", "mesh", "telemetry",
 )
 
 # §7 label allowlist: low-cardinality enums only. ``machine``/``worker``/

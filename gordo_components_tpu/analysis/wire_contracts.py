@@ -132,6 +132,10 @@ ROUTES: Tuple[RouteSpec, ...] = (
               "workers (§18)"),
     RouteSpec("/slo", ("server", "router"),
               "burn-rate objectives + per-stage attribution (§18)"),
+    RouteSpec("/telemetry", ("server", "router"),
+              "warehouse window queries + traffic top-K + cost ledger; "
+              "?view=export = layout-input doc; router merges workers "
+              "(§24)"),
     RouteSpec("/models", ("server", "router"), "served machine list"),
     RouteSpec("/prefetch", ("server",),
               "POST placement hint (§22): queue async host-cache loads "

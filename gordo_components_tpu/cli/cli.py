@@ -1016,6 +1016,121 @@ def autopilot_disable_cmd(base_url):
     click.echo(json.dumps(body, indent=2))
 
 
+@gordo.group("telemetry")
+def telemetry_group():
+    """The fleet telemetry warehouse (ARCHITECTURE §24): durable metric
+    history, per-machine traffic accounting, and the measured-cost
+    ledger, read from a live ``/telemetry`` endpoint.
+
+    ``traffic`` shows the top-K heavy hitters with multi-horizon EWMA
+    rates; ``costs`` shows the per-rung device/host byte and latency
+    ledger; ``export`` emits the versioned layout-input document
+    (machines x observed rate x bytes x latency per rung) that layout
+    planning consumes. Point ``--base-url`` at a router to read the
+    whole fleet merged, or at one worker for its slice.
+    """
+
+
+def _telemetry_request(base_url: str, window: Optional[float] = None,
+                       view: Optional[str] = None):
+    import requests
+
+    url = f"{base_url.rstrip('/')}/telemetry"
+    params = {}
+    if window is not None:
+        params["window"] = window
+    if view is not None:
+        params["view"] = view
+    try:
+        response = requests.get(url, params=params, timeout=10)
+        response.raise_for_status()
+        body = response.json()
+    except requests.RequestException as exc:
+        logger.error("Could not read /telemetry from %s: %s", base_url, exc)
+        sys.exit(1)
+    except ValueError:
+        logger.error("Non-JSON answer from %s", url)
+        sys.exit(1)
+    if not body.get("enabled", True) and "schema" not in body:
+        logger.error(
+            "Telemetry is disabled on %s (GORDO_TELEMETRY=0)", base_url
+        )
+        sys.exit(1)
+    return body
+
+
+@telemetry_group.command("traffic")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+@click.option("--window", default=300.0, show_default=True,
+              help="history window in seconds for rates/percentiles")
+def telemetry_traffic_cmd(base_url, window):
+    """Per-machine traffic accounting: the top-K heavy-hitter sketch
+    with 1m/10m/1h EWMA rates, plus shape-bucket x precision groups."""
+    body = _telemetry_request(base_url, window=window)
+    click.echo(json.dumps(
+        {
+            "now": body.get("now"),
+            "workers": body.get("workers", [body.get("worker")]),
+            "traffic": body.get("traffic"),
+            "window": body.get("window"),
+        },
+        indent=2,
+    ))
+
+
+@telemetry_group.command("costs")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+@click.option("--window", default=300.0, show_default=True,
+              help="history window in seconds")
+def telemetry_costs_cmd(base_url, window):
+    """The measured-cost ledger: per-rung stacked-tree device bytes,
+    dispatch seconds, host-cache tier bytes + hit/load latency EWMAs,
+    spill-path accounting, and per-key compile seconds."""
+    body = _telemetry_request(base_url, window=window)
+    click.echo(json.dumps(
+        {
+            "now": body.get("now"),
+            "workers": body.get("workers", [body.get("worker")]),
+            "costs": body.get("costs"),
+            "warehouse": body.get("warehouse"),
+        },
+        indent=2,
+    ))
+
+
+@telemetry_group.command("export")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+@click.option("--window", default=300.0, show_default=True,
+              help="history window in seconds the rates are measured over")
+@click.option("--output", "-o", default=None,
+              help="write the document here instead of stdout")
+def telemetry_export_cmd(base_url, window, output):
+    """Emit the versioned layout-input document from ``?view=export``.
+
+    The document (schema ``gordo-layout-input/v1``) is validated
+    client-side before it is printed — a malformed answer exits nonzero
+    rather than handing layout planning a broken contract.
+    """
+    from ..observability import telemetry as telemetry_engine
+
+    body = _telemetry_request(base_url, window=window, view="export")
+    problems = telemetry_engine.validate_layout_input(body)
+    if problems:
+        for problem in problems:
+            logger.error("layout-input validation: %s", problem)
+        sys.exit(1)
+    rendered = json.dumps(body, indent=2)
+    if output:
+        with open(output, "w") as fh:
+            fh.write(rendered + "\n")
+        click.echo(output)
+    else:
+        click.echo(rendered)
+
+
 @gordo.group("client")
 def client_group():
     """Bulk prediction against running servers."""

@@ -59,6 +59,10 @@ logger = logging.getLogger(__name__)
 KEY_FILE = "KEY.json"
 EXEC_FILE = "executable.bin"
 TREES_FILE = "treedefs.pkl"
+# sidecar measurements (NOT part of the cache key): the measured XLA
+# compile seconds this entry saved, read back by the §24 cost ledger.
+# Pre-ledger entries simply lack the file — `entries()` reports None.
+META_FILE = "META.json"
 
 # env knob read by the server/CLI wiring (a path, or "off" to disable the
 # cache even when a models_root would default one on)
@@ -166,11 +170,21 @@ class CompileCacheStore:
         return deserialize_and_load(payload, in_tree, out_tree)
 
     # -- write-back ----------------------------------------------------------
-    def put(self, program_key: Dict[str, Any], compiled: Any) -> bool:
+    def put(
+        self,
+        program_key: Dict[str, Any],
+        compiled: Any,
+        compile_seconds: Optional[float] = None,
+    ) -> bool:
         """Serialize ``compiled`` and commit it under ``program_key``
         (atomic; an existing entry — e.g. one that just read invalid — is
         replaced whole). Never raises: a cache that cannot write degrades
-        to compile-every-boot, not to a failed build or request."""
+        to compile-every-boot, not to a failed build or request.
+
+        ``compile_seconds``: the measured XLA compile duration this entry
+        amortizes, persisted as sidecar meta — the §24 cost ledger's
+        per-key compile cost, recorded once at the only moment it is
+        actually known."""
         key = fp.full_key(program_key)
         path = os.path.join(self.root, fp.entry_name(key))
         try:
@@ -191,6 +205,8 @@ class CompileCacheStore:
             return False
         try:
             os.makedirs(self.root, exist_ok=True)
+            import json
+
             with atomic_commit(path, name=os.path.basename(path)) as staging:
                 with open(os.path.join(staging, KEY_FILE), "w") as fh:
                     fh.write(fp.canonical(key) + "\n")
@@ -198,6 +214,14 @@ class CompileCacheStore:
                     fh.write(payload)
                 with open(os.path.join(staging, TREES_FILE), "wb") as fh:
                     fh.write(trees)
+                with open(os.path.join(staging, META_FILE), "w") as fh:
+                    json.dump(
+                        {
+                            "compile_seconds": compile_seconds,
+                            "created": time.time(),
+                        },
+                        fh,
+                    )
         except Exception as exc:
             logger.warning(
                 "Compile-cache write-back failed for %s (%s: %s)",
@@ -255,6 +279,13 @@ class CompileCacheStore:
             except Exception:
                 record.setdefault("error", "KEY.json unreadable")
                 record["current"] = False
+            try:
+                with open(os.path.join(path, META_FILE)) as fh:
+                    meta = json.load(fh)
+                record["compile_seconds"] = meta.get("compile_seconds")
+                record["created"] = meta.get("created")
+            except Exception:  # lint: allow-swallow(pre-ledger entries have no META.json sidecar by design; absence is the signal, recorded as compile_seconds=None)
+                record["compile_seconds"] = None
             out.append(record)
         return out
 

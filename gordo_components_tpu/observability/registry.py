@@ -43,6 +43,19 @@ INF = float("inf")
 MACHINE_LABEL = "machine"
 MACHINE_OTHER = "other"
 
+# The ONE authoritative top-K-by-traffic selection (§24): when the
+# telemetry traffic sketch is live, it nominates the kept machines for
+# every family, so a scrape shows one consistent survivor set instead of
+# per-family re-derivations that can disagree. observability.traffic
+# installs the provider at import time (a callable cap -> names); the
+# hook keeps the dependency pointed traffic -> registry, never back.
+_traffic_topk_provider = None
+
+
+def set_traffic_topk_provider(provider) -> None:
+    global _traffic_topk_provider
+    _traffic_topk_provider = provider
+
 
 def machine_cardinality_cap() -> int:
     """``GORDO_METRICS_MACHINE_CARDINALITY``: distinct machine label
@@ -99,7 +112,29 @@ def bound_machine_cardinality(
         totals[key[idx]] = totals.get(key[idx], 0.0) + weight(data)
     if len(totals) <= cap:
         return collected
-    keep = set(sorted(totals, key=lambda m: (-totals[m], m))[:cap])
+    keep: Optional[set] = None
+    if _traffic_topk_provider is not None:
+        try:
+            nominated = _traffic_topk_provider(cap)
+        except Exception:  # lint: allow-swallow(a broken traffic sketch must not break metric rendering; the recount below is the documented fallback)
+            nominated = None
+        if nominated:
+            # the sketch ranks by TOTAL traffic across all families;
+            # only machines present in THIS family's series can be kept,
+            # and any remaining slots fall back to the per-family
+            # recount so the cap is always filled
+            keep = set(nominated) & set(totals)
+            if len(keep) > cap:
+                keep = set(
+                    sorted(keep, key=lambda m: (-totals[m], m))[:cap]
+                )
+            elif len(keep) < cap:
+                for m in sorted(totals, key=lambda m: (-totals[m], m)):
+                    if len(keep) >= cap:
+                        break
+                    keep.add(m)
+    if keep is None:
+        keep = set(sorted(totals, key=lambda m: (-totals[m], m))[:cap])
     # "other" is a RESERVED label value once collapse is in play: a real
     # machine named "other" kept verbatim would collide with the
     # synthetic aggregate (counter sums merging into its kept entry,

@@ -49,6 +49,7 @@ from ..observability import (
     tracing,
 )
 from ..observability import slo as slo_engine
+from ..observability import telemetry as telemetry_engine
 from ..observability.registry import REGISTRY
 from ..watchman.control import DRAINING_HEADER, ControlPlane
 from .placement import Placement
@@ -117,6 +118,9 @@ _URL_MAP = Map(
         Rule("/healthz", endpoint="healthz"),
         Rule("/metrics", endpoint="metrics"),
         Rule("/slo", endpoint="slo"),
+        # fleet telemetry warehouse (§24): per-worker warehouses fetched
+        # and merged (rates summed, percentiles recomputed, latency MAX)
+        Rule("/telemetry", endpoint="telemetry"),
         # elastic autopilot: status + runtime kill switch (§20)
         Rule("/autopilot", endpoint="autopilot"),
         Rule("/autopilot/<action>", endpoint="autopilot-action"),
@@ -302,6 +306,20 @@ class FleetRouter:
                 return _json({"enabled": False})
             self.slo.maybe_tick()
             return _json(self.slo.snapshot(recorder=flightrec.RECORDER))
+        if endpoint == "telemetry":
+            if not telemetry_engine.enabled():
+                return _json({"enabled": False})
+            window = request.args.get("window", default=300.0, type=float)
+            merged, errors = self._aggregate_telemetry(window)
+            if request.args.get("view") == "export":
+                payload: Dict[str, Any] = telemetry_engine.build_export(
+                    merged, window=window
+                )
+            else:
+                payload = merged
+            if errors:
+                payload["errors"] = errors
+            return _json(payload)
         if endpoint == "autopilot":
             if self.autopilot is None:
                 return _json(disabled_snapshot())
@@ -666,6 +684,42 @@ class FleetRouter:
             for name in sorted(set(self.supervisor.specs) - set(targets))
         )
         return preamble + skipped + merged
+
+    def _aggregate_telemetry(
+        self, window: float
+    ) -> "tuple[Dict[str, Any], Dict[str, str]]":
+        """Fetch every routable worker's ``/telemetry`` view and merge
+        them into one fleet view (``telemetry.merge_views``). Unreachable,
+        malformed, or telemetry-disabled workers are named in the errors
+        map and skipped — the fleet view degrades, never dies."""
+        import requests
+
+        targets = {
+            name: spec.base_url
+            for name, spec in sorted(self.supervisor.specs.items())
+            if self.control.routable(name)
+        }
+        views: Dict[str, Dict[str, Any]] = {}
+        errors: Dict[str, str] = {}
+        for name, base in targets.items():
+            try:
+                reply = self._session.get(
+                    f"{base}/telemetry",
+                    params={"window": window},
+                    timeout=self.scrape_timeout,
+                )
+                reply.raise_for_status()
+                view = reply.json()
+            except (requests.RequestException, ValueError) as exc:
+                errors[name] = str(exc)
+                continue
+            if not isinstance(view, dict) or not view.get("enabled"):
+                errors[name] = "telemetry disabled on worker"
+                continue
+            views[name] = view
+        for name in sorted(set(self.supervisor.specs) - set(targets)):
+            errors[name] = "not routable, skipped"
+        return telemetry_engine.merge_views(views), errors
 
     # -- views ---------------------------------------------------------------
     def _healthz(self) -> Response:

@@ -84,6 +84,7 @@ from ..analysis import lockcheck
 from ..models.analysis import analyze_model
 from ..models.transformers import MinMaxScaler, StandardScaler
 from ..observability import spans
+from ..observability import traffic as traffic_accounting
 from ..observability.registry import REGISTRY
 from ..ops import windowing
 from ..ops.scaling import ScalerParams
@@ -826,6 +827,13 @@ class _Bucket:
         self.names = [e.name for e in entries]  # REAL machines only — padding
         # below must never surface in warmup/dispatch name lists
         self.n_features = int(np.atleast_1d(entries[0].sx.scale).shape[0])
+        # compact operator-readable shape identity for the §24 traffic
+        # groups (one value per bucket — bounded by construction)
+        self.shape_key = (
+            f"L{lookback}"
+            + (f"a{lookahead}" if lookahead is not None else "")
+            + f"f{self.n_features}"
+        )
         self._fleet_sharding = None
         if mesh is not None:
             from ..parallel.mesh import fleet_sharding, pad_to_multiple
@@ -944,6 +952,27 @@ class _Bucket:
         self.dispatch_count = 0
         self.request_count = 0
         self.max_batch_seen = 0
+        # accumulated compile-free device seconds (the §24 cost ledger's
+        # per-rung latency numerator) and the stacked tree's device
+        # footprint, computed once — the tree is immutable after build
+        self.dispatch_seconds_total = 0.0
+        self._stacked_nbytes: Optional[int] = None
+
+    def stacked_nbytes(self) -> int:
+        """Device bytes held by this bucket's stacked tree, computed once
+        (the tree is immutable after build). Reads each leaf's ``nbytes``
+        attribute — no device→host transfer — falling back to the host
+        conversion only for plain-list leaves."""
+        if self._stacked_nbytes is None:
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(self.stacked):
+                nbytes = getattr(leaf, "nbytes", None)
+                total += (
+                    int(nbytes) if nbytes is not None
+                    else int(np.asarray(leaf).nbytes)
+                )
+            self._stacked_nbytes = total
+        return self._stacked_nbytes
 
     # -- compiled programs ---------------------------------------------------
     def _machine_score_fn(self):
@@ -1243,8 +1272,12 @@ class _Bucket:
                 (rows, k) if kind == "cold" else (kind, rows, k)
             )
             return jitted
-        _M_COMPILE_SECONDS.labels(kind).observe(time.perf_counter() - started)
-        self._compile_cache.put(ckey, compiled)
+        compile_seconds = time.perf_counter() - started
+        _M_COMPILE_SECONDS.labels(kind).observe(compile_seconds)
+        # the measured compile cost rides along into the entry's meta —
+        # the §24 cost ledger reads per-key compile seconds back out of
+        # the store instead of re-measuring
+        self._compile_cache.put(ckey, compiled, compile_seconds=compile_seconds)
         return compiled
 
     def _gather_machine(self, idx: int):
@@ -1897,6 +1930,7 @@ class _Bucket:
                 _M_COMPILE_SECONDS.labels(job.kind).observe(seconds)
             else:
                 _M_DISPATCH_SECONDS.labels(job.kind).observe(seconds)
+                self.dispatch_seconds_total += seconds
             # results are filled BEFORE any accounting (ADVICE r5): a
             # _fill_results failure must error the waiters without having
             # counted their requests as served — previously hot counts
@@ -1985,6 +2019,7 @@ class _Bucket:
                 _M_COMPILE_SECONDS.labels("cold").observe(seconds)
             else:
                 _M_DISPATCH_SECONDS.labels("cold").observe(seconds)
+                self.dispatch_seconds_total += seconds
             # fill first, account after (ADVICE r5): a fill failure here
             # must not count these requests served a second time on top of
             # the hot path's failed attempt
@@ -2044,6 +2079,7 @@ class _Bucket:
                     _M_DISPATCH_SECONDS.labels("cold").observe(
                         fetched - started
                     )
+                    self.dispatch_seconds_total += fetched - started
                 # fill first, account after (ADVICE r5), like every
                 # other completion path
                 self._fill_results([item], x_tail, pred, scaled, total)
@@ -2457,6 +2493,10 @@ class ServingEngine:
         # needed — a plain dict with last-write-wins registration is
         # correct (two racing first-requests build equal scorers)
         self._spill_scorers: Dict[str, _SpillScorer] = {}
+        # §24 cost ledger: spill-path device seconds + request counts by
+        # precision rung (the stacked twin lives on each bucket)
+        self._spill_dispatch_seconds: Dict[str, float] = {}
+        self._spill_request_counts: Dict[str, int] = {}
         # cross-machine megabatching (ARCHITECTURE §15): replicated mode
         # only; env-resolved unless the caller overrides. fill_window_us
         # is zeroed when megabatching is off — the window is the fused
@@ -2802,6 +2842,13 @@ class ServingEngine:
         if resolved is None:
             raise KeyError(name)
         bucket, idx = resolved
+        # §24 traffic accounting: one note per REQUEST (not per chunk or
+        # dispatch), tagged with the serving bucket's shape + rung — the
+        # sketch/EWMA source the warehouse, /telemetry, and the metric
+        # cardinality bound all read
+        traffic_accounting.note(
+            name, bucket=bucket.shape_key, precision=bucket.precision
+        )
         if self.mesh_shard is not None:
             # §23: this shard owns the machine — the steady-state rung
             _M_MESH_REQUESTS.labels(str(self.mesh_shard[0]), "owned").inc()
@@ -2908,6 +2955,9 @@ class ServingEngine:
         scorer: _SpillScorer = bundle["scorer"]
         if scorer is None:
             raise SpillNotLiftable(bundle.get("skip") or name)
+        traffic_accounting.note(
+            name, bucket="spill", precision=scorer.precision
+        )
         return self._chunked_score(
             scorer, X,
             lambda x_padded, m_valid: self._spill_score_once(
@@ -2928,11 +2978,20 @@ class ServingEngine:
             outputs = program(tree, x_padded[None])
         with spans.stage("fetch", path="spill"):
             x_tail, pred, scaled, total = jax.device_get(outputs)
-        _M_DISPATCH_SECONDS.labels("spill").observe(
-            time.perf_counter() - started
-        )
+        elapsed = time.perf_counter() - started
+        _M_DISPATCH_SECONDS.labels("spill").observe(elapsed)
         _M_REQUESTS.labels("spill").inc()
         _M_PRECISION.labels(scorer.precision).inc()
+        # §24 cost ledger: spill device time accrues to the scorer's rung
+        # (GIL-atomic dict writes; a lost race under-counts one sample,
+        # which a cost EWMA can afford)
+        rung = scorer.precision
+        self._spill_dispatch_seconds[rung] = (
+            self._spill_dispatch_seconds.get(rung, 0.0) + elapsed
+        )
+        self._spill_request_counts[rung] = (
+            self._spill_request_counts.get(rung, 0) + 1
+        )
         return ScoreResult(
             model_input=x_tail[0][:m_valid],
             model_output=pred[0][:m_valid],
@@ -3042,4 +3101,47 @@ class ServingEngine:
                 "scorers": len(self._spill_scorers),
                 "host_cache": self.host_cache.stats(),
             },
+        }
+
+    def cost_ledger(self) -> Dict[str, Any]:
+        """The §24 measured-cost sample: what bench_serving only measures
+        offline, read from the live engine — per-rung stacked-tree device
+        bytes, served requests, and accumulated compile-free device
+        seconds (stacked buckets + the spill tier), plus the host-cache
+        tier's byte/latency economy. Consumed by the telemetry
+        warehouse's cost sampler each tick; everything here is O(buckets
+        + rungs), never O(machines)."""
+        rungs: Dict[str, Dict[str, float]] = {}
+
+        def rung_entry(precision: str) -> Dict[str, float]:
+            return rungs.setdefault(precision, {
+                "machines": 0,
+                "buckets": 0,
+                "device_bytes": 0,
+                "requests": 0,
+                "dispatch_seconds_total": 0.0,
+            })
+
+        for b in self._buckets:
+            entry = rung_entry(b.precision)
+            entry["machines"] += len(b.names)
+            entry["buckets"] += 1
+            entry["device_bytes"] += b.stacked_nbytes()
+            entry["requests"] += b.request_count
+            entry["dispatch_seconds_total"] += b.dispatch_seconds_total
+        for rung, seconds in self._spill_dispatch_seconds.items():
+            entry = rung_entry(rung)
+            entry["dispatch_seconds_total"] += seconds
+            entry["requests"] += self._spill_request_counts.get(rung, 0)
+        return {
+            "rungs": {rung: rungs[rung] for rung in sorted(rungs)},
+            "host_cache": self.host_cache.stats(),
+            "spill": {
+                "lazy_machines": len(self._lazy),
+                "scorers": len(self._spill_scorers),
+                "requests_total": sum(
+                    self._spill_request_counts.values()
+                ),
+            },
+            "host_path_machines": len(self.skipped),
         }

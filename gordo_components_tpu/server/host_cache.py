@@ -97,6 +97,13 @@ class HostTierCache:
         self.evictions = 0
         self.loads = 0
         self.prefetches = 0
+        # §24 cost ledger: smoothed hit-path and store-path latencies
+        # (seconds). Plain float writes outside the lock — a lost race
+        # drops one EWMA sample, which a smoothed cost can afford, and
+        # the request path never takes a second lock for accounting.
+        self.hit_latency_ewma: Optional[float] = None
+        self.load_latency_ewma: Optional[float] = None
+        self._latency_alpha = 0.05
 
     @property
     def enabled(self) -> bool:
@@ -106,6 +113,9 @@ class HostTierCache:
     def get(self, name: str) -> Optional[Any]:
         """The cached host entry (LRU-touched) or None. Counts hit/miss
         so the residency economy is readable off one counter pair."""
+        import time as _time
+
+        started = _time.perf_counter()
         with self._lock:
             lockcheck.assert_guard("engine.host_cache")
             cached = self._entries.get(name)
@@ -118,7 +128,18 @@ class HostTierCache:
             _M_EVENTS.labels("miss").inc()
             return None
         _M_EVENTS.labels("hit").inc()
+        self.hit_latency_ewma = self._fold_latency(
+            self.hit_latency_ewma, _time.perf_counter() - started
+        )
         return cached[0]
+
+    def _fold_latency(
+        self, prev: Optional[float], sample: float
+    ) -> float:
+        return (
+            sample if prev is None
+            else prev + self._latency_alpha * (sample - prev)
+        )
 
     def peek(self, name: str) -> Optional[Any]:
         """The cached entry WITHOUT touching LRU order or hit/miss
@@ -179,7 +200,11 @@ class HostTierCache:
 
         started = _time.perf_counter()
         entry, nbytes = loader()
-        _M_LOAD_SECONDS.observe(_time.perf_counter() - started)
+        load_seconds = _time.perf_counter() - started
+        _M_LOAD_SECONDS.observe(load_seconds)
+        self.load_latency_ewma = self._fold_latency(
+            self.load_latency_ewma, load_seconds
+        )
         with self._lock:
             self.loads += 1
         _M_EVENTS.labels("store").inc()
@@ -322,6 +347,8 @@ class HostTierCache:
                 "evictions": self.evictions,
                 "loads": self.loads,
                 "prefetches": self.prefetches,
+                "hit_latency_s": self.hit_latency_ewma,
+                "load_latency_s": self.load_latency_ewma,
             }
 
     def resident(self) -> Tuple[str, ...]:

@@ -61,6 +61,7 @@ from ..autopilot import build_server_autopilot, disabled_snapshot
 from ..models.anomaly.base import AnomalyDetectorBase
 from ..observability import exposition, flightrec, spans, stitch, tracing
 from ..observability import slo as slo_engine
+from ..observability import telemetry as telemetry_engine
 from ..observability.registry import REGISTRY
 from ..resilience import deadline, faults
 from ..resilience.admission import (
@@ -105,6 +106,10 @@ _URL_MAP = Map(
         # [...]} queues async host-cache loads for lazy machines
         Rule("/prefetch", endpoint="prefetch"),
         Rule("/slo", endpoint="slo"),
+        # fleet telemetry warehouse (§24): windowed rates / percentiles
+        # from the durable history, traffic top-K, measured-cost ledger;
+        # ?view=export renders the layout-input document
+        Rule("/telemetry", endpoint="telemetry"),
         Rule("/models", endpoint="models"),
         Rule("/reload", endpoint="reload"),
         # closed-loop controller status + runtime kill switch (§20)
@@ -633,6 +638,28 @@ class ModelServer:
         # self._tuning.
         self._tuning: Dict[str, int] = {}
         self.autopilot = build_server_autopilot(self)
+        # fleet telemetry warehouse (§24): durable counter/gauge/histogram
+        # history + traffic sketch + measured-cost ledger, snapshotted on
+        # the scrape path (maybe_tick, no thread). The warehouse lives in
+        # a dot-dir so the model rescan never mistakes it for an artifact.
+        self.telemetry: Optional[telemetry_engine.TelemetryWarehouse] = None
+        if telemetry_engine.enabled():
+            warehouse_dir = os.environ.get("GORDO_TELEMETRY_DIR")
+            if not warehouse_dir and models_root:
+                warehouse_dir = os.path.join(
+                    models_root,
+                    ".telemetry",
+                    f"worker-{worker_id if worker_id is not None else 0}",
+                )
+            self.telemetry = telemetry_engine.TelemetryWarehouse(
+                directory=warehouse_dir or None,
+                worker=(
+                    str(worker_id) if worker_id is not None else ""
+                ),
+                cost_sampler=lambda: telemetry_engine.sample_costs(
+                    self._state.engine, self.compile_cache
+                ),
+            )
         # every record emitted while serving a request carries its trace id
         # (idempotent; composes with logsetup.configure_logging)
         tracing.install_log_record_factory()
@@ -1448,6 +1475,19 @@ class ModelServer:
                 return _json({"enabled": False})
             self.slo.maybe_tick()
             return _json(self.slo.snapshot(recorder=flightrec.RECORDER))
+        if endpoint == "telemetry":
+            if self.telemetry is None:
+                return _json({"enabled": False})
+            # a telemetry read is also a snapshot tick (scrape-driven,
+            # like /slo) — min-interval-gated inside maybe_tick
+            self.telemetry.maybe_tick()
+            window = request.args.get("window", default=300.0, type=float)
+            view = self.telemetry.view(window=window)
+            if request.args.get("view") == "export":
+                return _json(
+                    telemetry_engine.build_export(view, window=window)
+                )
+            return _json(view)
         if endpoint == "autopilot":
             if self.autopilot is None:
                 return _json(disabled_snapshot())
@@ -1468,6 +1508,8 @@ class ModelServer:
                 self.slo.maybe_tick()
             if self.autopilot is not None:
                 self.autopilot.maybe_tick()
+            if self.telemetry is not None:
+                self.telemetry.maybe_tick()
             if request.args.get("format") == "prometheus":
                 # &exemplars=1 opts into OpenMetrics-style exemplar
                 # suffixes (gordo tooling / OpenMetrics ingesters); the

@@ -1,0 +1,565 @@
+"""Fleet telemetry warehouse (§24): durable metric history, the
+Space-Saving traffic sketch, window-query math, and the router's fleet
+merge.
+
+Warehouse and accountant tests run on FAKE clocks (hours of window
+arithmetic, zero sleeps) against private Registry instances; the final
+test is the acceptance path — two REAL ModelServer workers behind the
+router, one scored request each, ONE merged /telemetry view whose
+export document schema-validates.
+"""
+
+import json
+import math
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+from werkzeug.serving import make_server
+
+from gordo_components_tpu.observability import telemetry, traffic
+from gordo_components_tpu.observability.registry import (
+    Registry,
+    bound_machine_cardinality,
+)
+from gordo_components_tpu.router import WorkerSpec, assemble_fleet
+
+pytestmark = pytest.mark.usefixtures("thread_hygiene")
+
+
+class FakeClock:
+    """Injectable monotonic + wall pair (slo.py test idiom)."""
+
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> float:
+        self.now += seconds
+        return self.now
+
+
+def _warehouse(tmp_path, clock, registry, **kwargs):
+    defaults = dict(
+        directory=str(tmp_path),
+        registry=registry,
+        accountant=traffic.TrafficAccountant(capacity=16, clock=clock),
+        clock=clock,
+        wall=clock,
+        min_interval=1.0,
+    )
+    defaults.update(kwargs)
+    return telemetry.TelemetryWarehouse(**defaults)
+
+
+def _zipf_counts(n_machines: int, n_requests: int, s: float = 1.1,
+                 seed: int = 7):
+    """Exact per-machine request counts under a Zipf(s) draw."""
+    rng = np.random.default_rng(seed)
+    weights = 1.0 / np.arange(1, n_machines + 1) ** s
+    weights /= weights.sum()
+    draws = rng.choice(n_machines, size=n_requests, p=weights)
+    counts = {}
+    for idx in draws:
+        name = f"mach-{idx:04d}"
+        counts[name] = counts.get(name, 0) + 1
+    return counts, draws
+
+
+# -- segment rotation + byte budget -------------------------------------------
+
+
+def test_segment_rotation_and_byte_budget(tmp_path):
+    """Appends rotate segments at the segment limit, and the byte budget
+    deletes whole oldest segments — never the active one, never below
+    one segment of live history."""
+    clock = FakeClock()
+    registry = Registry()
+    counter = registry.counter("gordo_server_requests_total", "reqs",
+                               labels=("endpoint",))
+    wh = _warehouse(
+        tmp_path, clock, registry, segment_limit=512, budget=1500
+    )
+    for i in range(40):
+        counter.labels("anomaly").inc(10)
+        clock.advance(10.0)
+        wh.tick()
+    assert wh.rotations > 0
+    segments = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("seg-")
+    )
+    assert 1 <= len(segments) <= 4
+    # budget held: on-disk bytes match the ledger and stay bounded by
+    # budget + one active segment's worth of slack
+    on_disk = sum(
+        os.path.getsize(tmp_path / f) for f in segments
+    )
+    assert on_disk == wh.total_bytes()
+    assert wh.total_bytes() <= 1500 + 512
+    # the oldest segments were deleted (seq 0 is long gone)
+    assert "seg-00000000.jsonl" not in segments
+    # the index only holds records from surviving segments
+    view = wh.view(window=10_000.0)
+    assert view["warehouse"]["records"] < 40
+    assert view["warehouse"]["records"] > 0
+    wh.close()
+
+
+def test_memory_only_warehouse_answers_queries():
+    """directory=None: same ledger and window math, no disk."""
+    clock = FakeClock()
+    registry = Registry()
+    counter = registry.counter("gordo_server_requests_total", "reqs")
+    wh = _warehouse(None, clock, registry, directory=None)
+    for _ in range(5):
+        counter.labels().inc(7)
+        clock.advance(10.0)
+        wh.tick()
+    rate = wh.rate("gordo_server_requests_total", window=300.0)
+    assert rate["total"] == pytest.approx(0.7)
+    assert wh.view(window=300.0)["warehouse"]["dir"] is None
+
+
+# -- restart recovery with a torn tail ----------------------------------------
+
+
+def test_restart_recovers_history_with_torn_tail(tmp_path):
+    """The WAL contract: a crash mid-append leaves a torn final line;
+    reload drops it silently, keeps every whole record, and window
+    queries answer from pre-restart history."""
+    clock = FakeClock()
+    registry = Registry()
+    counter = registry.counter("gordo_server_requests_total", "reqs")
+    wh = _warehouse(tmp_path, clock, registry)
+    for _ in range(10):
+        counter.labels().inc(30)
+        clock.advance(30.0)
+        wh.tick()
+    wh.close()
+    segments = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("seg-")
+    )
+    # tear the tail: a crash mid-append wrote half a record
+    with open(tmp_path / segments[-1], "a") as fh:
+        fh.write('{"v": 1, "t": 99999.0, "dt": 30.0, "c": {"gordo')
+
+    registry2 = Registry()
+    clock2 = FakeClock(start=clock.now)
+    wh2 = _warehouse(tmp_path, clock2, registry2)
+    view = wh2.view(window=600.0, now_wall=clock.now)
+    # pre-restart history is queryable: 600s window covers the last
+    # ~20 ticks' records at 30s each
+    rate = view["window"]["rates"]["gordo_server_requests_total"]
+    assert rate["total"] == pytest.approx(1.0)
+    assert view["warehouse"]["records"] == 10  # torn line NOT counted
+    # and appends continue where the reload left off
+    counter2 = registry2.counter("gordo_server_requests_total", "reqs")
+    counter2.labels().inc(60)
+    clock2.advance(30.0)
+    wh2.tick()
+    assert wh2.view(window=600.0)["warehouse"]["records"] == 11
+    wh2.close()
+
+
+def test_reload_skips_corrupt_midfile_line(tmp_path):
+    clock = FakeClock()
+    registry = Registry()
+    counter = registry.counter("gordo_server_requests_total", "reqs")
+    wh = _warehouse(tmp_path, clock, registry)
+    for _ in range(4):
+        counter.labels().inc(10)
+        clock.advance(10.0)
+        wh.tick()
+    wh.close()
+    segment = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("seg-")
+    )[0]
+    lines = (tmp_path / segment).read_text().splitlines()
+    lines[1] = "NOT JSON AT ALL"
+    (tmp_path / segment).write_text("\n".join(lines) + "\n")
+    wh2 = _warehouse(tmp_path, FakeClock(start=clock.now), Registry())
+    assert wh2.view(window=600.0)["warehouse"]["records"] == 3
+    wh2.close()
+
+
+# -- sketch correctness on Zipf traffic ---------------------------------------
+
+
+def test_space_saving_error_bounds_on_zipf():
+    """The Metwally guarantees the §24 docs state: estimate - error <=
+    true <= estimate for every tracked key, and every key with true
+    count > N/capacity is tracked."""
+    counts, draws = _zipf_counts(400, 20_000)
+    sketch = traffic.SpaceSaving(64)
+    for idx in draws:
+        sketch.offer(f"mach-{idx:04d}")
+    n_total = len(draws)
+    for name, estimate, error in sketch.items():
+        true = counts.get(name, 0)
+        assert true <= estimate
+        assert estimate - error <= true
+    tracked = {name for name, _, _ in sketch.items()}
+    for name, true in counts.items():
+        if true > n_total / sketch.capacity:
+            assert name in tracked, (
+                f"{name} (count {true}) above the N/K guarantee line "
+                "but not tracked"
+            )
+
+
+def test_sketch_merge_matches_exact_counts_on_zipf():
+    """Router-merge soundness: two workers each sketch half the stream;
+    the merged sketch's estimates hold the same error contract against
+    EXACT whole-stream counts, and the merged top-10 matches the true
+    top-10."""
+    counts, draws = _zipf_counts(300, 30_000, seed=11)
+    a, b = traffic.SpaceSaving(128), traffic.SpaceSaving(128)
+    for i, idx in enumerate(draws):
+        (a if i % 2 == 0 else b).offer(f"mach-{idx:04d}")
+    merged = traffic.SpaceSaving.merged([a.to_list(), b.to_list()], 128)
+    for name, estimate, error in merged.items():
+        true = counts.get(name, 0)
+        assert true <= estimate
+        assert estimate - error <= true
+    true_top = [
+        name for name, _ in sorted(
+            counts.items(), key=lambda kv: (-kv[1], kv[0])
+        )[:10]
+    ]
+    merged_top = [name for name, _, _ in merged.top(10)]
+    assert merged_top == true_top
+
+
+def test_cardinality_bound_parity_with_traffic_sketch(monkeypatch):
+    """Satellite: with telemetry ON the registry's machine-cardinality
+    bound keeps the traffic sketch's top-K; with telemetry OFF it falls
+    back to the per-family recount — and on consistent Zipf load the two
+    authorities agree exactly."""
+    monkeypatch.setenv("GORDO_METRICS_MACHINE_CARDINALITY", "8")
+    counts, draws = _zipf_counts(60, 5_000, seed=3)
+    registry = Registry()
+    counter = registry.counter(
+        "gordo_server_requests_total", "reqs", labels=("machine",)
+    )
+    traffic.ACCOUNTANT.reset()
+    try:
+        for idx in draws:
+            name = f"mach-{idx:04d}"
+            traffic.note(name)
+        for name, n in counts.items():
+            counter.labels(name).inc(n)
+        collected = counter.collect()
+
+        monkeypatch.setenv("GORDO_TELEMETRY", "1")
+        via_sketch = bound_machine_cardinality(counter, collected)
+        monkeypatch.setenv("GORDO_TELEMETRY", "0")
+        via_recount = bound_machine_cardinality(counter, collected)
+    finally:
+        monkeypatch.setenv("GORDO_TELEMETRY", "1")
+        traffic.ACCOUNTANT.reset()
+    assert set(via_sketch) == set(via_recount)
+    # the collapsed "other" mass agrees too (same kept set, same input)
+    assert via_sketch == via_recount
+    assert len(via_sketch) <= 8 + 1  # top-8 + the "other" series
+
+
+# -- EWMA rate folding --------------------------------------------------------
+
+
+def test_ewma_rates_multi_horizon():
+    """First fold initializes to the instantaneous rate (honest first
+    estimate); an idle minute then decays the 1m rate by e^-1 while the
+    1h rate barely moves."""
+    clock = FakeClock()
+    acct = traffic.TrafficAccountant(capacity=8, clock=clock)
+    acct.tick()  # baseline
+    for _ in range(60):
+        acct.note("mach-a")
+    clock.advance(60.0)
+    acct.tick()
+    snap = acct.snapshot()
+    rates = snap["machines"][0]["rates"]
+    assert rates["1m"] == pytest.approx(1.0)
+    assert rates["10m"] == pytest.approx(1.0)
+    assert rates["1h"] == pytest.approx(1.0)
+    # one idle minute: 1m decays hard, 1h barely
+    clock.advance(60.0)
+    acct.tick()
+    rates = acct.snapshot()["machines"][0]["rates"]
+    assert rates["1m"] == pytest.approx(math.exp(-1.0), rel=1e-6)
+    assert rates["1h"] == pytest.approx(math.exp(-60.0 / 3600.0), rel=1e-6)
+
+
+# -- window-query math on synthetic buckets -----------------------------------
+
+
+def test_window_query_math_on_synthetic_buckets(tmp_path):
+    """rate() sums per-tick deltas over covered time; percentiles
+    linear-interpolate within the bucket holding the quantile; records
+    older than the window are excluded."""
+    clock = FakeClock()
+    registry = Registry()
+    counter = registry.counter("gordo_server_requests_total", "reqs")
+    hist = registry.histogram(
+        "gordo_server_request_duration_seconds", "lat",
+        buckets=(0.1, 1.0, 10.0),
+    )
+    wh = _warehouse(tmp_path, clock, registry)
+    # tick 1: 100 requests, 100 observations uniformly in (0, 0.1]
+    counter.labels().inc(100)
+    for _ in range(100):
+        hist.labels().observe(0.05)
+    clock.advance(100.0)
+    wh.tick()
+    # tick 2: 50 requests, 100 observations in (0.1, 1.0]
+    counter.labels().inc(50)
+    for _ in range(100):
+        hist.labels().observe(0.5)
+    clock.advance(100.0)
+    wh.tick()
+
+    # window covering both ticks: rate = 150 req / 200 s
+    rate = wh.rate("gordo_server_requests_total", window=250.0)
+    assert rate["total"] == pytest.approx(0.75)
+    assert rate["coverage_s"] == pytest.approx(200.0)
+    # window covering only the second tick (records are cut by their
+    # END timestamp: tick 1 landed at t0+100, tick 2 at t0+200): 50/100
+    rate = wh.rate("gordo_server_requests_total", window=50.0)
+    assert rate["total"] == pytest.approx(0.5)
+    assert rate["coverage_s"] == pytest.approx(100.0)
+
+    merged = wh.histogram_window(
+        "gordo_server_request_duration_seconds", window=250.0
+    )
+    assert merged["count"] == 200
+    assert merged["le"] == [0.1, 1.0, 10.0, None]
+    assert merged["d"] == [100.0, 100.0, 0.0, 0.0]
+    # p50 lands exactly at the first bucket's upper bound; p90
+    # interpolates 80% into the (0.1, 1.0] bucket
+    assert merged["p50"] == pytest.approx(0.1)
+    assert merged["p90"] == pytest.approx(0.1 + 0.9 * (180 - 100) / 100)
+    assert merged["sum"] == pytest.approx(100 * 0.05 + 100 * 0.5)
+    wh.close()
+
+
+def test_percentile_in_inf_bucket_reports_last_finite_bound():
+    le = [0.1, 1.0, None]
+    assert telemetry._bucket_percentile(le, [0, 0, 10], 0.5) == 1.0
+
+
+# -- merged views + export contract -------------------------------------------
+
+
+def test_merge_views_and_export_schema(tmp_path):
+    """Two synthetic workers merge: rates sum, histogram percentiles
+    recompute from merged buckets, and the export document validates
+    against the layout-input contract."""
+    views = {}
+    for worker in ("0", "1"):
+        clock = FakeClock()
+        registry = Registry()
+        counter = registry.counter("gordo_server_requests_total", "reqs")
+        wh = _warehouse(
+            tmp_path / worker, clock, registry, worker=worker
+        )
+        wh.accountant.tick()
+        for _ in range(120):
+            wh.accountant.note("mach-a", bucket="L1f3", precision="f32")
+        counter.labels().inc(120)
+        clock.advance(60.0)
+        wh.tick()
+        views[worker] = json.loads(json.dumps(wh.view(window=300.0)))
+        wh.close()
+    merged = telemetry.merge_views(views)
+    assert merged["workers"] == ["0", "1"]
+    assert merged["window"]["rates"]["gordo_server_requests_total"][
+        "total"
+    ] == pytest.approx(4.0)  # 2 workers x 2/s
+    assert merged["traffic"]["machines"][0]["machine"] == "mach-a"
+    assert merged["traffic"]["machines"][0]["count"] == 240
+    assert merged["traffic"]["machines"][0]["rates"]["1m"] == (
+        pytest.approx(4.0)
+    )
+    doc = telemetry.build_export(merged, window=300.0)
+    assert doc["schema"] == telemetry.EXPORT_SCHEMA
+    assert telemetry.validate_layout_input(doc) == []
+    assert doc["machines"][0]["machine"] == "mach-a"
+
+
+def test_validate_layout_input_catches_malformed_docs():
+    assert telemetry.validate_layout_input({}) != []
+    assert telemetry.validate_layout_input(
+        {"schema": "wrong/v9"}
+    ) != []
+    assert telemetry.validate_layout_input(None) != []
+
+
+# -- end to end: 2 real workers behind the router ------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+class _ThreadWorker:
+    """Thread-backed werkzeug server satisfying the worker protocol —
+    same seam as test_router.py / test_slo.py."""
+
+    def __init__(self, spec, app):
+        self.spec = spec
+        self._app = app
+        self._server = None
+        self._thread = None
+
+    def start(self):
+        self._server = make_server(
+            self.spec.host, self.spec.port, self._app, threaded=True
+        )
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def pid(self):
+        return None
+
+    def alive(self):
+        return self._server is not None
+
+    def terminate(self, grace: float = 5.0):
+        if self._server is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=5)
+            self._server = None
+
+    kill = terminate
+
+
+def test_router_aggregates_two_real_worker_warehouses(
+    tmp_path_factory, monkeypatch
+):
+    """The acceptance path: two REAL ModelServer workers (each with its
+    own on-disk warehouse under <models_root>/.telemetry/worker-<id>),
+    one scored request through the router, and /telemetry on the router
+    answering the MERGED fleet view — request deltas present, per-rung
+    cost ledger populated on the owning worker, export schema-valid."""
+    import requests as req
+
+    from gordo_components_tpu.builder import provide_saved_model
+    from gordo_components_tpu.server import build_app
+
+    # every scrape ticks (no 15s waits in a test)
+    monkeypatch.setenv("GORDO_TELEMETRY_INTERVAL", "0")
+    traffic.ACCOUNTANT.reset()
+
+    model_dir = provide_saved_model(
+        "mach-1",
+        {"Pipeline": {"steps": [
+            "MinMaxScaler",
+            {"DenseAutoEncoder": {"kind": "feedforward_symmetric",
+                                  "dims": [4], "epochs": 1,
+                                  "batch_size": 32}},
+        ]}},
+        {
+            "type": "RandomDataset",
+            "train_start_date": "2023-01-01T00:00:00+00:00",
+            "train_end_date": "2023-01-03T00:00:00+00:00",
+            "tag_list": ["tag-a", "tag-b", "tag-c"],
+        },
+        str(tmp_path_factory.mktemp("telemetry-e2e") / "mach-1"),
+        evaluation_config={"cv_mode": "build_only"},
+    )
+    specs = [
+        WorkerSpec(f"worker-{i}", i, "127.0.0.1", _free_port())
+        for i in range(2)
+    ]
+    apps = {}
+    # per-worker models_root so each warehouse lands in its OWN
+    # <models_root>/.telemetry/worker-<id> dot-dir
+    roots = {
+        spec.name: tmp_path_factory.mktemp(f"root-{spec.name}")
+        for spec in specs
+    }
+
+    def factory(spec):
+        app = apps.get(spec.name)
+        if app is None:
+            app = apps[spec.name] = build_app(
+                {"mach-1": model_dir}, project="proj",
+                worker_id=spec.worker_id,
+                models_root=str(roots[spec.name]),
+            )
+        return _ThreadWorker(spec, app)
+
+    router = assemble_fleet(specs, factory, project="proj", respawn=False)
+    router.supervisor.start_all()
+    assert len(router.supervisor.wait_ready(timeout=30)) == 2
+    server = make_server("127.0.0.1", 0, router, threaded=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        for _ in range(3):
+            response = req.post(
+                f"{base}/gordo/v0/proj/mach-1/prediction",
+                data=json.dumps({"X": [[0.1, 0.2, 0.3]] * 2}),
+                headers={"Content-Type": "application/json"}, timeout=60,
+            )
+            assert response.status_code == 200
+
+        view = req.get(f"{base}/telemetry?window=600", timeout=30).json()
+        assert view["enabled"] is True
+        assert view["workers"] == ["worker-0", "worker-1"]
+        assert not view.get("errors")
+        # both workers' warehouses contributed records
+        assert view["warehouse"]["records"] >= 1
+        # the scored requests show up in the merged window deltas
+        # (in-process workers share one registry+accountant: the merge
+        # still must carry the request-rate family and traffic entry)
+        assert view["window"]["rates"], "no windowed rates in fleet view"
+        machines = {
+            m["machine"]: m for m in view["traffic"]["machines"]
+        }
+        assert "mach-1" in machines
+        assert machines["mach-1"]["count"] >= 3
+        groups = {
+            (g["bucket"], g["precision"]) for g in view["traffic"]["groups"]
+        }
+        assert groups, "no (bucket, precision) traffic groups"
+        # measured-cost ledger: the owning worker reported device bytes
+        rungs = (view["costs"].get("engine") or {}).get("rungs") or {}
+        assert rungs, "no per-rung cost ledger in merged view"
+        assert any(
+            entry.get("device_bytes", 0) > 0 for entry in rungs.values()
+        )
+
+        # the export document is the ROADMAP item 5 input contract
+        doc = req.get(
+            f"{base}/telemetry?window=600&view=export", timeout=30
+        ).json()
+        assert telemetry.validate_layout_input(doc) == []
+        assert any(
+            m["machine"] == "mach-1" for m in doc["machines"]
+        )
+
+        # each worker's slice answers too, with its own warehouse dir
+        worker_view = req.get(
+            f"{specs[0].base_url}/telemetry?window=600", timeout=30
+        ).json()
+        assert worker_view["enabled"] is True
+        assert worker_view["warehouse"]["dir"].endswith(
+            os.path.join(".telemetry", "worker-0")
+        )
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
+        router.supervisor.stop_all()
+        router.close()
+        traffic.ACCOUNTANT.reset()

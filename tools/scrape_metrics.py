@@ -171,6 +171,86 @@ def validate(
     return 0
 
 
+def parse_window(value: str) -> float:
+    """``300`` / ``300s`` / ``5m`` / ``1h`` -> seconds."""
+    value = value.strip().lower()
+    units = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+    if value and value[-1] in units:
+        return float(value[:-1]) * units[value[-1]]
+    return float(value)
+
+
+def telemetry_window(url: str, window_s: float, timeout: float = 10.0) -> int:
+    """Pretty-print windowed rates/percentiles from the telemetry
+    warehouse next to the instant scrape: GET ``<base>/telemetry`` on
+    the same host the metrics URL names (ARCHITECTURE §24)."""
+    import requests
+
+    if "://" not in url:
+        url = f"http://{url}"
+    base = url.split("?")[0]
+    for suffix in ("/metrics", "/telemetry"):
+        if base.rstrip("/").endswith(suffix):
+            base = base.rstrip("/")[: -len(suffix)]
+            break
+    try:
+        response = requests.get(
+            f"{base}/telemetry", params={"window": window_s},
+            timeout=timeout,
+        )
+        response.raise_for_status()
+        view = response.json()
+    except Exception as exc:
+        print(f"TELEMETRY UNREACHABLE: {base}/telemetry: {exc!r}",
+              file=sys.stderr)
+        return 2
+    if not view.get("enabled", False):
+        print("telemetry: disabled on this server (GORDO_TELEMETRY=0)",
+              file=sys.stderr)
+        return 1
+    window = view.get("window") or {}
+    print(
+        f"telemetry window: {window.get('window_s', window_s):.0f}s "
+        f"({window.get('coverage_s', 0.0):.0f}s covered, "
+        f"{window.get('records', 0)} snapshot(s))"
+    )
+    rates = window.get("rates") or {}
+    for name in sorted(rates):
+        rate = rates[name]
+        print(f"  {name}: {rate.get('total', 0.0):.3f}/s")
+        series = rate.get("series") or {}
+        for key in sorted(series, key=lambda k: -series[k])[:5]:
+            print(f"    {key or '(unlabeled)'}: {series[key]:.3f}/s")
+        extra = len(series) - 5
+        if extra > 0:
+            print(f"    ... and {extra} more series")
+    hists = window.get("histograms") or {}
+    for name in sorted(hists):
+        hist = hists[name]
+        p50, p90, p99 = hist.get("p50"), hist.get("p90"), hist.get("p99")
+        stated = ", ".join(
+            f"{label}={value:.6g}"
+            for label, value in (("p50", p50), ("p90", p90), ("p99", p99))
+            if value is not None
+        )
+        print(
+            f"  {name}: count {hist.get('count', 0)}"
+            + (f", {stated}" if stated else " (empty window)")
+        )
+    traffic = view.get("traffic") or {}
+    machines = traffic.get("machines") or []
+    if machines:
+        print(f"traffic top-{min(len(machines), 10)} "
+              f"(sketch capacity {traffic.get('capacity')}):")
+        for entry in machines[:10]:
+            rates_1m = (entry.get("rates") or {}).get("1m", 0.0)
+            print(
+                f"  {entry['machine']}: count {entry['count']:.0f} "
+                f"(±{entry.get('error', 0):.0f}), {rates_1m:.3f}/s @1m"
+            )
+    return 0
+
+
 def spawn_and_scrape() -> int:
     """Build a toy model, serve it in-process, warm it, scrape it."""
     import json
@@ -247,6 +327,10 @@ def main() -> int:
                              "(?aggregate=1) and require worker-labeled "
                              "series under --require-gordo")
     parser.add_argument("--timeout", type=float, default=10.0)
+    parser.add_argument("--window", default=None, metavar="DUR",
+                        help="also query the telemetry warehouse for "
+                             "windowed rates/percentiles over DUR "
+                             "(e.g. 300, 5m, 1h) — ARCHITECTURE §24")
     args = parser.parse_args()
 
     if args.spawn:
@@ -259,11 +343,22 @@ def main() -> int:
     except Exception as exc:
         print(f"UNREACHABLE: {args.url}: {exc!r}", file=sys.stderr)
         return 2
-    return validate(
+    status = validate(
         text,
         require_gordo=args.require_gordo,
         aggregated=args.aggregate and args.require_gordo,
     )
+    if args.window is not None:
+        try:
+            window_s = parse_window(args.window)
+        except ValueError:
+            parser.error(f"unparseable --window {args.window!r} "
+                         "(try 300, 5m, 1h)")
+        window_status = telemetry_window(
+            args.url, window_s, timeout=args.timeout
+        )
+        status = max(status, window_status)
+    return status
 
 
 if __name__ == "__main__":
