@@ -197,6 +197,7 @@ class TelemetryWarehouse:
         self._active_fh = None
         self._active_bytes = 0
         self._last_tick: Optional[float] = None
+        self._tick_pending = False
         self._last_wall: Optional[float] = None
         self._prev_counters: Dict[str, Dict[Tuple[str, ...], float]] = {}
         self._prev_hist: Dict[
@@ -336,13 +337,25 @@ class TelemetryWarehouse:
 
     # -- tick: registry deltas + cost sample into one record ------------------
     def maybe_tick(self, now: Optional[float] = None) -> bool:
-        """Scrape-path entry: tick when ``min_interval`` has elapsed."""
+        """Scrape-path entry: tick when ``min_interval`` has elapsed.
+        The interval check and the claim happen in ONE critical section
+        (``_tick_pending``), so concurrent scrapes (/metrics and
+        /telemetry racing) cannot both pass the check and double-tick —
+        the loser returns False instead of appending a zero-dt record
+        and double-folding the accountant EWMAs."""
         now = self._clock() if now is None else now
         with self._lock:
             last = self._last_tick
-        if last is not None and now - last < self.min_interval:
-            return False
-        self.tick(now)
+            if self._tick_pending or (
+                last is not None and now - last < self.min_interval
+            ):
+                return False
+            self._tick_pending = True
+        try:
+            self.tick(now)
+        finally:
+            with self._lock:
+                self._tick_pending = False
         return True
 
     def tick(self, now: Optional[float] = None) -> None:

@@ -82,12 +82,20 @@ class SpaceSaving:
     error]; ``_heap`` is a lazy min-heap of (count, key) used only to
     find the eviction victim (stale entries are skipped on pop, the
     standard lazy-deletion trick — amortized O(log K) per eviction).
+    Evictions are the only place stale tuples get popped, so a fleet
+    that never fills ``capacity`` would leak one tuple per offer;
+    :meth:`_compact_heap` rebuilds the heap from live counts whenever
+    it exceeds 4x capacity, keeping it bounded at amortized O(1).
     """
 
     def __init__(self, capacity: int):
         self.capacity = max(1, int(capacity))
         self._counts: Dict[str, List[float]] = {}
         self._heap: List[Tuple[float, str]] = []
+
+    def _compact_heap(self) -> None:
+        self._heap = [(v[0], k) for k, v in self._counts.items()]
+        heapq.heapify(self._heap)
 
     def __len__(self) -> int:
         return len(self._counts)
@@ -96,6 +104,8 @@ class SpaceSaving:
         return key in self._counts
 
     def offer(self, key: str, amount: float = 1.0) -> None:
+        if len(self._heap) > 4 * self.capacity:
+            self._compact_heap()
         entry = self._counts.get(key)
         if entry is not None:
             entry[0] += amount
@@ -143,16 +153,23 @@ class SpaceSaving:
 
     @classmethod
     def merged(
-        cls, lists: Sequence[Sequence[Sequence[Any]]], capacity: int
+        cls,
+        lists: Sequence[Sequence[Sequence[Any]]],
+        capacity: int,
+        source_capacities: Optional[Sequence[Optional[int]]] = None,
     ) -> "SpaceSaving":
         """Merge serialized sketches (router aggregating per-worker
         accountants) with the mergeable-summaries rule: per key, SUM the
         estimates of sketches that track it, and for each sketch that
         does NOT, add that sketch's minimum count to both estimate and
         error — a key a full sketch dropped can have seen at most its
-        minimum there. A sketch below ``capacity`` never evicted, so its
-        missing-mass bound is exactly zero. This keeps the §24 contract
-        sound across the merge: estimate - error <= true <= estimate."""
+        minimum there. A sketch below its OWN capacity never evicted,
+        so its missing-mass bound is exactly zero — "full" is judged
+        against ``source_capacities[i]`` (the capacity that sketch
+        actually ran with, which under heterogeneous GORDO_TELEMETRY_TOPK
+        differs from the merge ``capacity``; unknown defaults to
+        ``capacity``). This keeps the §24 contract sound across the
+        merge: estimate - error <= true <= estimate."""
         parsed: List[Dict[str, Tuple[float, float]]] = [
             {
                 str(row[0]): (float(row[1]), float(row[2]))
@@ -160,10 +177,15 @@ class SpaceSaving:
             }
             for rows in lists
         ]
+        caps: List[int] = [
+            int(cap) if cap else capacity
+            for cap in (source_capacities or [None] * len(parsed))
+        ]
+        caps += [capacity] * (len(parsed) - len(caps))
         missing_mass = [
             (min(c for c, _ in rows.values())
-             if rows and len(rows) >= capacity else 0.0)
-            for rows in parsed
+             if rows and len(rows) >= cap else 0.0)
+            for rows, cap in zip(parsed, caps)
         ]
         combined: Dict[str, List[float]] = {}
         all_keys = set()
@@ -388,6 +410,12 @@ def merge_snapshots(
             for snap in snapshots
         ],
         capacity,
+        # each worker's fullness is judged against ITS capacity, not
+        # the router's — a smaller-TOPK worker can be full (and owe a
+        # missing-mass bound) while looking sparse to the router
+        source_capacities=[
+            int(snap.get("capacity") or 0) or None for snap in snapshots
+        ],
     )
     machine_rates: Dict[str, Dict[str, float]] = {}
     groups: Dict[Tuple[str, str], Dict[str, Any]] = {}

@@ -735,7 +735,11 @@ def _collector_loop(bucket_ref: "weakref.ref", fetch_queue: "queue.Queue"):
             # AFTER _complete (incl. its promotion work): quiesce() joins
             # on this, so "fetch stage drained" implies promotions landed
             fetch_queue.task_done()
-            del bucket  # drop the strong ref before blocking on the queue
+            # drop BOTH strong refs before blocking on the queue: a failed
+            # job's item.error carries a traceback whose frames reference
+            # the engine, so a stale job local would pin a dropped engine
+            # and keep this thread alive past the weakref backstop
+            del bucket, job
 
 
 class _Bucket:

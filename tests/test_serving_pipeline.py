@@ -227,6 +227,55 @@ def test_mid_pipeline_error_surfaces_on_exactly_its_own_waiters(
     assert _bits(healed) == reference[100]
 
 
+def test_failed_dispatch_does_not_pin_dropped_engine(monkeypatch, models):
+    """A failed fetch's exception carries a traceback whose frames
+    reference the bucket (and through waiter re-raises, the engine); the
+    collector loop must not keep its last job alive in a frame local
+    while idle, or a dropped (not close()d) engine generation can never
+    be collected and the collector's weakref backstop never exits — the
+    module hygiene gate's flaky collector leak. ``defer=True`` hands the
+    poisoned fetch to the collector deterministically (an idle
+    singleton fetches inline and never reaches it)."""
+    import gc
+    import time
+    import weakref
+
+    from gordo_components_tpu.server.engine import _Item
+
+    engine = _engine(monkeypatch, 2, {"p1": models["p1"]}, megabatch=False)
+    X = np.zeros((100, 4), np.float32)
+    engine.anomaly("p1", X)  # warm: programs compiled, collector idle
+    bucket, idx = engine._by_name["p1"]
+    engine.quiesce()
+
+    def poisoned(job):
+        raise RuntimeError("injected fetch failure")
+
+    bucket._fetch = poisoned
+    try:
+        x_padded, m_valid = engine._prepare(bucket, X)
+        item = _Item(idx, x_padded, m_valid)
+        bucket._dispatch(x_padded.shape[0], [item], defer=True)
+        assert item.done.wait(timeout=30)
+        assert isinstance(item.error, RuntimeError)
+    finally:
+        del bucket._fetch
+    engine_ref = weakref.ref(engine)
+    bucket_ref = weakref.ref(bucket)
+    del engine, bucket, item
+    deadline = time.monotonic() + 10.0
+    while (
+        (engine_ref() is not None or bucket_ref() is not None)
+        and time.monotonic() < deadline
+    ):
+        gc.collect()
+        time.sleep(0.05)
+    assert engine_ref() is None and bucket_ref() is None, (
+        "dropped engine/bucket still referenced after a failed deferred "
+        "dispatch — the collector's stale job local is pinning it"
+    )
+
+
 def test_enqueue_time_error_surfaces_on_waiters(monkeypatch, models):
     """A dispatch that fails at ENQUEUE (program build / launch) — before
     the collector ever sees it — must also surface on its waiters, not

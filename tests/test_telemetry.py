@@ -211,6 +211,26 @@ def test_space_saving_error_bounds_on_zipf():
             )
 
 
+def test_space_saving_heap_stays_bounded_without_evictions():
+    """Regression: offers to already-tracked keys push a lazy tuple per
+    call, and evictions (the only popper) never happen while distinct
+    keys <= capacity — a steady-state fleet must not leak one heap entry
+    per request. The 4x-capacity compaction bounds the heap."""
+    sketch = traffic.SpaceSaving(64)
+    for i in range(50_000):
+        sketch.offer(f"mach-{i % 32:04d}")
+    assert len(sketch._heap) <= 4 * sketch.capacity + 1
+    # counts stay exact (no evictions ever happened)
+    assert sum(c for _, c, _ in sketch.items()) == 50_000
+    for name, estimate, error in sketch.items():
+        assert estimate in (1562.0, 1563.0)
+        assert error == 0.0
+    # and eviction still works after compactions: flood with new keys
+    for i in range(200):
+        sketch.offer(f"new-{i:04d}")
+    assert len(sketch) == sketch.capacity
+
+
 def test_sketch_merge_matches_exact_counts_on_zipf():
     """Router-merge soundness: two workers each sketch half the stream;
     the merged sketch's estimates hold the same error contract against
@@ -232,6 +252,43 @@ def test_sketch_merge_matches_exact_counts_on_zipf():
     ]
     merged_top = [name for name, _, _ in merged.top(10)]
     assert merged_top == true_top
+
+
+def test_merge_honors_per_sketch_capacity():
+    """Regression: a worker running a SMALLER TOPK than the router is
+    full (and owes a missing-mass bound) even though its row count looks
+    sparse against the router's capacity. Judging fullness by the
+    merge capacity would drop that bound and break
+    estimate - error <= true <= estimate."""
+    small = traffic.SpaceSaving(2)
+    for _ in range(5):
+        small.offer("a")
+    for _ in range(3):
+        small.offer("b")
+    small.offer("c")  # evicts b (min count 3); c inherits its error
+    assert "b" not in small
+    big = traffic.SpaceSaving(128)
+    for _ in range(7):
+        big.offer("b")
+    true = {"a": 5, "b": 3 + 7, "c": 1}
+    merged = traffic.merge_snapshots(
+        [
+            {"capacity": 2, "machines": [
+                {"machine": k, "count": c, "error": e}
+                for k, c, e in small.items()
+            ]},
+            {"capacity": 128, "machines": [
+                {"machine": k, "count": c, "error": e}
+                for k, c, e in big.items()
+            ]},
+        ],
+        capacity=128,
+    )
+    rows = {m["machine"]: m for m in merged["machines"]}
+    for name, true_count in true.items():
+        estimate, error = rows[name]["count"], rows[name]["error"]
+        assert true_count <= estimate, (name, true_count, estimate)
+        assert estimate - error <= true_count, (name, estimate, error)
 
 
 def test_cardinality_bound_parity_with_traffic_sketch(monkeypatch):
@@ -292,6 +349,42 @@ def test_ewma_rates_multi_horizon():
     rates = acct.snapshot()["machines"][0]["rates"]
     assert rates["1m"] == pytest.approx(math.exp(-1.0), rel=1e-6)
     assert rates["1h"] == pytest.approx(math.exp(-60.0 / 3600.0), rel=1e-6)
+
+
+def test_maybe_tick_claims_tick_in_one_critical_section():
+    """Regression: two concurrent scrapes must not BOTH pass the
+    interval check and double-tick (duplicate zero-dt record, EWMAs
+    double-folded). The cost sampler runs mid-tick outside the lock —
+    the exact window the race needs — so a reentrant maybe_tick from
+    there deterministically exercises it: the claim (_tick_pending) must
+    make the second caller lose."""
+    clock = FakeClock()
+    wh = telemetry.TelemetryWarehouse(
+        directory=None,
+        registry=Registry(),
+        accountant=traffic.TrafficAccountant(capacity=8, clock=clock),
+        clock=clock,
+        wall=clock,
+        min_interval=1.0,
+    )
+    nested = []
+
+    def sampler():
+        # interval has elapsed for this `now` too — only the pending
+        # claim can (and must) reject the nested call
+        nested.append(wh.maybe_tick(clock.now + 50.0))
+        return {}
+
+    wh.cost_sampler = sampler
+    clock.advance(10.0)
+    assert wh.maybe_tick() is True
+    assert nested == [False]
+    assert wh.ticks == 1
+    # and the claim is released: the next elapsed-interval scrape ticks
+    clock.advance(10.0)
+    wh.cost_sampler = None
+    assert wh.maybe_tick() is True
+    assert wh.ticks == 2
 
 
 # -- window-query math on synthetic buckets -----------------------------------
