@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke smoke images builder-image server-image watchman-image
 
 # invariant linter (docs/ARCHITECTURE.md §17/§21): lock discipline
 # against the declared hierarchy, blocking-calls-under-hot-locks,
@@ -145,6 +145,16 @@ mesh-smoke:
 telemetry-smoke:
 	JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 
+# multi-tenant QoS check (§25): the three-principal mix (premium
+# interactive + saturating bulk + over-quota abuser) through 2 router
+# workers against a small admission gate — premium p99 holds with ZERO
+# sheds while the bulk tenant saturates at 12 threads and is actually
+# shed, quota exhaustion answers 429 + Retry-After (never an
+# overload-shaped 503), and scores stay byte-identical bare vs
+# tenant-stamped vs the forced-bulk endpoint
+qos-smoke:
+	JAX_PLATFORMS=cpu python tools/qos_smoke.py
+
 # the full smoke battery: invariant lint + exposition + resilience +
 # store integrity + serving data plane + span attribution + cold-start
 # economics + cross-machine megabatching + the horizontal serving tier
@@ -156,7 +166,8 @@ telemetry-smoke:
 # + multi-host mesh serving (layout routing / fallback rung / warm boots)
 # + the telemetry warehouse (traffic top-K / cost ledger / export /
 #   accounting overhead)
-smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke
+# + multi-tenant QoS (quotas / priority classes / class-ordered sheds)
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke
 
 images: builder-image server-image watchman-image
 

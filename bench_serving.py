@@ -1656,6 +1656,9 @@ def measure_capacity() -> dict:
     - traffic: heavy-tailed diurnal hot-key-skewed load plus a
       flight-recorder-replay pass through 2 lazy workers behind the
       real router, with SLO attainment and zero-failure accounting;
+    - qos: the §25 tenant mix (premium interactive + saturating bulk +
+      quota-abusing tenant, concurrently) with per-class attainment
+      and the 503-shed vs 429-quota split;
     - metrics: exposition bytes + worst machine-label cardinality
       (bounded top-K + `other` at any fleet size).
 
@@ -1696,6 +1699,15 @@ def measure_capacity() -> dict:
             "exposition_bytes"
         ),
         "slo_breaches": report.get("slo", {}).get("breaches"),
+        # §25: per-class attainment under the three-principal mix (each
+        # tenant is its class's only principal in the canonical table)
+        "qos_attainment": {
+            name: report.get("qos", {}).get(name, {}).get("attainment")
+            for name in ("premium", "batch", "abuser")
+        },
+        "qos_quota_429s": report.get("qos", {}).get("abuser", {}).get(
+            "quota_429"
+        ),
     }
     return report
 

@@ -68,6 +68,22 @@ KNOBS: Dict[str, Knob] = dict(
         _knob("GORDO_QUEUE_TIMEOUT", "1.0", "float",
               "seconds a waiter queues for admission before shedding 503",
               "serving"),
+        # -- multi-tenant QoS (§25) ---------------------------------------
+        _knob("GORDO_TENANTS", "unset", "spec",
+              "multi-tenant QoS table (§25): "
+              "`name:class[:rate[:burst[:key]]]` entries separated by "
+              "`;` — class `interactive`/`standard`/`bulk`, rate in "
+              "requests/s (0 = unmetered token bucket), key an optional "
+              "API key; requests pick a tenant via `X-Gordo-Tenant`, "
+              "unknown names fold into `default` (`--tenants` on "
+              "`run-server` / `run-fleet-server`)", "serving"),
+        _knob("GORDO_QOS_DEFAULT_CLASS", "standard", "str",
+              "priority class for bare requests and undeclared tenants "
+              "(`interactive`/`standard`/`bulk`)", "serving"),
+        _knob("GORDO_QOS_WEIGHTS", "interactive=8,standard=4,bulk=1", "spec",
+              "deficit-weighted fair-share ratios the megabatch fill "
+              "window drains classes by (scores stay byte-identical; "
+              "only intra-window ORDER changes)", "serving"),
         _knob("GORDO_DRAIN_TIMEOUT", "10", "float",
               "graceful-shutdown budget: seconds SIGTERM waits for "
               "in-flight requests before stopping the listener",
@@ -257,6 +273,10 @@ KNOBS: Dict[str, Knob] = dict(
         _knob("GORDO_AUTOPILOT_WORKER_BOUNDS", "1:8", "spec",
               "`floor:ceiling` for the elastic worker count (the router's "
               "spawn/retire actuator)", "autopilot"),
+        _knob("GORDO_AUTOPILOT_SHED_BOUNDS", "0:8", "spec",
+              "`min:max` rungs for the shed-ladder actuator (§25): "
+              "sustained SLO burn progressively tightens the BULK "
+              "class's admission share, relaxing on recovery", "autopilot"),
         # -- store -------------------------------------------------------
         _knob("GORDO_STORE_KEEP_GENERATIONS", "3", "int",
               "generations kept per machine after a commit prunes old "
@@ -335,6 +355,16 @@ KNOBS: Dict[str, Knob] = dict(
               "bench `telemetry` block: seconds of Zipf load before "
               "the scrape-cost and warehouse-economy measurements",
               "bench"),
+        _knob("GORDO_QOS_SMOKE_MACHINES", "24", "int",
+              "qos smoke (§25): synthetic-fleet size for "
+              "`tools/qos_smoke.py`", "bench"),
+        _knob("GORDO_QOS_SMOKE_SECONDS", "5", "float",
+              "qos smoke: seconds of the three-tenant mix through the "
+              "2-worker router tier", "bench"),
+        _knob("GORDO_QOS_SMOKE_P99_MS", "6000", "float",
+              "qos smoke: premium p99 bound under bulk saturation — "
+              "deliberately coarse (below the queue-timeout cliff); "
+              "zero premium sheds is the sharp gate", "bench"),
         # -- test / validation harnesses ---------------------------------
         _knob("GORDO_LOCKCHECK", "0", "bool",
               "runtime lock-order validator: named locks record real "

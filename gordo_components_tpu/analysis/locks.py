@@ -34,6 +34,7 @@ LOCK_RANKS: Dict[str, int] = {
     "parallel.shard_plan": 14,  # shard_plan.py plan cache (boot/reload/router)
     "router.op": 15,            # rollout.py _op_lock: one rollout/rollback
     "server.admission": 20,     # admission.py gate condition
+    "resilience.qos": 22,       # qos.py tenant quota table + header sketch
     "server.state_cond": 25,    # server.py _ServerState in-flight tracking
     "router.models": 30,        # router.py cached fleet model list
     "watchman.control": 35,     # control.py probe bookkeeping
@@ -68,6 +69,7 @@ LOCK_RANKS: Dict[str, int] = {
 HOT_LOCKS = frozenset(
     {
         "server.admission",
+        "resilience.qos",
         "server.state_cond",
         "router.models",
         "router.placement",
@@ -100,6 +102,7 @@ LOCK_ATTRS: Dict[Tuple[str, str], str] = {
     ("server/server.py", "_cond"): "server.state_cond",
     ("server/server.py", "_reload_lock"): "server.reload",
     ("resilience/admission.py", "_cond"): "server.admission",
+    ("resilience/qos.py", "_lock"): "resilience.qos",
     ("resilience/breaker.py", "_lock"): "resilience.breaker",
     ("resilience/quarantine.py", "_lock"): "resilience.quarantine",
     ("resilience/faults.py", "_lock"): "resilience.faults",
@@ -145,7 +148,13 @@ GUARDED_FIELDS: Dict[Tuple[str, str], str] = {
     # admission counters: occupancy, queue depth, closed marker (§10)
     ("resilience/admission.py", "_inflight"): "server.admission",
     ("resilience/admission.py", "_waiting"): "server.admission",
+    ("resilience/admission.py", "_waiting_by"): "server.admission",
     ("resilience/admission.py", "_closed"): "server.admission",
+    ("resilience/admission.py", "_shed_level"): "server.admission",
+    ("resilience/admission.py", "_class_sheds"): "server.admission",
+    ("resilience/admission.py", "_releases"): "server.admission",
+    # tenant quota table: raw-header sketch fed under the qos lock (§25)
+    ("resilience/qos.py", "_header_sketch"): "resilience.qos",
     # fault-injection plan (module global, not an attribute)
     ("resilience/faults.py", "_rules"): "resilience.faults",
     # router: cached fleet model list + placement ring/rate state +

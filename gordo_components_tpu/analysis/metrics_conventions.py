@@ -32,7 +32,7 @@ CHECKER = "metrics-conventions"
 COMPONENTS = (
     "server", "engine", "client", "build", "builds", "fleet", "watchman",
     "router", "resilience", "store", "compile_cache", "span", "stage",
-    "drift", "lint", "slo", "autopilot", "mesh", "telemetry",
+    "drift", "lint", "slo", "autopilot", "mesh", "telemetry", "tenant",
 )
 
 # §7 label allowlist: low-cardinality enums only. ``machine``/``worker``/
@@ -41,12 +41,16 @@ COMPONENTS = (
 # ``precision`` is the three-value f32/bf16/int8 ladder enum (§19).
 # ``actuator``/``direction`` are the autopilot's decision enums (§20).
 # ``shard`` is bounded by the serving mesh's shard count (§23).
+# ``tenant`` is bounded by the DECLARED tenant table — unknown header
+# values fold into 'default' before any label is minted — and ``class``
+# is the three-value interactive/standard/bulk enum (§25).
 ALLOWED_LABELS = frozenset(
     {
         "endpoint", "status", "kind", "outcome", "path", "event", "phase",
         "reason", "stage", "name", "trigger", "format", "worker",
         "machine", "target", "cause", "point", "to", "where", "error",
         "window", "precision", "actuator", "direction", "shard",
+        "tenant", "class",
     }
 )
 

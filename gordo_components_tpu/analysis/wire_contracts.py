@@ -104,9 +104,17 @@ HEADERS: Dict[str, HeaderSpec] = {
             response=True,
         ),
         HeaderSpec(
+            "X-Gordo-Tenant",
+            "which principal this request scores as (§25): the server "
+            "maps it to a priority class + token-bucket quota; unknown "
+            "names fold into 'default'; the router forwards it untouched",
+            request=True,
+        ),
+        HeaderSpec(
             "Retry-After",
             "seconds to back off: admission shed / quarantine / draining "
-            "503s all carry it; draining floors it at 0 (§10/§16)",
+            "503s carry it (draining floors it at 0), and quota 429s "
+            "carry the bucket's refill time (§10/§16/§25)",
             response=True,
         ),
     )
@@ -153,7 +161,13 @@ ROUTES: Tuple[RouteSpec, ...] = (
     RouteSpec("/prediction", ("server", "router"), "single-model scoring"),
     RouteSpec("/anomaly/prediction", ("server", "router"),
               "anomaly scoring; 503+Retry-After on shed/quarantine, "
-              "504 past deadline (§10)"),
+              "504 past deadline, 429+Retry-After on quota (§10/§25)"),
+    RouteSpec("/tenants", ("server", "router"),
+              "QoS control surface (§25): tenant table, class limits + "
+              "shed rung, raw-header heavy-hitter sketch"),
+    RouteSpec("/bulk/anomaly/prediction", ("server",),
+              "offline scoring surface (§25): forced-bulk class, large "
+              "windows amortized through the spill tier"),
     RouteSpec("/download-model", ("server",), "serialized model bytes"),
     RouteSpec("/debug/requests", ("server", "router"),
               "flight-recorder rings (§13)"),
@@ -168,6 +182,8 @@ ROUTES: Tuple[RouteSpec, ...] = (
               "machine-scoped scoring"),
     RouteSpec("/gordo/v0/<project>/<machine>/anomaly/prediction",
               ("server",), "machine-scoped anomaly scoring"),
+    RouteSpec("/gordo/v0/<project>/<machine>/bulk/anomaly/prediction",
+              ("server",), "machine-scoped bulk scoring (§25)"),
     RouteSpec("/gordo/v0/<project>/<machine>/download-model", ("server",),
               "machine-scoped model download"),
     RouteSpec("/gordo/v0/<project>/<machine>/<path:rest>", ("router",),

@@ -47,6 +47,7 @@ from ..analysis import lockcheck
 from ..observability import flightrec
 from ..observability.registry import REGISTRY
 from ..observability.spans import Timeline
+from ..resilience import qos
 from . import policy, signals
 from .policy import DOWN, HOLD, UP, Actuator
 
@@ -487,6 +488,17 @@ def build_server_autopilot(server, clock=time.monotonic):
             decide=policy.inflight_rule(thresholds),
             bounds=policy.bounds_knob(
                 "GORDO_AUTOPILOT_INFLIGHT_BOUNDS", policy.Bounds(8, 256)
+            ),
+            aimd=aimd, cooldown=cooldown, confirm=confirm,
+        ),
+        Actuator(
+            name="shed",
+            read=lambda: server.admission.shed_level,
+            apply=lambda v: server.apply_tuning(shed_level=v),
+            decide=policy.shed_rule(thresholds),
+            bounds=policy.bounds_knob(
+                "GORDO_AUTOPILOT_SHED_BOUNDS",
+                policy.Bounds(0, qos.SHED_MAX),
             ),
             aimd=aimd, cooldown=cooldown, confirm=confirm,
         ),

@@ -272,6 +272,30 @@ def residency_rule(
     return decide
 
 
+def shed_rule(
+    thresholds: Thresholds,
+) -> Callable[[Observation], Tuple[int, str]]:
+    """Shed ladder (§25): on SUSTAINED burn — the fast window over the
+    line while the slow window is already elevated, so one latency
+    spike cannot squeeze anyone — climb a rung, progressively
+    tightening ONLY the bulk class's admission share. Relax back down
+    the ladder once the fast window is quiet. UP here means "shed
+    more", and the ladder's own hysteresis/cooldown/oscillation guards
+    are the controller's, same as every other actuator."""
+
+    def decide(obs: Observation) -> Tuple[int, str]:
+        if (
+            obs.burn_fast >= thresholds.burn_high
+            and obs.burn_slow >= thresholds.burn_low
+        ):
+            return UP, "sustained_burn"
+        if obs.burn_fast <= thresholds.burn_low:
+            return DOWN, "burn_recovered"
+        return HOLD, ""
+
+    return decide
+
+
 def workers_rule(
     thresholds: Thresholds,
 ) -> Callable[[Observation], Tuple[int, str]]:

@@ -535,6 +535,14 @@ def cache_purge_cmd(store_dir, stale_only):
                    "beyond it (plus a small queue) the server sheds with "
                    "503 + Retry-After instead of convoying threads "
                    "(default 64)")
+@click.option("--tenants", default=None, envvar="GORDO_TENANTS",
+              help="multi-tenant QoS table (§25): "
+                   "'name:class[:rate[:burst[:key]]]' entries separated "
+                   "by ';' — class interactive/standard/bulk, rate in "
+                   "requests/s (0 = unmetered token bucket), key an "
+                   "optional API key that maps to the tenant. Requests "
+                   "pick their tenant via X-Gordo-Tenant; unknown names "
+                   "fold into 'default'")
 @click.option("--faults", default=None, envvar="GORDO_FAULTS",
               help="chaos-testing fault spec "
                    "'point:target:kind[:param][;...]' (points: model-load, "
@@ -587,9 +595,9 @@ def cache_purge_cmd(store_dir, stale_only):
                    "--mesh-shards mesh; defaults to worker-id mod shards")
 @_TRACE_DIR_OPT
 def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
-                   max_inflight, faults, compile_cache_store, megabatch,
-                   fill_window_us, worker_id, lazy_boot, mesh_shards,
-                   mesh_shard, trace_dir):
+                   max_inflight, tenants, faults, compile_cache_store,
+                   megabatch, fill_window_us, worker_id, lazy_boot,
+                   mesh_shards, mesh_shard, trace_dir):
     """Serve built model(s) over REST."""
     import os
 
@@ -616,6 +624,17 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
         ).strip().lower() in ("1", "true", "on", "yes")
     if lazy_boot and not models_dir:
         raise click.UsageError("--lazy-boot requires --models-dir")
+
+    if tenants is not None:
+        from ..resilience import qos as qos_mod
+
+        try:
+            # validated HERE so a typo'd table fails the command loudly
+            # instead of silently serving everyone as 'default'
+            qos_mod.parse_tenants(tenants)
+        except ValueError as exc:
+            raise click.UsageError(f"Bad --tenants spec: {exc}")
+        os.environ["GORDO_TENANTS"] = tenants
 
     if faults is not None:
         from ..resilience import faults as faults_mod
@@ -702,6 +721,10 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
               help="forwarded to every worker (see run-server)")
 @click.option("--max-inflight", default=None, type=int,
               help="per-WORKER admission bound (see run-server)")
+@click.option("--tenants", default=None, envvar="GORDO_TENANTS",
+              help="multi-tenant QoS table (§25), exported as "
+                   "GORDO_TENANTS so the router AND every spawned worker "
+                   "load the same table (see run-server)")
 @click.option("--mesh-shards", default=0, show_default=True, type=int,
               envvar="GORDO_MESH_SHARDS",
               help="multi-host mesh serving (§23): partition the fleet's "
@@ -712,13 +735,15 @@ def run_server_cmd(model_dirs, models_dir, host, port, project, shard_fleet,
                    "replicated tier exactly as before")
 def run_fleet_server_cmd(models_dir, workers, host, port, worker_base_port,
                          project, replicas, hot_rps, probe_interval,
-                         megabatch, max_inflight, mesh_shards):
+                         megabatch, max_inflight, tenants, mesh_shards):
     """Horizontal serving tier: spawn and supervise WORKERS server
     processes over one models tree, routing /prediction traffic by
     consistent-hash machine→worker placement. Worker health probes drive
     breaker/quarantine-based eject + respawn; POST /reload canaries one
     worker then sweeps the rest (rolling generation adoption), and POST
     /rollback swaps CURRENT fleet-wide before re-adopting."""
+    import os
+
     from ..router import run_fleet_server
 
     worker_args = []
@@ -726,6 +751,15 @@ def run_fleet_server_cmd(models_dir, workers, host, port, worker_base_port,
         worker_args += ["--megabatch" if megabatch else "--no-megabatch"]
     if max_inflight is not None:
         worker_args += ["--max-inflight", str(max_inflight)]
+    if tenants is not None:
+        from ..resilience import qos as qos_mod
+
+        try:
+            qos_mod.parse_tenants(tenants)
+        except ValueError as exc:
+            raise click.UsageError(f"Bad --tenants spec: {exc}")
+        # env, not worker_args: the router process reads the table too
+        os.environ["GORDO_TENANTS"] = tenants
     if workers < 1:
         raise click.UsageError("--workers must be >= 1")
     if mesh_shards and mesh_shards > workers:
@@ -949,6 +983,27 @@ def slo_cmd(base_url):
         response.raise_for_status()
     except requests.RequestException as exc:
         logger.error("Could not read /slo from %s: %s", base_url, exc)
+        sys.exit(1)
+    click.echo(json.dumps(response.json(), indent=2))
+
+
+@gordo.command("tenants")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+def tenants_cmd(base_url):
+    """The QoS control surface (ARCHITECTURE §25) from a live ``/tenants``:
+    the declared tenant table (name, class, token-bucket rate/burst and
+    current fill), the admission gate's per-class limits and shed ladder
+    rung (model-server only), and the raw-header heavy-hitter sketch —
+    which unmapped principals are folding into 'default' and how hard."""
+    import requests
+
+    url = f"{base_url.rstrip('/')}/tenants"
+    try:
+        response = requests.get(url, timeout=10)
+        response.raise_for_status()
+    except requests.RequestException as exc:
+        logger.error("Could not read /tenants from %s: %s", base_url, exc)
         sys.exit(1)
     click.echo(json.dumps(response.json(), indent=2))
 

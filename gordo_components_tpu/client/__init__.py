@@ -1,4 +1,4 @@
-from .client import Client, ClientError
+from .client import Client, ClientError, QuotaExceeded
 from .forwarders import (
     CsvForwarder,
     ForwardPredictionsIntoInflux,
@@ -9,6 +9,7 @@ from .utils import make_date_ranges
 __all__ = [
     "Client",
     "ClientError",
+    "QuotaExceeded",
     "PredictionForwarder",
     "CsvForwarder",
     "ForwardPredictionsIntoInflux",

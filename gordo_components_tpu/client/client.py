@@ -35,7 +35,7 @@ from .. import wire
 from ..analysis import lockcheck
 from ..observability import flightrec, spans, tracing
 from ..observability.registry import REGISTRY
-from ..resilience import deadline
+from ..resilience import deadline, qos
 from ..resilience.admission import DRAINING_HEADER
 from ..resilience.breaker import BreakerBoard
 from .forwarders import PredictionForwarder
@@ -52,13 +52,30 @@ _M_RETRIES = REGISTRY.counter(
 _M_REQUESTS = REGISTRY.counter(
     "gordo_client_requests_total",
     "Client requests by terminal outcome (ok / permanent_4xx / exhausted "
-    "/ circuit_open / budget_exhausted)",
+    "/ circuit_open / budget_exhausted / quota_blocked / quota_exhausted)",
     labels=("outcome",),
 )
 
 
 class ClientError(RuntimeError):
     """A request failed permanently (4xx, or retries exhausted)."""
+
+
+class QuotaExceeded(ClientError):
+    """The server answered 429: THIS tenant's token bucket is empty. The
+    transport is healthy — a 429 never counts against the circuit
+    breaker — so the remedy is to slow down (``retry_after`` seconds)
+    or raise the tenant's quota, not to fail over."""
+
+    def __init__(
+        self,
+        message: str,
+        retry_after: float = 1.0,
+        tenant: str = qos.DEFAULT_TENANT,
+    ):
+        super().__init__(message)
+        self.retry_after = retry_after
+        self.tenant = tenant
 
 
 class Client:
@@ -75,12 +92,17 @@ class Client:
         retry_budget: Optional[float] = None,
         breaker_recovery: float = 30.0,
         forwarders: Optional[List[PredictionForwarder]] = None,
+        tenant: Optional[str] = None,
     ):
         """``retry_budget``: wall-clock cap (seconds) on one call's retries
         + backoff, so a flapping server cannot stretch a call past what the
         caller budgeted (any bound ``resilience.deadline`` tightens it
         further). ``breaker_recovery``: seconds an endpoint's circuit stays
-        open after tripping before one probe request tests it again."""
+        open after tripping before one probe request tests it again.
+        ``tenant``: principal name stamped on every request as
+        ``X-Gordo-Tenant`` — the server maps it to a priority class and
+        token-bucket quota (ARCHITECTURE §25); None rides as the server's
+        default tenant."""
         self.base_url = base_url.rstrip("/")
         self.project = project
         self.machines = list(machines) if machines else None
@@ -95,6 +117,15 @@ class Client:
         # machine × chunk requests fail in microseconds instead of each
         # paying a full connect/read timeout
         self._breakers = BreakerBoard(recovery_time=breaker_recovery)
+        self.tenant = tenant
+        # per-TENANT quota backoff, deliberately separate from the
+        # per-base-url breaker above: a 429 means the server is healthy
+        # and saying no to THIS principal, so it must not open the
+        # transport circuit (which would also fail every other tenant
+        # sharing this client process). Values are monotonic "clear at"
+        # times; plain dict get/set are atomic under the GIL and the
+        # worst race is one extra probe request, so no lock.
+        self._quota_until: Dict[str, float] = {}
         self.forwarders = forwarders or []
         # ONE pooled aiohttp session for the client's lifetime, living on a
         # persistent background event loop (asyncio.run per predict() call
@@ -289,7 +320,9 @@ class Client:
         negotiation (an old server ignores the Accept and answers JSON —
         the response handlers dispatch on Content-Type, so both work); the
         context deadline's remaining budget rides ``X-Gordo-Deadline`` so
-        the server can 504 work we have already given up on."""
+        the server can 504 work we have already given up on; the tenant
+        name (when configured) rides ``X-Gordo-Tenant`` so the server can
+        class and meter this principal."""
         headers = {
             tracing.TRACE_HEADER: tracing.current_or_new(),
             "Accept": f"{wire.NPZ_CONTENT_TYPE}, application/json",
@@ -297,7 +330,60 @@ class Client:
         budget = deadline.header_value()
         if budget is not None:
             headers[deadline.DEADLINE_HEADER] = budget
+        if self.tenant:
+            headers[qos.TENANT_HEADER] = self.tenant
         return headers
+
+    # -- per-tenant quota backoff -------------------------------------------
+    def _quota_key(self) -> str:
+        return self.tenant or qos.DEFAULT_TENANT
+
+    def _quota_blocked(self) -> Optional[float]:
+        """Seconds until this tenant's 429 backoff clears, or None when
+        clear. Checked once per call (not per retry): a call that starts
+        inside the window fails fast with the typed :class:`QuotaExceeded`
+        instead of burning its retry budget re-earning the same 429."""
+        until = self._quota_until.get(self._quota_key(), 0.0)
+        remaining = until - time.monotonic()
+        return remaining if remaining > 0 else None
+
+    def _note_quota(self, retry_after: Optional[float]) -> float:
+        """Record a 429's Retry-After against this tenant (1s when the
+        server sent no usable hint) and return the wait."""
+        wait = retry_after if retry_after and retry_after > 0 else 1.0
+        key = self._quota_key()
+        self._quota_until[key] = max(
+            self._quota_until.get(key, 0.0), time.monotonic() + wait
+        )
+        return wait
+
+    def _check_quota_gate(self, what: str) -> None:
+        blocked = self._quota_blocked()
+        if blocked is not None:
+            _M_REQUESTS.labels("quota_blocked").inc()
+            raise QuotaExceeded(
+                f"{what}: tenant {self._quota_key()!r} backing off "
+                f"{blocked:.2f}s after HTTP 429",
+                retry_after=blocked,
+                tenant=self._quota_key(),
+            )
+
+    def _exhausted_error(
+        self,
+        message: str,
+        last_error: Optional[str],
+        retry_after: Optional[float],
+    ) -> ClientError:
+        """Terminal failure, typed: a retry budget that died on quota
+        responses surfaces as :class:`QuotaExceeded` (the caller can back
+        off the principal) instead of a generic retries-exhausted."""
+        if last_error == "HTTP 429 (quota)":
+            return QuotaExceeded(
+                message,
+                retry_after=retry_after if retry_after else 1.0,
+                tenant=self._quota_key(),
+            )
+        return ClientError(message)
 
     @staticmethod
     def _refresh_deadline_header(headers: Dict[str, str]) -> None:
@@ -351,6 +437,7 @@ class Client:
         params = {"start": start.isoformat(), "end": end.isoformat()}
         headers = self._headers()
         breaker = self._breaker()
+        self._check_quota_gate(f"{machine} [{start}, {end})")
         started = time.monotonic()
         last_error: Optional[str] = None
         retry_after: Optional[float] = None
@@ -359,9 +446,11 @@ class Client:
                 delay = self._retry_delay(attempt, started, retry_after)
                 if delay is None:
                     _M_REQUESTS.labels("budget_exhausted").inc()
-                    raise ClientError(
+                    raise self._exhausted_error(
                         f"{machine} [{start}, {end}): retry budget "
-                        f"exhausted ({last_error})"
+                        f"exhausted ({last_error})",
+                        last_error,
+                        retry_after,
                     )
                 await asyncio.sleep(delay)
                 self._refresh_deadline_header(headers)
@@ -386,6 +475,19 @@ class Client:
                         async with session.post(
                             url, params=params, headers=headers
                         ) as response:
+                            if response.status == 429:
+                                # quota, not failure: the server is
+                                # healthy and saying no to THIS principal
+                                # — never trips the transport circuit,
+                                # backs off the TENANT instead
+                                breaker.record(True)
+                                hint = self._parse_retry_after(
+                                    response.headers.get("Retry-After")
+                                )
+                                retry_after = self._note_quota(hint)
+                                last_error = "HTTP 429 (quota)"
+                                _M_RETRIES.labels("quota").inc()
+                                continue
                             if 400 <= response.status < 500:
                                 breaker.record(True)  # alive — the REQUEST
                                 # is bad
@@ -449,9 +551,16 @@ class Client:
                 breaker.record(False)
                 last_error = repr(exc)
                 _M_RETRIES.labels("connection").inc()
-        _M_REQUESTS.labels("exhausted").inc()
-        raise ClientError(
-            f"{machine} [{start}, {end}): retries exhausted ({last_error})"
+        outcome = (
+            "quota_exhausted"
+            if last_error == "HTTP 429 (quota)"
+            else "exhausted"
+        )
+        _M_REQUESTS.labels(outcome).inc()
+        raise self._exhausted_error(
+            f"{machine} [{start}, {end}): retries exhausted ({last_error})",
+            last_error,
+            retry_after,
         )
 
     async def _predict_async(
@@ -542,6 +651,7 @@ class Client:
         # every terminal failure surfaces as ClientError
         kwargs.setdefault("headers", {}).update(self._headers())
         breaker = self._breaker()
+        self._check_quota_gate(machine)
         started = time.monotonic()
         last_error: Optional[str] = None
         retry_after: Optional[float] = None
@@ -550,8 +660,10 @@ class Client:
                 delay = self._retry_delay(attempt, started, retry_after)
                 if delay is None:
                     _M_REQUESTS.labels("budget_exhausted").inc()
-                    raise ClientError(
-                        f"{machine}: retry budget exhausted ({last_error})"
+                    raise self._exhausted_error(
+                        f"{machine}: retry budget exhausted ({last_error})",
+                        last_error,
+                        retry_after,
                     )
                 time.sleep(delay)
                 self._refresh_deadline_header(kwargs["headers"])
@@ -581,6 +693,18 @@ class Client:
                 breaker.record(False)
                 last_error = repr(exc)
                 _M_RETRIES.labels("connection").inc()
+                continue
+            if response.status_code == 429:
+                # same quota carve-out as the async path: a healthy
+                # server metering THIS principal — success on the
+                # breaker, backoff on the tenant
+                breaker.record(True)
+                hint = self._parse_retry_after(
+                    response.headers.get("Retry-After")
+                )
+                retry_after = self._note_quota(hint)
+                last_error = "HTTP 429 (quota)"
+                _M_RETRIES.labels("quota").inc()
                 continue
             if 400 <= response.status_code < 500:
                 breaker.record(True)  # alive — the REQUEST is bad
@@ -629,9 +753,16 @@ class Client:
             _M_REQUESTS.labels("ok").inc()
             chunk = self._chunk_frame(payload)
             return chunk if chunk is not None else pd.DataFrame()
-        _M_REQUESTS.labels("exhausted").inc()
-        raise ClientError(
-            f"{machine}: retries exhausted ({last_error})"
+        outcome = (
+            "quota_exhausted"
+            if last_error == "HTTP 429 (quota)"
+            else "exhausted"
+        )
+        _M_REQUESTS.labels(outcome).inc()
+        raise self._exhausted_error(
+            f"{machine}: retries exhausted ({last_error})",
+            last_error,
+            retry_after,
         )
 
     def predict(

@@ -627,6 +627,64 @@ def server_objectives() -> List[Objective]:
     ]
 
 
+def tenant_objectives(tenants: Any = ()) -> List[Objective]:
+    """Per-class and per-declared-tenant availability objectives over
+    the bounded ``gordo_tenant_requests_total`` family (§25): bad events
+    are overload sheds and server errors at the admission seam — quota
+    rejections are deliberately NOT bad (a tenant spending its own
+    declared budget is the system working). Cardinality is bounded by
+    construction: three classes plus the closed declared table.
+
+    ``tenants`` duck-types ``qos.TenantSpec`` (``.name``/``.klass``) so
+    this module never imports the resilience layer."""
+    # class targets step down the ladder: bulk is the class the shed
+    # actuator squeezes on purpose, so holding it to the interactive
+    # availability target would page on intended behavior
+    class_targets = {
+        "interactive": availability_target(),
+        "standard": 0.99,
+        "bulk": 0.95,
+    }
+    bad_outcomes = ("shed", "error")
+    out = [
+        Objective(
+            name=f"class-{klass}-availability",
+            kind="availability",
+            metric="gordo_tenant_requests_total",
+            target=target,
+            label_filter={"class": klass},
+            bad_filter={"class": klass, "outcome": bad_outcomes},
+            description=(
+                f"shed+error ratio under {1 - target:.2%} for the "
+                f"{klass} class at the admission seam"
+            ),
+        )
+        for klass, target in class_targets.items()
+    ]
+    for spec in tenants:
+        name = getattr(spec, "name", None)
+        if not name or name == "default":
+            continue
+        target = class_targets.get(
+            getattr(spec, "klass", "standard"), 0.99
+        )
+        out.append(
+            Objective(
+                name=f"tenant-{name}-availability",
+                kind="availability",
+                metric="gordo_tenant_requests_total",
+                target=target,
+                label_filter={"tenant": name},
+                bad_filter={"tenant": name, "outcome": bad_outcomes},
+                description=(
+                    f"shed+error ratio under {1 - target:.2%} for "
+                    f"tenant {name}"
+                ),
+            )
+        )
+    return out
+
+
 def router_objectives() -> List[Objective]:
     """The router defaults: end-to-end route latency (the ``route``
     stage wraps placement + forward + re-route walks) and fleet

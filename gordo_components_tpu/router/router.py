@@ -51,6 +51,7 @@ from ..observability import (
 from ..observability import slo as slo_engine
 from ..observability import telemetry as telemetry_engine
 from ..observability.registry import REGISTRY
+from ..resilience import qos
 from ..watchman.control import DRAINING_HEADER, ControlPlane
 from .placement import Placement
 from .rollout import RolloutManager
@@ -118,6 +119,9 @@ _URL_MAP = Map(
         Rule("/healthz", endpoint="healthz"),
         Rule("/metrics", endpoint="metrics"),
         Rule("/slo", endpoint="slo"),
+        # §25: the QoS control surface — declared tenants, classes,
+        # quota state, and the raw-header heavy-hitter sketch
+        Rule("/tenants", endpoint="tenants"),
         # fleet telemetry warehouse (§24): per-worker warehouses fetched
         # and merged (rates summed, percentiles recomputed, latency MAX)
         Rule("/telemetry", endpoint="telemetry"),
@@ -192,10 +196,19 @@ class FleetRouter:
         # truncated-stitch pull ledger: claims a pending pull exactly
         # once across concurrent /debug readers (never held across HTTP)
         self._stitch_lock = lockcheck.named_lock("router.stitch")
+        # §25: the tenant table at the fleet's front door — the SAME
+        # GORDO_TENANTS spec the workers load, so a name resolves to the
+        # same class on both tiers, and unknown names fold into the
+        # default tenant (bounded metric cardinality by construction)
+        self.tenants = qos.TenantTable.from_env()
         # router-side SLO engine (§18): route latency + routability
-        # objectives over the router's own series, scrape-driven
+        # objectives over the router's own series, scrape-driven;
+        # per-class/per-tenant availability (§25) rides the same engine
         self.slo = (
-            slo_engine.SLOEvaluator(slo_engine.router_objectives())
+            slo_engine.SLOEvaluator(
+                slo_engine.router_objectives()
+                + slo_engine.tenant_objectives(self.tenants.specs())
+            )
             if slo_engine.enabled()
             else None
         )
@@ -244,6 +257,7 @@ class FleetRouter:
                 )
                 if request.path not in (
                     "/healthz", "/metrics", "/slo", "/router/status",
+                    "/tenants",
                 ) and not request.path.startswith(
                     ("/debug/", "/autopilot")
                 ):
@@ -306,6 +320,8 @@ class FleetRouter:
                 return _json({"enabled": False})
             self.slo.maybe_tick()
             return _json(self.slo.snapshot(recorder=flightrec.RECORDER))
+        if endpoint == "tenants":
+            return _json(self.tenants.snapshot())
         if endpoint == "telemetry":
             if not telemetry_engine.enabled():
                 return _json({"enabled": False})
@@ -425,6 +441,24 @@ class FleetRouter:
         order on dead/draining/unreachable candidates. The whole decision
         + forward is the timeline's ``route`` stage."""
         self.placement.note_request(machine)
+        # §25: per-tenant accounting at the front door too — the router's
+        # SLO engine reads its OWN registry, and a router-side shed (no
+        # routable worker) would otherwise be invisible to tenant
+        # availability. The tenant header itself forwards to the worker
+        # untouched (it is not hop-by-hop).
+        tenant_spec = self.tenants.resolve(
+            request.headers.get(qos.TENANT_HEADER)
+        )
+        base_path = path.split("?", 1)[0]
+        is_scoring = base_path.endswith("/prediction")
+        klass = (
+            "bulk"
+            if base_path.endswith("/bulk/anomaly/prediction")
+            else tenant_spec.klass
+        )
+        timeline = spans.current_timeline()
+        if timeline is not None:
+            timeline.meta["tenant"] = tenant_spec.name
         body = request.get_data()
         headers = {
             key: value
@@ -465,9 +499,28 @@ class FleetRouter:
                         breaker,
                     )
                     if response is not None:
-                        spans.event("routed", worker=worker_name)
+                        spans.event(
+                            "routed",
+                            worker=worker_name,
+                            tenant=tenant_spec.name,
+                        )
+                        if is_scoring:
+                            status = response.status_code
+                            qos.note_request(
+                                tenant_spec.name,
+                                klass,
+                                "quota" if status == 429
+                                else "shed" if status == 503
+                                else "ok" if status < 400
+                                else "error",
+                            )
                         return response
         _M_UNROUTABLE.inc()
+        if is_scoring:
+            # a router-side shed: every candidate dead/draining — charge
+            # it to the tenant's availability like any worker-side shed
+            qos.note_request(tenant_spec.name, klass, "shed")
+        spans.event("unroutable", machine=machine, tenant=tenant_spec.name)
         return self._unroutable(
             f"no routable worker for machine {machine!r} "
             f"(candidates: {candidates})"

@@ -88,7 +88,7 @@ from ..observability import traffic as traffic_accounting
 from ..observability.registry import REGISTRY
 from ..ops import windowing
 from ..ops.scaling import ScalerParams
-from ..resilience import deadline, faults
+from ..resilience import deadline, faults, qos
 
 logger = logging.getLogger(__name__)
 
@@ -610,12 +610,17 @@ class _SpillScorer:
 
 class _Item:
     __slots__ = ("idx", "x", "m_valid", "in_flight", "done", "result",
-                 "error", "ctx")
+                 "error", "ctx", "klass")
 
     def __init__(self, idx: int, x: np.ndarray, m_valid: int):
         self.idx = idx
         self.x = x
         self.m_valid = m_valid
+        # priority class captured at submit time (the request thread's
+        # tenant contextvar): the drain loop's weighted-fair interleave
+        # orders fused-batch slots by it. Reordering is byte-safe —
+        # scores are per-item under vmap, independent of batch position.
+        self.klass = qos.current_class()
         # set (under the bucket condition) when a leader pops this item off
         # the pending queue: a woken waiter whose item is in flight must
         # wait for the collector, not elect itself leader
@@ -1399,9 +1404,21 @@ class _Bucket:
                             self._cond.notify_all()
                     if not pending:
                         break
+                    # weighted-fair ordering at drain time (§25): within
+                    # each rows-bucket, interleave items by priority class
+                    # (deficit-weighted) so a saturating bulk tenant fills
+                    # the TAIL batches of a drain round, not every slot of
+                    # the first fused batch. Single-class rounds — the
+                    # whole idle path — take a one-scan fast path that
+                    # returns the list untouched.
                     batches = [
-                        (batch_rows, items[start : start + self.max_batch])
+                        (batch_rows, fair[start : start + self.max_batch])
                         for batch_rows, items in pending.items()
+                        for fair in (
+                            qos.weighted_interleave(
+                                items, lambda it: it.klass
+                            ),
+                        )
                         for start in range(0, len(items), self.max_batch)
                     ]
                     for i, (batch_rows, batch_items) in enumerate(batches):

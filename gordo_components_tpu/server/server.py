@@ -63,11 +63,12 @@ from ..observability import exposition, flightrec, spans, stitch, tracing
 from ..observability import slo as slo_engine
 from ..observability import telemetry as telemetry_engine
 from ..observability.registry import REGISTRY
-from ..resilience import deadline, faults
+from ..resilience import deadline, faults, qos
 from ..resilience.admission import (
     DRAINING_HEADER,
     AdmissionController,
     AdmissionRejected,
+    QuotaExceeded,
 )
 from ..resilience.deadline import DeadlineExceeded
 from ..resilience.quarantine import Quarantine
@@ -89,6 +90,9 @@ _M_REQUESTS = REGISTRY.counter(
     "HTTP requests served, by endpoint and status code",
     labels=("endpoint", "status"),
 )
+# endpoints whose outcomes feed the per-tenant accounting counter (§25)
+_SCORING_ENDPOINTS = ("prediction", "anomaly", "bulk-anomaly")
+
 _M_WIRE_FORMAT = REGISTRY.counter(
     "gordo_server_wire_format_total",
     "Scoring responses by negotiated wire format (npz = binary "
@@ -115,8 +119,17 @@ _URL_MAP = Map(
         # closed-loop controller status + runtime kill switch (§20)
         Rule("/autopilot", endpoint="autopilot"),
         Rule("/autopilot/<action>", endpoint="autopilot-action"),
+        # multi-tenant QoS (§25): declared tenant table, live bucket
+        # levels, class watermarks at the current shed level
+        Rule("/tenants", endpoint="tenants"),
         Rule("/prediction", endpoint="prediction"),
         Rule("/anomaly/prediction", endpoint="anomaly"),
+        # bulk/offline scoring surface (§25): same anomaly scoring, but
+        # the request is FORCED into the bulk priority class — its own
+        # endpoint label keeps it outside the interactive latency SLO,
+        # and large windows amortize through the engine's fused-batch
+        # slicing + host-RAM spill tier like any lazy-fleet traffic
+        Rule("/bulk/anomaly/prediction", endpoint="bulk-anomaly"),
         Rule("/download-model", endpoint="download-model"),
         # flight recorder: recent/slow/errored request timelines, and one
         # trace's full timeline (?format=chrome = Perfetto-loadable)
@@ -128,6 +141,10 @@ _URL_MAP = Map(
         Rule(
             "/gordo/v0/<project>/<machine>/anomaly/prediction",
             endpoint="anomaly",
+        ),
+        Rule(
+            "/gordo/v0/<project>/<machine>/bulk/anomaly/prediction",
+            endpoint="bulk-anomaly",
         ),
         Rule(
             "/gordo/v0/<project>/<machine>/download-model",
@@ -521,11 +538,17 @@ class ModelServer:
         )
         if max_inflight is None:
             max_inflight = int(os.environ.get("GORDO_MAX_INFLIGHT", "64"))
+        # multi-tenant QoS (§25): the declared tenant table (GORDO_TENANTS
+        # / --tenants) — identity, priority classes, token-bucket quotas.
+        # Undeclared deployments get the one default tenant and behave
+        # exactly as before.
+        self.tenants = qos.TenantTable.from_env()
         self.admission = AdmissionController(
             max_inflight=max_inflight,
             max_queue=int(os.environ.get("GORDO_MAX_QUEUE", "32")),
             queue_timeout=float(os.environ.get("GORDO_QUEUE_TIMEOUT", "1.0")),
             retry_after=1.0,
+            tenants=self.tenants,
         )
         self.quarantine = Quarantine(cooldown=quarantine_cooldown)
         self.drain_timeout = drain_timeout
@@ -626,7 +649,12 @@ class ModelServer:
         # multi-window burn rate on the scrape path (/metrics and /slo
         # reads piggyback maybe_tick — no supervisor thread)
         self.slo = (
-            slo_engine.SLOEvaluator(slo_engine.server_objectives())
+            slo_engine.SLOEvaluator(
+                slo_engine.server_objectives()
+                # per-class + per-declared-tenant burn rates over the
+                # bounded tenant counter (§25)
+                + slo_engine.tenant_objectives(self.tenants.specs())
+            )
             if slo_engine.enabled()
             else None
         )
@@ -688,6 +716,7 @@ class ModelServer:
         fill_window_us: Optional[int] = None,
         max_inflight: Optional[int] = None,
         megabatch_residency: Optional[int] = None,
+        shed_level: Optional[int] = None,
     ) -> Dict[str, Any]:
         """The autopilot's actuation seam (§20): land new knob values on
         the LIVE serving state without a reload. Admission resizes under
@@ -700,6 +729,13 @@ class ModelServer:
                 max_inflight
             )
             self._tuning["max_inflight"] = applied["max_inflight"]
+        if shed_level is not None:
+            # §25: the shed ladder — tightens ONLY the bulk class's
+            # admission watermark; rung 0 = no shedding
+            applied["shed_level"] = self.admission.set_shed_level(
+                shed_level
+            )
+            self._tuning["shed_level"] = applied["shed_level"]
         engine_values = {
             "dispatch_depth": dispatch_depth,
             "fill_window_us": fill_window_us,
@@ -1183,6 +1219,16 @@ class ModelServer:
         deadline_token = (
             deadline.set_deadline(budget) if budget is not None else None
         )
+        # tenant identity seam (§25): resolve X-Gordo-Tenant (name or
+        # declared API key; absent/unknown → default tenant) and bind it
+        # to this handler's context — the admission gate reads the class
+        # watermark and quota bucket from it, the engine's fill window
+        # reads the class at submit time
+        tenant_spec = self.tenants.resolve(
+            request.headers.get(qos.TENANT_HEADER)
+        )
+        qos_token = qos.set_current(tenant_spec)
+        shed = False
         # per-request span timeline, bound to this handler's context; the
         # engine's leader/collector threads receive it via each item's
         # captured SpanContext (contextvars do not cross those threads)
@@ -1200,11 +1246,29 @@ class ModelServer:
             try:
                 endpoint, args = adapter.match()
                 response = self._dispatch(request, endpoint, args, state)
+            except QuotaExceeded as exc:
+                # quota, not overload: 429 tells THIS tenant to slow
+                # down without claiming the fleet is hurting; the hint
+                # is the bucket's actual refill time
+                spans.event(
+                    "quota_exceeded", tenant=exc.tenant,
+                    retry_after=exc.retry_after,
+                )
+                response = _json(
+                    {"error": f"quota exhausted: {exc}",
+                     "tenant": exc.tenant},
+                    status=429,
+                )
+                response.headers["Retry-After"] = _retry_after(exc.retry_after)
             except AdmissionRejected as exc:
-                # load shed: tell the client WHEN to come back, not just no
+                # load shed: tell the client WHEN to come back, not just
+                # no — the hint derives from the gate's measured drain
+                # rate, so backed-off clients converge on real capacity
+                shed = True
                 spans.event(
                     "admission_rejected", reason=str(exc),
                     retry_after=exc.retry_after,
+                    tenant=tenant_spec.name,
                 )
                 response = _json({"error": f"overloaded: {exc}"}, status=503)
                 response.headers["Retry-After"] = _retry_after(exc.retry_after)
@@ -1244,9 +1308,24 @@ class ModelServer:
             elapsed = time.perf_counter() - started
             _M_REQUEST_SECONDS.labels(endpoint).observe(elapsed)
             _M_REQUESTS.labels(endpoint, str(response.status_code)).inc()
+            if endpoint in _SCORING_ENDPOINTS:
+                # per-tenant accounting at the admission seam (§25):
+                # tenant/class come from the closed table, outcome is a
+                # closed enum — cardinality bounded by configuration
+                status = response.status_code
+                qos.note_request(
+                    tenant_spec.name,
+                    "bulk" if endpoint == "bulk-anomaly"
+                    else tenant_spec.klass,
+                    "quota" if status == 429
+                    else "shed" if shed
+                    else "ok" if status < 400
+                    else "error",
+                )
             if timeline is not None:
                 status = response.status_code
                 timeline.meta["endpoint"] = endpoint
+                timeline.meta["tenant"] = tenant_spec.name
                 if self.worker_id is not None:
                     timeline.meta["worker"] = self.worker_id
                 if self.mesh_shard is not None:
@@ -1274,7 +1353,7 @@ class ModelServer:
                 # N machines would flush every scoring trace out of the
                 # ring within one poll interval
                 if endpoint not in (
-                    "healthz", "metrics", "slo",
+                    "healthz", "metrics", "slo", "tenants",
                     "autopilot", "autopilot-action",
                     "debug-requests", "debug-request",
                 ):
@@ -1297,6 +1376,7 @@ class ModelServer:
         finally:
             if timeline_token is not None:
                 spans.end(timeline_token)
+            qos.reset(qos_token)
             if deadline_token is not None:
                 deadline.reset(deadline_token)
             tracing.reset_trace_id(token)
@@ -1475,6 +1555,14 @@ class ModelServer:
                 return _json({"enabled": False})
             self.slo.maybe_tick()
             return _json(self.slo.snapshot(recorder=flightrec.RECORDER))
+        if endpoint == "tenants":
+            # §25: declared table + live bucket levels + top raw header
+            # values, alongside the gate's class watermarks at the
+            # current shed rung — one curl answers "who is declared,
+            # who is spraying unknown names, who is being squeezed"
+            snap = self.tenants.snapshot()
+            snap["admission"] = self.admission.stats()
+            return _json(snap)
         if endpoint == "telemetry":
             if self.telemetry is None:
                 return _json({"enabled": False})
@@ -1593,7 +1681,7 @@ class ModelServer:
                 serializer_dumps(machine.model),
                 mimetype="application/octet-stream",
             )
-        if endpoint in ("prediction", "anomaly"):
+        if endpoint in _SCORING_ENDPOINTS:
             # pin THIS generation while scoring: a concurrent reload
             # drains these before releasing dropped machines' params
             state.enter()
@@ -1644,6 +1732,15 @@ class ModelServer:
             # cooldown elapsed: this request is the recovery probe
             probing = True
             logger.info("Quarantine recovery probe for machine %r", name)
+        # §25: the bulk surface forces the bulk priority class whatever
+        # class the tenant declared — the quota identity (and bucket)
+        # stays the tenant's own. Rebound here, not in __call__, so the
+        # engine's fill window reads "bulk" at submit time too.
+        bulk_token = None
+        if endpoint == "bulk-anomaly":
+            spec = qos.current() or self.tenants.default
+            if spec.klass != "bulk":
+                bulk_token = qos.set_current(qos.as_class(spec, "bulk"))
         try:
             # the admit() call itself is the gate wait (it returns the
             # release handle): staged so a queued request's timeline shows
@@ -1654,6 +1751,8 @@ class ModelServer:
                 if endpoint == "prediction":
                     response = self._predict(request, machine, state)
                 else:
+                    # anomaly and bulk-anomaly share the scoring path;
+                    # they differ only in class and SLO accounting
                     response = self._anomaly(request, machine, state)
         except (AdmissionRejected, DeadlineExceeded):
             if probing:  # the model was never exercised: don't burn the
@@ -1671,6 +1770,9 @@ class ModelServer:
                 # well-formed request can still recover it immediately
                 self.quarantine.release_probe(name)
             raise
+        finally:
+            if bulk_token is not None:
+                qos.reset(bulk_token)
         if probing:
             self.quarantine.recover(name)
             logger.info("Machine %r recovered from quarantine", name)

@@ -606,7 +606,8 @@ def test_server_autopilot_endpoints_and_kill_switch(
     assert body["enabled"] is True
     assert body["role"] == "server"
     assert set(body["actuators"]) == {
-        "dispatch_depth", "fill_window", "max_inflight", "residency",
+        "dispatch_depth", "fill_window", "max_inflight", "shed",
+        "residency",
     }
     disabled = client.post("/autopilot/disable").get_json()
     assert disabled["enabled"] is False

@@ -439,6 +439,7 @@ def run_load(
     project: str = "capacity",
     record: Optional[List[Tuple[float, str]]] = None,
     script: Optional[Sequence[Tuple[float, str]]] = None,
+    tenant: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Drive production-shaped load: Zipf machine choice (the hottest
     machine boosted ``hot_boost``x — the hot-key scenario), a diurnal
@@ -446,13 +447,17 @@ def run_load(
     ``script`` (a ``[(offset_s, machine), ...]`` load script — e.g. one
     extracted from flight-recorder timelines) the machine SEQUENCE and
     relative timing replay instead. ``record`` collects this run's
-    ``(offset, machine)`` schedule for later replay."""
+    ``(offset, machine)`` schedule for later replay. ``tenant`` stamps
+    every request with ``X-Gordo-Tenant`` (the §25 QoS principal) and
+    the result's ``status_counts`` splits refusals by code — 429 quota
+    vs 503 shed, the contract the QoS gates assert."""
     import requests
 
     sampler = ZipfSampler(machines)
     hot = sampler.head(1)[0]
     latencies_ms: List[float] = []
     failures: List[str] = []
+    status_counts: Dict[str, int] = {}
     lock = threading.Lock()
     stop = threading.Event()
     started = time.perf_counter()
@@ -485,6 +490,10 @@ def run_load(
             return not_before, hot
         return not_before, sampler.sample()
 
+    request_headers = {"Content-Type": "application/json"}
+    if tenant:
+        request_headers["X-Gordo-Tenant"] = tenant
+
     def client() -> None:
         session = requests.Session()
         while not stop.is_set():
@@ -505,7 +514,7 @@ def run_load(
                     f"{base_url}/gordo/v0/{project}/{machine}"
                     "/anomaly/prediction",
                     data=payloads[template_of(machine)],
-                    headers={"Content-Type": "application/json"},
+                    headers=request_headers,
                     timeout=30,
                 )
                 ok = response.status_code == 200
@@ -514,6 +523,7 @@ def run_load(
                 ok, tag = False, type(exc).__name__
             elapsed_ms = (time.perf_counter() - t0) * 1000
             with lock:
+                status_counts[tag] = status_counts.get(tag, 0) + 1
                 if ok:
                     latencies_ms.append(elapsed_ms)
                 else:
@@ -545,8 +555,66 @@ def run_load(
         "distinct_machines": len(
             {m for _, m in record} if record else set()
         ) or None,
+        "status_counts": dict(sorted(status_counts.items())),
         "mode": "replay" if script_queue is not None else "shaped",
     }
+
+
+# -- multi-tenant QoS mix (§25) -----------------------------------------------
+# the canonical three-principal mix every QoS gate drives: a premium
+# interactive tenant, an unmetered bulk tenant, and an "abusive" tenant
+# declared with a small token bucket (20 rps, burst 10) it will blow
+# through. Boot the tier with this in GORDO_TENANTS before calling
+# qos_mix.
+QOS_TENANTS = "premium:interactive;batch:bulk;abuser:standard:20:10"
+
+
+def qos_mix(
+    base_url: str,
+    machines: Sequence[str],
+    seconds: float,
+    interactive_threads: int = 3,
+    bulk_threads: int = 12,
+    abusive_threads: int = 6,
+    project: str = "capacity",
+) -> Dict[str, Any]:
+    """The §25 tenant mix, all principals CONCURRENTLY through one tier:
+    ``premium`` (interactive class) at modest closed-loop concurrency,
+    ``batch`` (bulk class) saturating at ``bulk_threads``, and
+    ``abuser`` hammering past its declared token-bucket rate. Returns
+    per-tenant attainment — rps, p99, and the ok / 503-shed / 429-quota
+    split — which is per-CLASS attainment, since each tenant is its
+    class's only principal in :data:`QOS_TENANTS`."""
+    roles = {
+        "premium": {"threads": interactive_threads, "base_rps": 40.0},
+        "batch": {"threads": bulk_threads, "base_rps": 100000.0},
+        "abuser": {"threads": abusive_threads, "base_rps": 100000.0},
+    }
+    results: Dict[str, Any] = {}
+
+    def drive(name: str, cfg: Dict[str, Any]) -> None:
+        results[name] = run_load(
+            base_url, machines, seconds, threads=cfg["threads"],
+            base_rps=cfg["base_rps"], project=project, tenant=name,
+        )
+
+    drivers = [
+        threading.Thread(target=drive, args=(name, cfg), daemon=True)
+        for name, cfg in roles.items()
+    ]
+    for driver in drivers:
+        driver.start()
+    for driver in drivers:
+        driver.join(timeout=seconds + 60)
+    for name, result in results.items():
+        counts = result.get("status_counts", {})
+        total = sum(counts.values())
+        result["attainment"] = (
+            round(counts.get("200", 0) / total, 4) if total else None
+        )
+        result["shed_503"] = counts.get("503", 0)
+        result["quota_429"] = counts.get("429", 0)
+    return results
 
 
 # -- flight-recorder replay ---------------------------------------------------
@@ -857,8 +925,13 @@ def full_run(
         f"join {report['placement']['join_incremental_ms']}ms vs rebuild "
         f"{report['placement']['join_full_rebuild_ms']}ms")
 
-    log(f"[5/6] router tier: {workers} lazy workers, shaped load "
+    log(f"[5/7] router tier: {workers} lazy workers, shaped load "
         f"{seconds}s x {threads} threads, then flight-recorder replay")
+    # §25: boot the tier with the canonical tenant table so the QoS mix
+    # phase has declared principals; the shaped/replay phases run bare
+    # (default tenant, standard class) and behave exactly as before
+    saved_tenants = os.environ.get("GORDO_TENANTS")
+    os.environ["GORDO_TENANTS"] = QOS_TENANTS
     tier = RouterTier(root, n_workers=workers, eager=eager,
                       host_cache_mb=host_cache_mb)
     try:
@@ -910,10 +983,24 @@ def full_run(
             log(f"    replay: {report['replay']['requests']} of "
                 f"{len(script)} recorded timelines replayed, p99 "
                 f"{report['replay']['p99_ms']}ms")
+        log("[6/7] multi-tenant QoS mix (§25): premium + bulk "
+            "saturation + abusive tenants, concurrently")
+        report["qos"] = qos_mix(
+            tier.base_url, sampler.head(8), min(seconds, 6.0)
+        )
+        for name in ("premium", "batch", "abuser"):
+            row = report["qos"].get(name, {})
+            log(f"    {name}: {row.get('rps')} rps ok, p99 "
+                f"{row.get('p99_ms')}ms, shed_503 {row.get('shed_503')}, "
+                f"quota_429 {row.get('quota_429')}")
     finally:
         tier.close()
+        if saved_tenants is None:
+            os.environ.pop("GORDO_TENANTS", None)
+        else:
+            os.environ["GORDO_TENANTS"] = saved_tenants
 
-    log("[6/6] metrics exposition bound")
+    log("[7/7] metrics exposition bound")
     report["metrics"] = metrics_bound()
     log(f"    {report['metrics']['exposition_bytes']} bytes, worst "
         f"machine cardinality {report['metrics']['max_machine_values']} "
