@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke reconcile-smoke smoke images builder-image server-image watchman-image
 
 # invariant linter (docs/ARCHITECTURE.md §17/§21): lock discipline
 # against the declared hierarchy, blocking-calls-under-hot-locks,
@@ -155,6 +155,17 @@ telemetry-smoke:
 qos-smoke:
 	JAX_PLATFORMS=cpu python tools/qos_smoke.py
 
+# declarative fleet reconciler check (§26): a 6-machine tier with three
+# seeded divergences — SIGKILLed worker, stale CURRENT pointer, machine
+# declared bf16 while built f32 — self-heals to the journaled spec
+# through the real seams (respawn / pin / precision rebuild /
+# canary→sweep reload) with ZERO client-visible errors under trickle
+# traffic; then two mid-sweep kill drills assert the WAL's exactly-once
+# contract (crashed step re-executes, landed-but-unmarked step resumes
+# without re-running)
+reconcile-smoke:
+	JAX_PLATFORMS=cpu python tools/reconcile_smoke.py
+
 # the full smoke battery: invariant lint + exposition + resilience +
 # store integrity + serving data plane + span attribution + cold-start
 # economics + cross-machine megabatching + the horizontal serving tier
@@ -167,7 +178,9 @@ qos-smoke:
 # + the telemetry warehouse (traffic top-K / cost ledger / export /
 #   accounting overhead)
 # + multi-tenant QoS (quotas / priority classes / class-ordered sheds)
-smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke
+# + the declarative fleet reconciler (journaled specs / self-healing
+#   convergence / WAL exactly-once disaster drills)
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke reconcile-smoke
 
 images: builder-image server-image watchman-image
 

@@ -277,6 +277,23 @@ KNOBS: Dict[str, Knob] = dict(
               "`min:max` rungs for the shed-ladder actuator (§25): "
               "sustained SLO burn progressively tightens the BULK "
               "class's admission share, relaxing on recovery", "autopilot"),
+        # -- fleet reconciler (§26) --------------------------------------
+        _knob("GORDO_FLEET", "unset", "bool",
+              "declarative fleet reconciler: unset/`1` constructs it "
+              "(inert until a spec is committed via `/fleet/apply`), "
+              "explicit `0` is the hard kill switch (no reconciler at "
+              "all; `/fleet` answers hard_off)", "fleet"),
+        _knob("GORDO_FLEET_INTERVAL", "10", "float",
+              "min seconds between scrape-driven reconcile ticks "
+              "(`/metrics` and `/fleet` reads piggyback them)", "fleet"),
+        _knob("GORDO_FLEET_REPAIR_BUDGET", "2", "int",
+              "max repairs applied per reconcile tick — a degraded "
+              "fleet is nudged toward spec, never stormed; the rest "
+              "journal `deferred`", "fleet"),
+        _knob("GORDO_FLEET_COOLDOWN", "30", "float",
+              "seconds a divergence class rests after a repair (seeded "
+              "from the reconcile WAL on restart); the oscillation "
+              "guard's hold window is 4× this", "fleet"),
         # -- store -------------------------------------------------------
         _knob("GORDO_STORE_KEEP_GENERATIONS", "3", "int",
               "generations kept per machine after a commit prunes old "
@@ -365,6 +382,12 @@ KNOBS: Dict[str, Knob] = dict(
               "qos smoke: premium p99 bound under bulk saturation — "
               "deliberately coarse (below the queue-timeout cliff); "
               "zero premium sheds is the sharp gate", "bench"),
+        _knob("GORDO_RECONCILE_SMOKE_MACHINES", "6", "int",
+              "reconcile smoke (§26): synthetic-fleet size for "
+              "`tools/reconcile_smoke.py`", "bench"),
+        _knob("GORDO_RECONCILE_SMOKE_TIMEOUT", "240", "float",
+              "reconcile smoke: per-phase convergence deadline in "
+              "seconds (covers the bf16 precision rebuild)", "bench"),
         # -- test / validation harnesses ---------------------------------
         _knob("GORDO_LOCKCHECK", "0", "bool",
               "runtime lock-order validator: named locks record real "

@@ -29,10 +29,14 @@ from typing import Dict, Tuple
 LOCK_RANKS: Dict[str, int] = {
     # -- admin / control-plane outer locks (held across whole operations)
     "server.reload": 10,        # server.py _reload_lock: one reload at a time
+    "fleet.reconcile": 11,      # reconciler.py _lock: held across repairs,
+                                # which nest into every admin lock below
     "autopilot.state": 12,      # controller.py _lock: tick/decision state
     "autopilot.elastic": 13,    # elastic.py _lock: one scale op at a time
     "parallel.shard_plan": 14,  # shard_plan.py plan cache (boot/reload/router)
     "router.op": 15,            # rollout.py _op_lock: one rollout/rollback
+    "fleet.spec": 16,           # spec.py _lock: journal cache + commits
+                                # (reconciler rollback nests under 11/15)
     "server.admission": 20,     # admission.py gate condition
     "resilience.qos": 22,       # qos.py tenant quota table + header sketch
     "server.state_cond": 25,    # server.py _ServerState in-flight tracking
@@ -120,6 +124,8 @@ LOCK_ATTRS: Dict[Tuple[str, str], str] = {
     ("router/workers.py", "_lock"): "router.workers",
     ("watchman/control.py", "_lock"): "watchman.control",
     ("client/client.py", "_io_lock"): "client.io",
+    ("fleet/spec.py", "_lock"): "fleet.spec",
+    ("fleet/reconciler.py", "_lock"): "fleet.reconcile",
 }
 
 
@@ -182,6 +188,10 @@ GUARDED_FIELDS: Dict[Tuple[str, str], str] = {
     ("observability/telemetry.py", "_index"): "observability.telemetry",
     ("observability/traffic.py", "_pending"): "observability.traffic",
     ("observability/traffic.py", "_rates"): "observability.traffic",
+    # fleet spec journal cache + reconciler repair ring / WAL step map (§26)
+    ("fleet/spec.py", "_records"): "fleet.spec",
+    ("fleet/reconciler.py", "_ring"): "fleet.reconcile",
+    ("fleet/reconciler.py", "_steps"): "fleet.reconcile",
 }
 
 

@@ -158,6 +158,13 @@ ROUTES: Tuple[RouteSpec, ...] = (
               "controller status; reads are evaluation ticks (§20)"),
     RouteSpec("/autopilot/<action>", ("server", "router"),
               "POST enable|disable; 409 when hard-off (§20)"),
+    RouteSpec("/fleet", ("router",),
+              "reconciler status: committed spec revision, divergence "
+              "counts, repair ring; reads are reconcile ticks (§26)"),
+    RouteSpec("/fleet/<action>", ("router",),
+              "GET status|diff, POST apply|rollback: journaled spec "
+              "commits + read-only spec-vs-observed diff; 409 when "
+              "hard-off (§26)"),
     RouteSpec("/prediction", ("server", "router"), "single-model scoring"),
     RouteSpec("/anomaly/prediction", ("server", "router"),
               "anomaly scoring; 503+Retry-After on shed/quarantine, "
@@ -195,7 +202,7 @@ ROUTES: Tuple[RouteSpec, ...] = (
 # influx data plane — is NOT the router↔worker protocol and is excluded)
 WIRE_COMPONENTS = frozenset(
     {"server", "router", "client", "watchman", "observability",
-     "resilience", "autopilot", "cli", "tools"}
+     "resilience", "autopilot", "fleet", "cli", "tools"}
 )
 
 _HTTP_VERBS = frozenset(

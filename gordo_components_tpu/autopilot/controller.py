@@ -177,6 +177,34 @@ class Autopilot:
         _M_ENABLED.set(0.0)
         logger.warning("Autopilot (%s) disabled: %s", self.role, reason)
 
+    def set_bounds(self, name: str, lo: int, hi: int) -> bool:
+        """Re-aim one actuator's hard bounds at runtime — the fleet
+        reconciler's ownership seam (§26): the autopilot adapts freely
+        INSIDE the envelope, the spec owns the envelope itself. The
+        change is journaled like a decision; a no-op (same bounds)
+        journals nothing. Returns whether the bounds changed."""
+        from .policy import Bounds
+
+        actuator = self.actuators.get(name)
+        if actuator is None:
+            raise KeyError(f"unknown actuator {name!r}")
+        with self._lock:
+            old = actuator.bounds
+            if (old.lo, old.hi) == (lo, hi):
+                return False
+            actuator.bounds = Bounds(int(lo), int(hi))
+            self._journal_locked(
+                name, "bounds", "fleet_spec",
+                value_from=None, value_to=None, now=self._clock(),
+                extra={"bounds_from": [old.lo, old.hi],
+                       "bounds_to": [int(lo), int(hi)]},
+            )
+        logger.info(
+            "Autopilot (%s): %s bounds re-aimed [%d, %d] -> [%d, %d]",
+            self.role, name, old.lo, old.hi, lo, hi,
+        )
+        return True
+
     # -- evaluation ----------------------------------------------------------
     def maybe_tick(self, now: Optional[float] = None) -> bool:
         """Scrape-path entry (like ``SLOEvaluator.maybe_tick``): tick
@@ -567,4 +595,10 @@ def build_router_autopilot(router, clock=time.monotonic):
     ]
     pilot = Autopilot(reader, actuators, role="router", clock=clock)
     pilot.elastic = elastic
+    # exposed for the measured-capacity feed (§24→§26): the thresholds
+    # object is shared by closure with every rule, so mutating it
+    # re-aims the running controller; static_idle_rps remembers the env
+    # default as the floor the measurement can never drop below
+    pilot.thresholds = thresholds
+    pilot.static_idle_rps = thresholds.idle_rps
     return pilot

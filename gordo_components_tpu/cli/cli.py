@@ -1071,6 +1071,91 @@ def autopilot_disable_cmd(base_url):
     click.echo(json.dumps(body, indent=2))
 
 
+@gordo.group("fleet")
+def fleet_group():
+    """The declarative fleet reconciler (ARCHITECTURE §26): journaled
+    desired-state specs the router continuously converges the fleet
+    toward.
+
+    ``apply`` commits a JSON spec file as a new journal revision;
+    ``diff`` shows spec-vs-observed divergences without repairing;
+    ``status`` dumps the /fleet body (revision, divergence counts,
+    repair ring, frozen/cooling classes); ``rollback`` re-applies the
+    previous revision as a new one. The HARD kill switch is
+    ``GORDO_FLEET=0`` at router start — under it no reconciler exists
+    and every verb answers 409.
+    """
+
+
+def _fleet_request(base_url: str, path: str, method: str = "GET",
+                   payload=None):
+    import requests
+
+    url = f"{base_url.rstrip('/')}{path}"
+    try:
+        response = requests.request(
+            method, url, timeout=30,
+            json=payload if payload is not None else None,
+        )
+    except requests.RequestException as exc:
+        logger.error("Could not reach %s: %s", url, exc)
+        sys.exit(1)
+    try:
+        body = response.json()
+    except ValueError:
+        logger.error("Non-JSON answer from %s (HTTP %d)", url,
+                     response.status_code)
+        sys.exit(1)
+    if response.status_code >= 400:
+        logger.error("%s answered HTTP %d: %s", url, response.status_code,
+                     body.get("error", body))
+        sys.exit(1)
+    return body
+
+
+@fleet_group.command("apply")
+@click.argument("spec_file", type=click.Path(exists=True))
+@click.option("--base-url", required=True, help="router base URL")
+def fleet_apply_cmd(spec_file, base_url):
+    """Commit SPEC_FILE (a JSON fleet spec) as a new revision:
+    ``POST /fleet/apply``. Parsing is loud — an unknown machine,
+    precision rung, or key is a 422, never a silent no-op."""
+    with open(spec_file) as fh:
+        try:
+            payload = json.load(fh)
+        except ValueError as exc:
+            logger.error("%s is not JSON: %s", spec_file, exc)
+            sys.exit(1)
+    body = _fleet_request(base_url, "/fleet/apply", method="POST",
+                          payload=payload)
+    click.echo(json.dumps(body, indent=2))
+
+
+@fleet_group.command("diff")
+@click.option("--base-url", required=True, help="router base URL")
+def fleet_diff_cmd(base_url):
+    """Spec-vs-observed divergences, read-only: ``GET /fleet/diff``
+    (no repairs run, no budget spent)."""
+    click.echo(json.dumps(_fleet_request(base_url, "/fleet/diff"),
+                          indent=2))
+
+
+@fleet_group.command("status")
+@click.option("--base-url", required=True, help="router base URL")
+def fleet_status_cmd(base_url):
+    """Reconciler status from a live router's ``/fleet``."""
+    click.echo(json.dumps(_fleet_request(base_url, "/fleet"), indent=2))
+
+
+@fleet_group.command("rollback")
+@click.option("--base-url", required=True, help="router base URL")
+def fleet_rollback_cmd(base_url):
+    """Re-apply the previous spec revision as a NEW journaled revision:
+    ``POST /fleet/rollback`` (422 with fewer than two revisions)."""
+    body = _fleet_request(base_url, "/fleet/rollback", method="POST")
+    click.echo(json.dumps(body, indent=2))
+
+
 @gordo.group("telemetry")
 def telemetry_group():
     """The fleet telemetry warehouse (ARCHITECTURE §24): durable metric

@@ -1,0 +1,26 @@
+"""Declarative fleet control (ARCHITECTURE §26).
+
+The paper's top layer declares desired state and lets a controller
+converge the cluster onto it; this package rebuilds that contract over
+the repo's own actuators. :mod:`.spec` is the artifact — a versioned
+:class:`~gordo_components_tpu.fleet.spec.FleetSpec` committed through an
+fsync'd journal (rollback = re-apply the previous revision); :mod:`.reconciler`
+is the mechanism — a scrape-driven diff/repair loop that drives the
+EXISTING seams (respawn, elastic scaling, canary→sweep adoption,
+generation pinning, precision rebuilds, mesh re-layout) toward the
+declared state, journaling every repair with WAL idempotence keys so a
+crash mid-apply resumes without double-applying. :mod:`.capacity` folds
+the telemetry warehouse's measured-cost ledger into the spec's default
+worker bounds and the autopilot's thresholds, replacing hardcoded
+guesses with measured ones.
+"""
+
+from .spec import FleetSpec, SpecError, SpecStore  # noqa: F401
+from .reconciler import (  # noqa: F401
+    Divergence,
+    Observed,
+    Reconciler,
+    RepairSeams,
+    diff_spec,
+)
+from .wiring import build_router_reconciler  # noqa: F401

@@ -12,15 +12,18 @@ Spec grammar (``GORDO_FAULTS`` env var or ``--faults`` CLI flag)::
     point:target:kind[:param][;point:target:kind[:param]...]
 
 - ``point``   — where: ``model-load``, ``engine-dispatch``, ``probe``,
-  ``data-fetch``, ``store-commit`` (the wired boundaries; unknown points
-  simply never fire)
+  ``data-fetch``, ``store-commit``, ``spec-commit``, ``reconcile-apply``
+  (the wired boundaries; unknown points simply never fire)
 - ``target``  — machine/endpoint name, or ``*`` for any
 - ``kind``    — ``error`` (raise :class:`FaultInjected`; param = message),
   ``latency`` (sleep; param = seconds, default 0.05),
-  ``corrupt`` (NaN-poison the payload via :func:`corrupt`), or — at the
-  ``store-commit`` seam — ``truncate`` / ``bitflip`` (damage one staged
+  ``corrupt`` (NaN-poison the payload via :func:`corrupt`), at the
+  ``store-commit`` seam ``truncate`` / ``bitflip`` (damage one staged
   artifact file AFTER its manifest hash was recorded; param = filename,
-  default ``state.npz`` — via :func:`damage_artifact`)
+  default ``state.npz`` — via :func:`damage_artifact`), or — at the
+  journal-append seams (``spec-commit``) — ``torn-write`` (chop the
+  just-fsynced final journal line in half AFTER the append, the on-disk
+  shape of a crash mid-write — via :func:`tear_tail`)
 
 Example: one machine slow, another broken at load::
 
@@ -48,8 +51,11 @@ ENV_VAR = "GORDO_FAULTS"
 
 POINTS = (
     "model-load", "engine-dispatch", "probe", "data-fetch", "store-commit",
+    "spec-commit", "reconcile-apply",
 )
-KINDS = ("error", "latency", "corrupt", "truncate", "bitflip")
+KINDS = (
+    "error", "latency", "corrupt", "truncate", "bitflip", "torn-write",
+)
 
 _M_INJECTED = REGISTRY.counter(
     "gordo_resilience_faults_injected_total",
@@ -222,6 +228,38 @@ def damage_artifact(point: str, target: Optional[str], directory: str) -> None:
         _M_INJECTED.labels(point, rule.kind).inc()
         logger.warning(
             "FAULT: %s %s at %s (target %r)", rule.kind, path, point, target
+        )
+
+
+def tear_tail(point: str, target: Optional[str], path: str) -> None:
+    """Apply any matching ``torn-write`` fault to a journal file: cut
+    the final line in half, leaving the byte shape a crash mid-append
+    leaves behind (a record whose fsync never completed). Called AFTER
+    the append — the writer believes the record landed, the next reader
+    must tolerate and drop the torn tail."""
+    rules = _active_rules()
+    if not rules:
+        return
+    for rule in rules:
+        if rule.kind != "torn-write" or not rule.matches(point, target):
+            continue
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+            stripped = data.rstrip(b"\n")
+            cut = stripped.rfind(b"\n") + 1  # start of the final line
+            keep = cut + max(1, (len(stripped) - cut) // 2)
+            with open(path, "r+b") as fh:
+                fh.truncate(keep)
+        except OSError as exc:
+            logger.warning(
+                "Fault %s:torn-write could not tear %s: %s",
+                point, path, exc,
+            )
+            continue
+        _M_INJECTED.labels(point, "torn-write").inc()
+        logger.warning(
+            "FAULT: torn-write %s at %s (target %r)", path, point, target
         )
 
 

@@ -164,6 +164,9 @@ def assemble_fleet(
     # §23: the reload endpoint re-derives the layout policy after fleet
     # membership changes (None on non-mesh routers)
     router.mesh_refresh = mesh_refresh
+    # §26: the observed shard count the reconciler diffs a declared
+    # mesh_shards against (None = fleet assembled without a mesh)
+    router.mesh_shards = int(mesh_shards) if mesh_shards else None
     return router
 
 

@@ -136,6 +136,25 @@ class RolloutManager:
             time.sleep(0.2)
         return {"ok": False, "error": last_error}
 
+    # public aliases for the fleet reconciler (§26): its per-worker
+    # canary→sweep steps ride the SAME reload/verify verbs the operator
+    # rollout uses, so a worker cannot tell the two apart
+    def reload_worker(self, name: str) -> Dict[str, Any]:
+        return self._reload_worker(name)
+
+    def verify_worker(self, name: str) -> Dict[str, Any]:
+        return self._verify_worker(name)
+
+    def try_claim_op(self) -> bool:
+        """Non-blocking claim of the one-rollout-at-a-time lock — the
+        reconciler's adoption steps must never interleave with an
+        operator ``/reload``/``/rollback`` (and vice versa: while the
+        reconciler holds it, those answer busy)."""
+        return self._op_lock.acquire(blocking=False)
+
+    def release_op(self) -> None:
+        self._op_lock.release()
+
     def _routable_workers(self) -> List[str]:
         return [
             name

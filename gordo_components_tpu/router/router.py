@@ -40,6 +40,8 @@ from werkzeug.wrappers import Request, Response
 
 from ..analysis import lockcheck
 from ..autopilot import build_router_autopilot, disabled_snapshot
+from ..fleet import reconciler as fleet_reconciler
+from ..fleet.spec import FleetSpec, SpecError
 from ..observability import (
     aggregate,
     exposition,
@@ -128,6 +130,10 @@ _URL_MAP = Map(
         # elastic autopilot: status + runtime kill switch (§20)
         Rule("/autopilot", endpoint="autopilot"),
         Rule("/autopilot/<action>", endpoint="autopilot-action"),
+        # declarative fleet reconciler (§26): spec status, diff, apply,
+        # rollback — the desired-state control surface
+        Rule("/fleet", endpoint="fleet"),
+        Rule("/fleet/<action>", endpoint="fleet-action"),
         Rule("/models", endpoint="models"),
         Rule("/reload", endpoint="reload"),
         Rule("/rollback", endpoint="rollback"),
@@ -217,6 +223,14 @@ class FleetRouter:
         # None under GORDO_AUTOPILOT=0; constructed-but-frozen when the
         # knob is unset.
         self.autopilot = build_router_autopilot(self)
+        # declarative fleet reconciler (§26): journaled desired-state
+        # specs diffed against the observed fleet each scrape, repaired
+        # through the seams above (supervisor, rollout, autopilot,
+        # generation store). None under GORDO_FLEET=0 or without a
+        # models_root; inert until a spec is committed.
+        from ..fleet.wiring import build_router_reconciler
+
+        self.fleet = build_router_reconciler(self)
         tracing.install_log_record_factory()
 
     # -- WSGI ----------------------------------------------------------------
@@ -290,6 +304,8 @@ class FleetRouter:
                 self.slo.maybe_tick()
             if self.autopilot is not None:
                 self.autopilot.maybe_tick()
+            if self.fleet is not None:
+                self.fleet.maybe_tick()
             exemplars = request.args.get("exemplars") in ("1", "true")
             if request.args.get("format") == "prometheus":
                 if request.args.get("aggregate") in (
@@ -369,6 +385,15 @@ class FleetRouter:
                     status=404,
                 )
             return _json(self.autopilot.snapshot())
+        if endpoint == "fleet":
+            if self.fleet is None:
+                return _json(fleet_reconciler.disabled_snapshot())
+            if self.slo is not None:
+                self.slo.maybe_tick()
+            self.fleet.maybe_tick()
+            return _json(self.fleet.snapshot())
+        if endpoint == "fleet-action":
+            return self._fleet_action(request, args.get("action"))
         if endpoint == "debug-requests":
             limit = request.args.get("limit", type=int)
             return _json(
@@ -434,6 +459,57 @@ class FleetRouter:
             )
         machine = args["machine"]
         return self._route(request, machine, request.full_path.rstrip("?"))
+
+    # -- fleet spec control surface (§26) ------------------------------------
+    def _fleet_action(self, request: Request, action: str) -> Response:
+        if self.fleet is None:
+            return _json(
+                {
+                    **fleet_reconciler.disabled_snapshot(),
+                    "error": "fleet reconciler not constructed "
+                             "(GORDO_FLEET=0 or no models_root)",
+                },
+                status=409,
+            )
+        if action == "status":
+            return _json(self.fleet.snapshot())
+        if action == "diff":
+            return _json(self.fleet.diff_now())
+        if action == "apply":
+            if request.method != "POST":
+                return _json({"error": "POST required"}, status=405)
+            try:
+                payload = json.loads(request.get_data(as_text=True) or "{}")
+            except ValueError as exc:
+                return _json(
+                    {"error": f"spec body is not JSON: {exc}"}, status=400
+                )
+            known = None
+            if self.models_root:
+                from ..store.generations import build_fleet_index
+
+                known = sorted(build_fleet_index(self.models_root))
+            try:
+                spec = FleetSpec.parse(payload, known_machines=known)
+            except SpecError as exc:
+                return _json({"error": str(exc)}, status=422)
+            record = self.fleet.spec_store.commit(spec, op="apply")
+            return _json({"committed": True, "record": record})
+        if action == "rollback":
+            if request.method != "POST":
+                return _json({"error": "POST required"}, status=405)
+            try:
+                record = self.fleet.spec_store.rollback(
+                    reason="operator via /fleet/rollback"
+                )
+            except SpecError as exc:
+                return _json({"error": str(exc)}, status=422)
+            return _json({"committed": True, "record": record})
+        return _json(
+            {"error": f"unknown fleet action {action!r} "
+                      "(status | diff | apply | rollback)"},
+            status=404,
+        )
 
     # -- routing core --------------------------------------------------------
     def _route(self, request: Request, machine: str, path: str) -> Response:

@@ -118,6 +118,25 @@ def _swap_current(root: str, gen_name: str) -> None:
     atomic_write_file(os.path.join(root, CURRENT_FILE), gen_name + "\n")
 
 
+def pin_generation(root: str, gen_name: str) -> str:
+    """Repoint ``CURRENT`` at a NAMED existing generation — the fleet
+    reconciler's repair verb for a machine root whose pointer drifted
+    from the declared spec (forward or backward; :func:`rollback_generation`
+    only ever walks one step back). Raises :class:`ArtifactIncomplete`
+    when the named generation does not exist on disk — a spec pinning a
+    generation nobody committed is an operator error, surfaced loudly."""
+    if not _GEN_RE.match(gen_name):
+        raise ArtifactIncomplete(
+            f"{root}: {gen_name!r} is not a generation name"
+        )
+    if not os.path.isdir(os.path.join(root, gen_name)):
+        raise ArtifactIncomplete(
+            f"{root}: cannot pin {gen_name!r}: no such generation on disk"
+        )
+    _swap_current(root, gen_name)
+    return gen_name
+
+
 def next_generation_name(root: str) -> str:
     gens = list_generations(root)
     if not gens:

@@ -1,0 +1,618 @@
+"""Declarative fleet reconciler (ARCHITECTURE §26): loud spec parsing,
+journaled commits with torn-tail fsck, revision rollback, the pure diff
+engine on synthetic observed states, and the reconciler's safety gates
+(repair budget, per-class cooldown, oscillation guard) plus WAL
+exactly-once resume — all on fake clocks, zero real sleeps.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from gordo_components_tpu.fleet.reconciler import (
+    Divergence,
+    Observed,
+    Reconciler,
+    RepairSeams,
+    diff_spec,
+)
+from gordo_components_tpu.fleet.spec import (
+    FleetSpec,
+    SpecError,
+    SpecStore,
+)
+from gordo_components_tpu.fleet import capacity
+from gordo_components_tpu.observability.flightrec import FlightRecorder
+from gordo_components_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# -- spec parsing -------------------------------------------------------------
+
+def test_spec_parse_roundtrip():
+    payload = {
+        "machines": {
+            "m-1": {"generation": "gen-0002", "precision": "bf16"},
+            "m-2": {"generation": "current"},
+        },
+        "workers": {"floor": 2, "ceiling": 4},
+        "mesh_shards": 2,
+        "canary_fraction": 0.5,
+        "residency_cap": 64,
+        "slo": {"p99_ms": 250, "availability": 99.9},
+        "tenants": "acme:interactive:100",
+    }
+    spec = FleetSpec.parse(payload, known_machines=["m-1", "m-2"])
+    assert spec.workers == (2, 4)
+    assert spec.machines["m-1"] == {
+        "generation": "gen-0002", "precision": "bf16",
+    }
+    assert spec.mesh_shards == 2
+    # to_dict -> parse is identity on the normalized form
+    assert FleetSpec.parse(spec.to_dict()) == spec
+
+
+def test_spec_parse_is_loud():
+    with pytest.raises(SpecError, match="unknown fleet-spec key"):
+        FleetSpec.parse({"machine": {}})
+    with pytest.raises(SpecError, match="unknown machine 'typo'"):
+        FleetSpec.parse(
+            {"machines": {"typo": {}}}, known_machines=["m-1"]
+        )
+    with pytest.raises(SpecError, match="not on the\n? ?ladder"):
+        FleetSpec.parse({"machines": {"m": {"precision": "fp64"}}})
+    with pytest.raises(SpecError, match="generation must be"):
+        FleetSpec.parse({"machines": {"m": {"generation": "v7"}}})
+    with pytest.raises(SpecError, match="floor <= ceiling"):
+        FleetSpec.parse({"workers": {"floor": 5, "ceiling": 2}})
+    with pytest.raises(SpecError, match="canary_fraction"):
+        FleetSpec.parse({"canary_fraction": 0.0})
+    with pytest.raises(SpecError, match="must be an object"):
+        FleetSpec.parse(["not", "a", "spec"])
+
+
+# -- the journaled store ------------------------------------------------------
+
+def test_spec_store_commit_load_history(tmp_path):
+    clock = _Clock()
+    store = SpecStore(str(tmp_path), clock=clock)
+    assert store.load() is None
+    assert store.current_spec() is None
+
+    r1 = store.commit(FleetSpec.parse({"machines": {"m": {}}}))
+    r2 = store.commit(
+        FleetSpec.parse({"machines": {"m": {"precision": "f32"}}})
+    )
+    assert (r1["revision"], r2["revision"]) == (1, 2)
+    assert r2["parent"] == 1
+    revision, spec = store.current_spec()
+    assert revision == 2
+    assert spec.machines["m"] == {"precision": "f32"}
+    assert [r["revision"] for r in store.history()] == [1, 2]
+    assert store.record_for(1)["spec"] == {
+        "machines": {"m": {}}, "canary_fraction": 0.25,
+    }
+    # the pointer caches the journal's last revision
+    with open(store.pointer_path) as fh:
+        assert fh.read().strip() == "2"
+
+
+def test_spec_store_error_fault_commits_nothing(tmp_path):
+    store = SpecStore(str(tmp_path))
+    store.commit(FleetSpec.parse({}))
+    # the spec-commit seam, error kind: a crash BEFORE the append
+    faults.configure("spec-commit:2:error")
+    with pytest.raises(faults.FaultInjected):
+        store.commit(FleetSpec.parse({"mesh_shards": 4}))
+    faults.clear()
+    fresh = SpecStore(str(tmp_path))
+    assert fresh.load()["revision"] == 1
+
+
+def test_spec_store_torn_tail_fsck(tmp_path):
+    clock = _Clock()
+    store = SpecStore(str(tmp_path), clock=clock)
+    store.commit(FleetSpec.parse({"machines": {"m": {}}}))
+    # torn-write chops revision 2's just-appended journal line in half:
+    # the on-disk shape of a crash mid-append
+    faults.configure("spec-commit:2:torn-write")
+    store.commit(FleetSpec.parse({"mesh_shards": 4}))
+    faults.clear()
+    fresh = SpecStore(str(tmp_path), clock=clock)
+    record = fresh.load()
+    # the torn tail is dropped; revision 1 is the committed truth
+    assert record["revision"] == 1
+    assert "mesh_shards" not in record["spec"]
+    # ... and the pointer (written before the tear was discovered) was
+    # fsck'd back to the journal's last intact revision
+    with open(fresh.pointer_path) as fh:
+        assert fh.read().strip() == "1"
+    # the journal heals on the next commit: append-only, monotonic
+    r2 = fresh.commit(FleetSpec.parse({"mesh_shards": 8}))
+    assert r2["revision"] == 2
+    assert SpecStore(str(tmp_path)).load()["revision"] == 2
+
+
+def test_spec_rollback_appends_new_revision(tmp_path):
+    store = SpecStore(str(tmp_path))
+    with pytest.raises(SpecError, match="nothing to roll back"):
+        store.rollback()
+    store.commit(FleetSpec.parse({"mesh_shards": 2}))
+    store.commit(FleetSpec.parse({"mesh_shards": 4}))
+    record = store.rollback(reason="drill")
+    assert record["revision"] == 3
+    assert record["op"] == "rollback"
+    assert record["reverted_to"] == 1
+    assert record["spec"]["mesh_shards"] == 2
+    # history is append-only: all three revisions remain auditable
+    assert [r["revision"] for r in store.history()] == [1, 2, 3]
+
+
+# -- the pure diff engine -----------------------------------------------------
+
+def _observed(**kwargs):
+    base = dict(
+        workers_total=2,
+        workers_ready=["w0", "w1"],
+        workers_dead=[],
+        worker_generations={},
+        disk_generations={"m": "gen-0002"},
+        disk_precisions={"m": "f32"},
+        mesh_shards=None,
+        elastic_busy=False,
+        autopilot_bounds=(1, 8),
+    )
+    base.update(kwargs)
+    return Observed(**base)
+
+
+def test_diff_clean_fleet_is_empty():
+    spec = FleetSpec.parse({
+        "machines": {"m": {"generation": "gen-0002", "precision": "f32"}},
+        "workers": {"floor": 1, "ceiling": 8},
+        "mesh_shards": 2,
+    })
+    assert diff_spec(spec, _observed(mesh_shards=2)) == []
+
+
+def test_diff_every_class_in_repair_order():
+    spec = FleetSpec.parse({
+        "machines": {"m": {"generation": "gen-0003", "precision": "bf16"}},
+        "workers": {"floor": 2, "ceiling": 3},
+        "mesh_shards": 4,
+    })
+    observed = _observed(
+        workers_total=2,
+        workers_ready=["w1"],
+        workers_dead=["w0"],
+        worker_generations={"w1": {"m": "gen-0001"}},
+        mesh_shards=2,
+        autopilot_bounds=(1, 8),
+    )
+    divergences = diff_spec(spec, observed)
+    assert [d.cls for d in divergences] == [
+        "bounds", "workers", "generation", "precision", "adoption", "mesh",
+    ]
+    respawn = divergences[1]
+    assert respawn.target == "w0"
+    assert respawn.detail == {"action": "respawn"}
+    adoption = divergences[4]
+    # adoption converges workers onto DISK truth (the generation class
+    # moves the pointer; adoption follows it next tick)
+    assert adoption.desired == {"m": "gen-0002"}
+    assert adoption.actual == {"m": "gen-0001"}
+
+
+def test_diff_scale_up_and_down_one_step():
+    spec = FleetSpec.parse({"workers": {"floor": 3, "ceiling": 4}})
+    up = diff_spec(spec, _observed(
+        workers_total=1, workers_ready=["w0"], autopilot_bounds=(3, 4),
+    ))
+    assert up[0].cls == "workers" and up[0].target == "scale-up"
+    assert up[0].detail["to"] == 2  # one worker at a time toward floor
+    spec_down = FleetSpec.parse({"workers": {"floor": 1, "ceiling": 1}})
+    down = diff_spec(
+        spec_down,
+        _observed(workers_total=3, workers_ready=["w0", "w1", "w2"],
+                  autopilot_bounds=(1, 1)),
+    )
+    assert down[0].target == "scale-down" and down[0].detail["to"] == 2
+
+
+def test_diff_dead_workers_preempt_scaling():
+    # a dead slot is repaired by respawn, never papered over by scale
+    spec = FleetSpec.parse({"workers": {"floor": 2, "ceiling": 2}})
+    divergences = diff_spec(
+        spec, _observed(workers_total=2, workers_ready=["w1"],
+                        workers_dead=["w0"], autopilot_bounds=(2, 2)),
+    )
+    assert [d.detail.get("action") for d in divergences] == ["respawn"]
+
+
+def test_diff_default_bounds_backfill():
+    # no workers block in the spec: the measured/knob default governs
+    spec = FleetSpec.parse({})
+    divergences = diff_spec(
+        spec, _observed(autopilot_bounds=(1, 8)), default_workers=(2, 4),
+    )
+    assert divergences[0].cls == "bounds"
+    assert divergences[0].desired == [2, 4]
+
+
+def test_diff_tracking_current_generation_never_pins():
+    spec = FleetSpec.parse({"machines": {"m": {"generation": "current"}}})
+    assert diff_spec(spec, _observed()) == []
+
+
+# -- reconciler scaffolding ---------------------------------------------------
+
+class _Seams:
+    """RepairSeams with every call recorded."""
+
+    def __init__(self):
+        self.calls = []
+
+    def record(self, name):
+        def seam(*args):
+            self.calls.append((name, args))
+            return {"ok": True} if name in (
+                "reload_worker", "verify_worker"
+            ) else None
+        return seam
+
+    def build(self, **overrides):
+        seams = RepairSeams(
+            respawn=self.record("respawn"),
+            scale=self.record("scale"),
+            pin_generation=self.record("pin_generation"),
+            rebuild=self.record("rebuild"),
+            reload_worker=self.record("reload_worker"),
+            verify_worker=self.record("verify_worker"),
+            mesh_refresh=self.record("mesh_refresh"),
+            set_worker_bounds=self.record("set_worker_bounds"),
+        )
+        for key, value in overrides.items():
+            setattr(seams, key, value)
+        return seams
+
+
+def _reconciler(tmp_path, observed, clock=None, seams=None, **kwargs):
+    clock = clock or _Clock()
+    store = SpecStore(str(tmp_path), clock=clock)
+    holder = {"observed": observed}
+    kwargs.setdefault("min_interval", 1.0)
+    kwargs.setdefault("cooldown", 30.0)
+    kwargs.setdefault("repair_budget", 2)
+    kwargs.setdefault("recorder", FlightRecorder(enabled=True))
+    rec = Reconciler(
+        store,
+        lambda: holder["observed"],
+        seams,
+        clock=clock,
+        **kwargs,
+    )
+    return rec, store, holder, clock
+
+
+def test_maybe_tick_claims_interval(tmp_path):
+    rec, store, holder, clock = _reconciler(
+        tmp_path, _observed(), min_interval=10.0,
+    )
+    store.commit(FleetSpec.parse({}))
+    assert rec.maybe_tick() is True
+    assert rec.maybe_tick() is False  # inside the interval
+    clock.advance(10.0)
+    assert rec.maybe_tick() is True
+    assert rec.ticks == 2
+
+
+def test_repair_budget_defers_excess(tmp_path):
+    seams = _Seams()
+    clock = _Clock()
+    rec, store, holder, clock = _reconciler(
+        tmp_path,
+        _observed(
+            workers_ready=["w1"], workers_dead=["w0"], workers_total=2,
+            disk_generations={"m": "gen-0001"},
+            disk_precisions={"m": "f32"},
+            autopilot_bounds=(1, 8),
+        ),
+        clock=clock,
+        seams=seams.build(),
+        repair_budget=2,
+    )
+    store.commit(FleetSpec.parse({
+        "machines": {"m": {"generation": "gen-0002", "precision": "bf16"}},
+        "workers": {"floor": 2, "ceiling": 3},
+    }))
+    entries = rec.tick()
+    outcomes = [(e["class"], e["outcome"]) for e in entries]
+    # four divergences (bounds, workers, generation, precision), budget 2:
+    # the first two classes repair, the rest journal ONE deferred entry
+    assert outcomes == [
+        ("bounds", "applied"),
+        ("workers", "applied"),
+        ("generation", "deferred"),
+    ]
+    assert entries[-1]["reason"] == "repair_budget"
+    assert entries[-1]["actual"] == 2  # two repairs deferred
+    assert [c[0] for c in seams.calls] == ["set_worker_bounds", "respawn"]
+
+
+def test_class_cooldown_rests_repaired_class(tmp_path):
+    seams = _Seams()
+    observed = _observed(
+        workers_ready=["w1"], workers_dead=["w0"], workers_total=2,
+    )
+    rec, store, holder, clock = _reconciler(
+        tmp_path, observed, seams=seams.build(),
+        cooldown=30.0, repair_budget=4,
+    )
+    store.commit(FleetSpec.parse({}))
+    rec.tick()
+    assert [c for c in seams.calls if c[0] == "respawn"] == [
+        ("respawn", ("w0",))
+    ]
+    # the respawn has not landed yet next tick: class is cooling, the
+    # same divergence is NOT re-repaired
+    clock.advance(1.0)
+    assert rec.tick() == []
+    assert len([c for c in seams.calls if c[0] == "respawn"]) == 1
+    # past the cooldown the divergence (still present) repairs again
+    clock.advance(30.0)
+    rec.tick()
+    assert len([c for c in seams.calls if c[0] == "respawn"]) == 2
+
+
+def test_oscillation_guard_freezes_fighting_class(tmp_path):
+    seams = _Seams()
+    observed = _observed(disk_generations={"m": "gen-0001"})
+    rec, store, holder, clock = _reconciler(
+        tmp_path, observed, seams=seams.build(),
+        cooldown=0.0, min_interval=1.0,
+    )
+    # something keeps swapping CURRENT back: spec says 0002, disk says
+    # 0001 every tick no matter how often we pin
+    store.commit(FleetSpec.parse(
+        {"machines": {"m": {"generation": "gen-0002"}}}
+    ))
+    assert rec.tick()[0]["outcome"] == "applied"
+    clock.advance(1.0)
+    assert rec.tick()[0]["outcome"] == "applied"
+    clock.advance(1.0)
+    held = rec.tick()
+    assert held[0]["outcome"] == "hold"
+    assert held[0]["reason"] == "oscillation_guard"
+    pins = len([c for c in seams.calls if c[0] == "pin_generation"])
+    assert pins == 2  # the guard stopped the third pin
+    # while frozen: silent skip, no journal churn
+    clock.advance(1.0)
+    assert rec.tick() == []
+    snap = rec.snapshot()
+    assert "generation" in snap["frozen"]
+
+
+def test_unwired_seam_journals_unwired(tmp_path):
+    rec, store, holder, clock = _reconciler(
+        tmp_path, _observed(mesh_shards=2), seams=RepairSeams(),
+    )
+    store.commit(FleetSpec.parse({"mesh_shards": 4}))
+    entries = rec.tick()
+    assert [(e["class"], e["outcome"]) for e in entries] == [
+        ("mesh", "unwired")
+    ]
+
+
+def test_elastic_busy_skips_scale_without_budget(tmp_path):
+    seams = _Seams()
+    rec, store, holder, clock = _reconciler(
+        tmp_path,
+        _observed(workers_total=1, workers_ready=["w0"], elastic_busy=True,
+                  autopilot_bounds=(2, 3)),
+        seams=seams.build(),
+    )
+    store.commit(FleetSpec.parse({"workers": {"floor": 2, "ceiling": 3}}))
+    assert rec.tick() == []  # no journal entry, no budget spent
+    assert seams.calls == []
+
+
+def test_adoption_respects_operator_op_lock(tmp_path):
+    seams = _Seams()
+    rec, store, holder, clock = _reconciler(
+        tmp_path,
+        _observed(
+            workers_ready=["w0"], workers_total=1,
+            worker_generations={"w0": {"m": "gen-0001"}},
+            disk_generations={"m": "gen-0002"},
+        ),
+        seams=seams.build(acquire_op=lambda: False),
+    )
+    store.commit(FleetSpec.parse({}))
+    assert rec.tick() == []  # operator rollout in flight: never interleave
+    assert seams.calls == []
+
+
+def test_canary_failure_rolls_spec_back(tmp_path):
+    seams = _Seams()
+    failing = seams.build(
+        reload_worker=lambda name: {"ok": False, "error": "boom"},
+    )
+    rec, store, holder, clock = _reconciler(
+        tmp_path,
+        _observed(
+            workers_ready=["w0", "w1"], workers_total=2,
+            worker_generations={
+                "w0": {"m": "gen-0001"}, "w1": {"m": "gen-0001"},
+            },
+            disk_generations={"m": "gen-0002"},
+        ),
+        seams=failing,
+    )
+    store.commit(FleetSpec.parse({"mesh_shards": 2}))
+    store.commit(FleetSpec.parse({"mesh_shards": 4}))
+    entries = rec.tick()
+    assert entries[0]["outcome"] == "canary_failed"
+    assert len(entries) == 1  # the sweep ended at the canary
+    # the canary abort IS a journaled revert to the previous revision
+    record = store.load()
+    assert record["op"] == "rollback"
+    assert record["reverted_to"] == 1
+    assert record["revision"] == 3
+    # adoption is frozen for the hold window
+    assert "adoption" in rec.snapshot()["frozen"]
+
+
+def test_wal_resume_is_exactly_once(tmp_path):
+    """Crash drill: kill the reconciler between the WAL's `applying`
+    and the repair marker. On resume, a step whose divergence is GONE
+    recovers its marker WITHOUT re-executing; one whose divergence
+    persists re-executes (the effect never landed)."""
+    seams = _Seams()
+    observed = _observed(
+        workers_ready=["w1"], workers_dead=["w0"], workers_total=2,
+    )
+    rec, store, holder, clock = _reconciler(
+        tmp_path, observed, seams=seams.build(),
+    )
+    store.commit(FleetSpec.parse({}))
+    # the reconcile-apply seam: crash mid-apply, AFTER `applying` landed
+    faults.configure("reconcile-apply:workers/w0:error")
+    entries = rec.tick()
+    assert [e["outcome"] for e in entries] == ["aborted"]
+    assert seams.calls == []  # the crash hit before the seam ran
+    faults.clear()
+
+    # case 1: the divergence persists (respawn never happened) — a
+    # fresh reconciler over the same WAL re-executes, exactly once
+    clock.advance(60.0)
+    rec2, = [Reconciler(
+        store, lambda: holder["observed"], seams.build(),
+        clock=clock, min_interval=1.0, cooldown=30.0,
+        recorder=FlightRecorder(enabled=True),
+    )]
+    entries = rec2.tick()
+    assert [(e["class"], e["outcome"]) for e in entries] == [
+        ("workers", "applied")
+    ]
+    respawns = [c for c in seams.calls if c[0] == "respawn"]
+    assert respawns == [("respawn", ("w0",))]
+
+    # case 2: crash again, but this time the repair LANDED before the
+    # marker was written (divergence gone on resume) — the WAL marker
+    # is recovered, the seam is NOT re-run: no double-spawn
+    faults.configure("reconcile-apply:workers/w0:error")
+    clock.advance(60.0)
+    holder["observed"] = _observed(
+        workers_ready=["w1"], workers_dead=["w0"], workers_total=2,
+    )
+    assert [e["outcome"] for e in rec2.tick()] == ["aborted"]
+    faults.clear()
+    clock.advance(60.0)
+    holder["observed"] = _observed(
+        workers_ready=["w0", "w1"], workers_total=2,
+    )
+    rec3 = Reconciler(
+        store, lambda: holder["observed"], seams.build(),
+        clock=clock, min_interval=1.0, cooldown=30.0,
+        recorder=FlightRecorder(enabled=True),
+    )
+    entries = rec3.tick()
+    assert [e["outcome"] for e in entries] == ["resumed"]
+    assert len([c for c in seams.calls if c[0] == "respawn"]) == 1
+
+
+def test_retune_piggybacks_on_adoption(tmp_path):
+    seams = _Seams()
+    rec, store, holder, clock = _reconciler(
+        tmp_path,
+        _observed(
+            workers_ready=["w0"], workers_total=1,
+            worker_generations={"w0": {"m": "gen-0001"}},
+            disk_generations={"m": "gen-0002"},
+        ),
+        seams=seams.build(retune=seams.record("retune")),
+    )
+    store.commit(FleetSpec.parse({}))
+    entries = rec.tick()
+    assert entries[0]["outcome"] == "applied"
+    assert [c[0] for c in seams.calls] == [
+        "reload_worker", "verify_worker", "retune",
+    ]
+
+
+def test_snapshot_and_diff_now_read_only(tmp_path):
+    seams = _Seams()
+    rec, store, holder, clock = _reconciler(
+        tmp_path, _observed(mesh_shards=2), seams=seams.build(),
+    )
+    store.commit(FleetSpec.parse({"mesh_shards": 4}))
+    body = rec.diff_now()
+    assert body["revision"] == 1
+    assert [d["class"] for d in body["divergences"]] == ["mesh"]
+    assert seams.calls == []  # diff is observation only
+    snap = rec.snapshot()
+    assert snap["enabled"] is True
+    assert snap["revision"] == 1
+    assert snap["repair_budget"] == rec.repair_budget
+
+
+# -- measured capacity (§24 -> §26) -------------------------------------------
+
+def _view(requests=1000, seconds=10.0, demand=25.0):
+    return {
+        "costs": {"engine": {"rungs": {
+            "f32": {"requests": requests, "dispatch_seconds_total": seconds},
+        }}},
+        "window": {"rates": {
+            "gordo_server_requests_total": {"total": demand},
+        }},
+    }
+
+
+def test_capacity_derivation_and_dark_ledger():
+    view = _view(requests=1000, seconds=10.0, demand=250.0)
+    assert capacity.worker_capacity_rps(view) == 100.0
+    assert capacity.observed_demand_rps(view) == 250.0
+    # demand 250 at 100/worker -> floor 3, ceiling 6, inside 1..8
+    assert capacity.derive_worker_bounds(view, (1, 8)) == (3, 6)
+    # clamped into the operator's hard envelope
+    assert capacity.derive_worker_bounds(view, (1, 4)) == (3, 4)
+    # dark ledger (too few requests): no derived bounds, keep defaults
+    assert capacity.derive_worker_bounds(_view(requests=3), (1, 8)) is None
+    assert capacity.worker_capacity_rps({}) is None
+    assert capacity.measured_idle_rps(view, 1.0) == 5.0  # 5% of capacity
+
+
+def test_capacity_calibrates_live_thresholds():
+    class _Thresholds:
+        idle_rps = 1.0
+
+    class _Pilot:
+        thresholds = _Thresholds()
+        static_idle_rps = 1.0
+
+    pilot = _Pilot()
+    assert capacity.calibrate_autopilot(pilot, _view()) is True
+    assert pilot.thresholds.idle_rps == 5.0
+    # idempotent once converged
+    assert capacity.calibrate_autopilot(pilot, _view()) is False

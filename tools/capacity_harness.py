@@ -742,8 +742,11 @@ class RouterTier:
                 )
             return _ThreadWorker(spec, app)
 
+        # models_root wired through: the router-side organs that need a
+        # store to act on (rollout, fleet reconciler §26) come up live
         self.router = assemble_fleet(
-            specs, factory, project="capacity", respawn=False
+            specs, factory, project="capacity", models_root=root,
+            respawn=False,
         )
         self.router.supervisor.start_all()
         ready = self.router.supervisor.wait_ready(timeout=120)

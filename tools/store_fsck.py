@@ -11,6 +11,10 @@ What it checks, per machine dir:
   — pre-store artifacts are visible, not silently trusted).
 - crash debris: leftover ``.staging-*`` / ``.trash-*`` dirs are reported
   (and swept with ``--sweep``).
+- the fleet spec journal (``.fleet/``, ARCHITECTURE §26), when present:
+  the spec store self-fscks on every read (torn tail truncated, the
+  ``SPEC_CURRENT`` pointer re-derived from the journal's last whole
+  record) — the scan surfaces those repairs and the surviving revision.
 
 Repairs (``--quarantine``):
 
@@ -148,7 +152,63 @@ def fsck(
         report["machines"][entry] = machine
         if not machine["verified"]:
             report["ok"] = False
+    fleet = _fsck_fleet_spec(models_root)
+    if fleet is not None:
+        report["fleet_spec"] = fleet
+        if not fleet["verified"]:
+            report["ok"] = False
     return report
+
+
+def _fsck_fleet_spec(models_root: str):
+    """Fsck the §26 fleet spec journal, if one exists. The store itself
+    repairs on read (torn tail truncated, pointer re-derived) — this
+    records the pre-scan damage so the repairs are visible in the
+    report, then lets one read do them."""
+    from gordo_components_tpu.fleet.spec import (
+        FLEET_DIR,
+        SPEC_CURRENT_FILE,
+        SPEC_JOURNAL_FILE,
+        SpecStore,
+    )
+
+    journal_path = os.path.join(models_root, FLEET_DIR, SPEC_JOURNAL_FILE)
+    pointer_path = os.path.join(models_root, FLEET_DIR, SPEC_CURRENT_FILE)
+    if not (os.path.isfile(journal_path) or os.path.isfile(pointer_path)):
+        return None
+    result: dict = {"actions": [], "verified": False, "revision": None,
+                    "error": None}
+    torn_tail = False
+    pointer = None
+    try:
+        if os.path.isfile(journal_path):
+            with open(journal_path) as fh:
+                lines = [l for l in fh.read().splitlines() if l.strip()]
+            if lines:
+                try:
+                    json.loads(lines[-1])
+                except ValueError:
+                    torn_tail = True
+        if os.path.isfile(pointer_path):
+            try:
+                with open(pointer_path) as fh:
+                    pointer = int(fh.read().strip())
+            except ValueError:
+                pointer = None
+        record = SpecStore(models_root).load()
+    except OSError as exc:
+        result["error"] = f"{type(exc).__name__}: {exc}"
+        return result
+    revision = record["revision"] if record else 0
+    result["revision"] = revision
+    if torn_tail:
+        result["actions"].append("torn journal tail truncated")
+    if pointer != revision:
+        result["actions"].append(
+            f"SPEC_CURRENT repaired: {pointer!r} -> {revision}"
+        )
+    result["verified"] = True
+    return result
 
 
 def _quarantine_generation(root: str, gen: str, machine: dict) -> None:
@@ -245,8 +305,33 @@ def _selftest() -> int:
         write(legacy_root)
         # crash debris
         os.makedirs(os.path.join(torn_root, ".staging-gen-0003.dead"))
+        # fleet spec journal (§26): two good revisions, a torn appended
+        # tail, and a pointer wound ahead of the journal's truth
+        from gordo_components_tpu.fleet.spec import FleetSpec, SpecStore
+
+        spec_store = SpecStore(root)
+        spec_store.commit(
+            FleetSpec.parse({"workers": {"floor": 1, "ceiling": 2}})
+        )
+        spec_store.commit(
+            FleetSpec.parse({"workers": {"floor": 2, "ceiling": 3}})
+        )
+        with open(spec_store.journal_path, "ab") as fh:
+            fh.write(b'{"revision": 3, "op": "apply", "spec": {"wor')
+        with open(spec_store.pointer_path, "w") as fh:
+            fh.write("9\n")
 
         report = fsck(root, quarantine=False, sweep=False)
+        fleet = report.get("fleet_spec") or {}
+        check(fleet.get("verified") and fleet.get("revision") == 2,
+              "spec journal fsck survives at the last whole revision")
+        check(any("torn journal tail" in a for a in fleet.get("actions", []))
+              and any("SPEC_CURRENT repaired" in a
+                      for a in fleet.get("actions", [])),
+              "spec journal torn tail + wound pointer repairs reported")
+        with open(spec_store.pointer_path) as fh:
+            check(fh.read().strip() == "2",
+                  "SPEC_CURRENT re-derived on disk from the journal")
         check(report["machines"]["m-ok"]["verified"], "healthy machine verifies")
         check(not report["machines"]["m-torn"]["verified"],
               "torn CURRENT generation detected")
@@ -288,6 +373,8 @@ def _selftest() -> int:
         final = fsck(root, quarantine=False, sweep=False)
         check(final["machines"]["m-torn"]["verified"],
               "repaired machine verifies on re-scan")
+        check((final.get("fleet_spec") or {}).get("actions") == [],
+              "spec journal clean on re-scan (repairs stuck)")
     finally:
         shutil.rmtree(root, ignore_errors=True)
     print(
