@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke reconcile-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke reconcile-smoke layout-smoke smoke images builder-image server-image watchman-image
 
 # invariant linter (docs/ARCHITECTURE.md §17/§21): lock discipline
 # against the declared hierarchy, blocking-calls-under-hot-locks,
@@ -166,6 +166,18 @@ qos-smoke:
 reconcile-smoke:
 	JAX_PLATFORMS=cpu python tools/reconcile_smoke.py
 
+# fleet layout compiler check (§27): a skewed-Zipf 48-machine fleet
+# through the real 2-worker router tier — the live telemetry export
+# compiles into a deterministic plan whose cost block beats the uniform
+# name-hash baseline, the plan applied live through the journaled spec
+# at ZERO client-visible errors and ZERO fresh XLA compiles for
+# rung-unchanged machines, the re-run Zipf schedule lands a lower
+# measured p99 than name-hash, the parity-budgeted variant projects
+# more machines-per-GiB, and /fleet/rollback converges the plan away
+# cleanly. GORDO_LAYOUT_SMOKE_MACHINES/SECONDS resize
+layout-smoke:
+	JAX_PLATFORMS=cpu python tools/layout_smoke.py
+
 # the full smoke battery: invariant lint + exposition + resilience +
 # store integrity + serving data plane + span attribution + cold-start
 # economics + cross-machine megabatching + the horizontal serving tier
@@ -180,7 +192,9 @@ reconcile-smoke:
 # + multi-tenant QoS (quotas / priority classes / class-ordered sheds)
 # + the declarative fleet reconciler (journaled specs / self-healing
 #   convergence / WAL exactly-once disaster drills)
-smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke reconcile-smoke
+# + the fleet layout compiler (measured-cost plans / zero-compile live
+#   apply / p99 + density gates / rollback)
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke reconcile-smoke layout-smoke
 
 images: builder-image server-image watchman-image
 

@@ -1833,6 +1833,188 @@ def measure_telemetry() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def measure_layout() -> dict:
+    """Fleet layout compiler block (ISSUE 19, ARCHITECTURE §27): the
+    name-hash vs computed-plan A/B on one skewed-Zipf fleet through the
+    real 2-worker router tier —
+
+    - measured p99 under the identical seeded Zipf schedule before and
+      after the plan is applied live (committed as ``FleetSpec.layout``
+      and converged through the reconciler's weights + ``/layout``
+      seams — the same path production takes);
+    - megabatch residency hit rate per phase: the mega-path share of
+      ``gordo_engine_requests_total``, i.e. what the plan's
+      expected-hit-rate pins actually bought vs 2-hit LRU promotion;
+    - projected machines-per-GiB at the 0.02 parity budget (the §19
+      ladder byte ratios applied to the measured per-rung cost
+      ledger), computed plan vs name-hash baseline;
+    - plan provenance: fingerprint, ring weights, move count, and the
+      compiler's own cost block.
+
+    Env: BENCH_SERVE_LAYOUT=0 skips; GORDO_LAYOUT_BENCH_MACHINES (48)
+    and GORDO_LAYOUT_BENCH_SECONDS (5) size the run."""
+    import shutil
+    import tempfile
+
+    import requests
+
+    from gordo_components_tpu.layout import compiler as layout_compiler
+    from gordo_components_tpu.observability import traffic as traffic_mod
+    from tools import capacity_harness as ch
+
+    machines_n = int(os.environ.get("GORDO_LAYOUT_BENCH_MACHINES", "48"))
+    seconds = float(os.environ.get("GORDO_LAYOUT_BENCH_SECONDS", "5"))
+    residency_cap = 4  # partial residency, so pins have slots to steer
+    saved = {
+        k: os.environ.get(k)
+        for k in ("GORDO_TELEMETRY", "GORDO_TELEMETRY_INTERVAL",
+                  "GORDO_FLEET_INTERVAL", "GORDO_FLEET_COOLDOWN",
+                  "GORDO_FLEET_REPAIR_BUDGET",
+                  "GORDO_MEGABATCH_RESIDENCY", "GORDO_LAYOUT_REDERIVE")
+    }
+    os.environ["GORDO_TELEMETRY"] = "1"
+    os.environ["GORDO_TELEMETRY_INTERVAL"] = "0"
+    os.environ["GORDO_FLEET_INTERVAL"] = "0.2"
+    os.environ["GORDO_FLEET_COOLDOWN"] = "0"
+    os.environ["GORDO_FLEET_REPAIR_BUDGET"] = "8"
+    os.environ["GORDO_MEGABATCH_RESIDENCY"] = str(residency_cap)
+    # the A/B authors its own plan; staleness re-derive would replace
+    # it mid-measurement
+    os.environ["GORDO_LAYOUT_REDERIVE"] = "0"
+    root = tempfile.mkdtemp(prefix="gordo-bench-layout-")
+    tier = None
+    session = requests.Session()
+
+    def mega_share(mark: dict) -> tuple:
+        """(mega-path request share since ``mark``, fresh totals) from
+        the workers' gordo_engine_requests_total counters."""
+        totals: dict = {}
+        for spec in tier.router.supervisor.specs.values():
+            body = session.get(
+                f"{spec.base_url}/metrics", timeout=30
+            ).json()
+            series = (
+                body.get("registry", {})
+                .get("gordo_engine_requests_total", {})
+                .get("series", {})
+            )
+            for label, count in series.items():
+                totals[label] = totals.get(label, 0.0) + count
+        delta = {
+            label: count - mark.get(label, 0.0)
+            for label, count in totals.items()
+        }
+        requests_total = sum(delta.values())
+        mega = sum(
+            count for label, count in delta.items()
+            if 'path="mega"' in label
+        )
+        share = mega / requests_total if requests_total > 0 else None
+        return share, totals
+
+    try:
+        ch.generate_fleet(root, machines_n)
+        machines = sorted(
+            name for name in os.listdir(root)
+            if name.startswith("cap-")
+        )
+        # all-eager boot: the A/B measures placement economics, not the
+        # spill tier
+        tier = ch.RouterTier(root, n_workers=2, eager=machines_n)
+        tier.warm(machines)
+        # unmeasured shape warm (fused widths + promotions), then reset
+        # accounting so the export sees only the measured baseline
+        ch.run_load(tier.base_url, machines, min(3.0, seconds), threads=6)
+        traffic_mod.ACCOUNTANT.reset()
+        traffic_mod.ACCOUNTANT.tick()
+
+        share_baseline, mark = mega_share({})
+        load_baseline = ch.run_load(
+            tier.base_url, machines, seconds, threads=6,
+        )
+        share_baseline, mark = mega_share(mark)
+
+        doc = session.get(
+            f"{tier.base_url}/telemetry",
+            params={"window": "10m", "view": "export"}, timeout=30,
+        ).json()
+        plan = layout_compiler.compile_plan(
+            doc, residency_cap=residency_cap,
+        )
+        budgeted = layout_compiler.compile_plan(
+            doc, residency_cap=residency_cap, parity_budget=0.02,
+        )
+        committed = session.post(
+            f"{tier.base_url}/fleet/apply", json={"layout": plan},
+            timeout=30,
+        ).json()
+        converged = False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            session.get(f"{tier.base_url}/fleet", timeout=300)
+            diff = session.get(
+                f"{tier.base_url}/fleet/diff", timeout=300
+            ).json()
+            if diff.get("divergences") == []:
+                converged = True
+                break
+            time.sleep(0.25)
+
+        _, mark = mega_share({})  # re-mark: converge traffic excluded
+        load_plan = ch.run_load(
+            tier.base_url, machines, seconds, threads=6,
+        )
+        share_plan, _ = mega_share(mark)
+
+        gib_baseline = budgeted["cost"]["baseline"]["machines_per_gib"]
+        gib_plan = budgeted["cost"]["plan"]["machines_per_gib"]
+        return {
+            "machines": machines_n,
+            "fingerprint": plan["fingerprint"],
+            "committed": bool(committed.get("committed")),
+            "converged": converged,
+            "weights": plan["weights"],
+            "moves": len(plan["moves"]),
+            "cost": plan["cost"],
+            "baseline": load_baseline,
+            "plan": load_plan,
+            "residency_hit_rate": {
+                "baseline": round(share_baseline, 4)
+                if share_baseline is not None else None,
+                "plan": round(share_plan, 4)
+                if share_plan is not None else None,
+            },
+            "machines_per_gib": {
+                "baseline": gib_baseline,
+                "plan": gib_plan,
+                "parity_budget": 0.02,
+                "downgraded": len(budgeted["precision"]),
+            },
+            "headlines": {
+                "p99_ms_baseline": load_baseline.get("p99_ms"),
+                "p99_ms_plan": load_plan.get("p99_ms"),
+                "hit_rate_baseline": round(share_baseline, 4)
+                if share_baseline is not None else None,
+                "hit_rate_plan": round(share_plan, 4)
+                if share_plan is not None else None,
+                "machines_per_gib_baseline": gib_baseline,
+                "machines_per_gib_plan": gib_plan,
+                "moves": len(plan["moves"]),
+                "converged": converged,
+            },
+        }
+    finally:
+        if tier is not None:
+            tier.close()
+        traffic_mod.ACCOUNTANT.reset()
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> None:
     from gordo_components_tpu.utils.backend import (
         enable_persistent_compile_cache,
@@ -1879,6 +2061,12 @@ def main() -> None:
     # load (ISSUE 16, §24; BENCH_SERVE_TELEMETRY=0 skips it)
     if os.environ.get("BENCH_SERVE_TELEMETRY", "1") == "1":
         result["telemetry"] = measure_telemetry()
+    # fleet layout compiler A/B: the same skewed-Zipf schedule under
+    # the name-hash ring vs the live-applied computed plan — measured
+    # p99, megabatch residency hit rate, and projected machines-per-GiB
+    # at the parity budget (ISSUE 19, §27; BENCH_SERVE_LAYOUT=0 skips)
+    if os.environ.get("BENCH_SERVE_LAYOUT", "1") == "1":
+        result["layout"] = measure_layout()
     if degraded:
         result["degraded"] = (
             "accelerator tunnel down; measured on the CPU backend — "
@@ -1945,6 +2133,9 @@ def main() -> None:
             # telemetry warehouse headlines: scrape cost, write
             # economy, sketch coverage, export validity (§24)
             "telemetry": (result.get("telemetry") or {}).get("headlines"),
+            # layout compiler A/B headlines: name-hash vs computed plan
+            # on p99 / residency hit rate / machines-per-GiB (§27)
+            "layout": (result.get("layout") or {}).get("headlines"),
         })
     except Exception:
         pass  # history is never worth failing an artifact over

@@ -294,6 +294,27 @@ KNOBS: Dict[str, Knob] = dict(
               "seconds a divergence class rests after a repair (seeded "
               "from the reconcile WAL on restart); the oscillation "
               "guard's hold window is 4× this", "fleet"),
+        # -- layout compiler (§27) ---------------------------------------
+        _knob("GORDO_LAYOUT_HORIZON", "10m", "str",
+              "rate horizon the reconciler's layout staleness check and "
+              "re-derive compile read telemetry over (seconds or "
+              "`1m`/`10m`/`1h` forms; snaps to the nearest warehouse "
+              "EWMA horizon)", "layout"),
+        _knob("GORDO_LAYOUT_MAX_AGE", "900", "float",
+              "seconds before a committed layout plan counts as stale "
+              "on age alone and the reconciler re-derives it", "layout"),
+        _knob("GORDO_LAYOUT_DRIFT", "0.35", "float",
+              "total-variation distance between the plan's recorded "
+              "traffic shares and fresh telemetry above which the plan "
+              "counts as stale (0..1)", "layout"),
+        _knob("GORDO_LAYOUT_REDERIVE", "1", "bool",
+              "`0` stops the reconciler from re-deriving stale layout "
+              "plans (it keeps converging on the committed one; "
+              "`gordo layout apply` stays the only author)", "layout"),
+        _knob("GORDO_LAYOUT_PARITY_BUDGET", "0", "float",
+              "traffic-weighted parity budget `compile_plan` may spend "
+              "on precision downgrades when the caller passes none "
+              "(0 disables planned downgrades)", "layout"),
         # -- store -------------------------------------------------------
         _knob("GORDO_STORE_KEEP_GENERATIONS", "3", "int",
               "generations kept per machine after a commit prunes old "
@@ -388,6 +409,18 @@ KNOBS: Dict[str, Knob] = dict(
         _knob("GORDO_RECONCILE_SMOKE_TIMEOUT", "240", "float",
               "reconcile smoke: per-phase convergence deadline in "
               "seconds (covers the bf16 precision rebuild)", "bench"),
+        _knob("GORDO_LAYOUT_SMOKE_MACHINES", "48", "int",
+              "layout smoke (§27): synthetic-fleet size for "
+              "`tools/layout_smoke.py`", "bench"),
+        _knob("GORDO_LAYOUT_BENCH_MACHINES", "48", "int",
+              "bench `layout` block (§27): synthetic-fleet size for "
+              "the name-hash vs computed-plan A/B", "bench"),
+        _knob("GORDO_LAYOUT_BENCH_SECONDS", "5", "float",
+              "bench `layout` block: seconds of Zipf load per A/B "
+              "phase", "bench"),
+        _knob("GORDO_LAYOUT_SMOKE_SECONDS", "5", "float",
+              "layout smoke: seconds of skewed Zipf load per phase "
+              "through the 2-worker router tier", "bench"),
         # -- test / validation harnesses ---------------------------------
         _knob("GORDO_LOCKCHECK", "0", "bool",
               "runtime lock-order validator: named locks record real "

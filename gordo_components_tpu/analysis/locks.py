@@ -144,6 +144,8 @@ GUARDED_FIELDS: Dict[Tuple[str, str], str] = {
     # residency slot table (§12/§15)
     ("server/engine.py", "_hot"): "engine.hot",
     ("server/engine.py", "_mega_slots"): "engine.mega",
+    # layout-plan residency pins (§27): seed/steer the mega promoter
+    ("server/engine.py", "_mega_pinned"): "engine.mega",
     # host-RAM spill tier: the LRU dict, its byte ledger, and the
     # in-flight prefetch claims (§22)
     ("server/host_cache.py", "_entries"): "engine.host_cache",

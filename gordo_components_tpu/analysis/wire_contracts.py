@@ -148,6 +148,10 @@ ROUTES: Tuple[RouteSpec, ...] = (
     RouteSpec("/prefetch", ("server",),
               "POST placement hint (§22): queue async host-cache loads "
               "for lazy machines; advisory, never blocks"),
+    RouteSpec("/layout", ("server",),
+              "layout-plan slice (§27): POST pins this worker's resident "
+              "set/cap/prefetch hints under a plan fingerprint (or "
+              "clears them); GET echoes what was applied"),
     RouteSpec("/reload", ("server", "router"),
               "adopt a new generation; router: canary→sweep rollout, "
               "busy answers 409 (§16)"),

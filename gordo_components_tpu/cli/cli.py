@@ -1243,20 +1243,28 @@ def telemetry_costs_cmd(base_url, window):
 @telemetry_group.command("export")
 @click.option("--base-url", required=True,
               help="router or model-server base URL")
-@click.option("--window", default=300.0, show_default=True,
-              help="history window in seconds the rates are measured over")
+@click.option("--window", default="5m", show_default=True,
+              help="rate horizon: seconds or 1m/10m/1h forms")
 @click.option("--output", "-o", default=None,
               help="write the document here instead of stdout")
 def telemetry_export_cmd(base_url, window, output):
     """Emit the versioned layout-input document from ``?view=export``.
 
-    The document (schema ``gordo-layout-input/v1``) is validated
-    client-side before it is printed — a malformed answer exits nonzero
-    rather than handing layout planning a broken contract.
+    ``--window`` takes the warehouse horizon forms (``1m``/``10m``/
+    ``1h``) or bare seconds; the document's per-machine ``rate`` field
+    snaps to the nearest tracked EWMA horizon. The document (schema
+    ``gordo-layout-input/v1``) is validated client-side before it is
+    printed — a malformed answer exits nonzero rather than handing
+    layout planning a broken contract.
     """
     from ..observability import telemetry as telemetry_engine
 
-    body = _telemetry_request(base_url, window=window, view="export")
+    seconds = telemetry_engine.parse_window(window)
+    if seconds is None:
+        logger.error("--window %r is not a duration (try 90, 10m, 1h)",
+                     window)
+        sys.exit(1)
+    body = _telemetry_request(base_url, window=seconds, view="export")
     problems = telemetry_engine.validate_layout_input(body)
     if problems:
         for problem in problems:
@@ -1269,6 +1277,140 @@ def telemetry_export_cmd(base_url, window, output):
         click.echo(output)
     else:
         click.echo(rendered)
+
+
+@gordo.group("layout")
+def layout_group():
+    """The fleet layout compiler (ARCHITECTURE §27): measured-cost
+    placement plans computed from the telemetry warehouse's layout-input
+    document, replacing hand-set placement/residency/precision knobs.
+
+    ``plan`` compiles a versioned ``gordo-layout-plan/v1`` artifact from
+    a live ``/telemetry?view=export`` feed or a saved document;
+    ``explain`` renders the decisions and why each machine moved;
+    ``apply`` commits a plan into the fleet spec journal, where the
+    reconciler drives it onto the running fleet (and ``gordo fleet
+    rollback`` reverts it).
+    """
+
+
+def _read_plan_file(plan_file: str):
+    from ..layout import plan as layout_plan
+
+    with open(plan_file) as fh:
+        try:
+            plan = json.load(fh)
+        except ValueError as exc:
+            logger.error("%s is not JSON: %s", plan_file, exc)
+            sys.exit(1)
+    problems = layout_plan.validate_layout_plan(plan)
+    if problems:
+        for problem in problems:
+            logger.error("layout-plan validation: %s", problem)
+        sys.exit(1)
+    return plan
+
+
+@layout_group.command("plan")
+@click.option("--base-url", default=None,
+              help="router base URL to pull /telemetry?view=export from")
+@click.option("--input", "input_file", default=None,
+              type=click.Path(exists=True),
+              help="saved layout-input document instead of a live fleet")
+@click.option("--window", default="10m", show_default=True,
+              help="rate horizon: seconds or 1m/10m/1h forms")
+@click.option("--cap", type=int, default=None,
+              help="per-worker residency cap override")
+@click.option("--parity-budget", type=float, default=None,
+              help="traffic-weighted parity budget for precision "
+                   "downgrades (0 disables them)")
+@click.option("--output", "-o", default=None,
+              help="write the plan here instead of stdout")
+def layout_plan_cmd(base_url, input_file, window, cap, parity_budget,
+                    output):
+    """Compile a ``gordo-layout-plan/v1`` from measured costs.
+
+    Exactly one of ``--base-url`` (live export) or ``--input`` (saved
+    document) chooses the evidence. The plan is deterministic: the same
+    document compiles to the same bytes and the same fingerprint.
+    """
+    from ..layout import compiler as layout_compiler
+    from ..observability import telemetry as telemetry_engine
+
+    if (base_url is None) == (input_file is None):
+        logger.error("pass exactly one of --base-url or --input")
+        sys.exit(1)
+    if base_url is not None:
+        seconds = telemetry_engine.parse_window(window)
+        if seconds is None:
+            logger.error("--window %r is not a duration (try 90, 10m, 1h)",
+                         window)
+            sys.exit(1)
+        doc = _telemetry_request(base_url, window=seconds, view="export")
+    else:
+        with open(input_file) as fh:
+            try:
+                doc = json.load(fh)
+            except ValueError as exc:
+                logger.error("%s is not JSON: %s", input_file, exc)
+                sys.exit(1)
+    try:
+        plan = layout_compiler.compile_plan(
+            doc, residency_cap=cap, parity_budget=parity_budget,
+        )
+    except ValueError as exc:
+        logger.error("layout plan does not compile: %s", exc)
+        sys.exit(1)
+    rendered = json.dumps(plan, indent=2, sort_keys=True)
+    if output:
+        with open(output, "w") as fh:
+            fh.write(rendered + "\n")
+        click.echo(output)
+    else:
+        click.echo(rendered)
+
+
+@layout_group.command("explain")
+@click.argument("plan_file", required=False,
+                type=click.Path(exists=True))
+@click.option("--base-url", default=None,
+              help="read the committed spec's plan from a live router")
+def layout_explain_cmd(plan_file, base_url):
+    """Render a plan's decisions: cost before/after, per-worker weights
+    and resident sets, precision downgrades, and why each machine moved.
+    Reads PLAN_FILE, or with ``--base-url`` the plan committed in the
+    live fleet spec."""
+    from ..layout import plan as layout_plan
+
+    if (plan_file is None) == (base_url is None):
+        logger.error("pass exactly one of PLAN_FILE or --base-url")
+        sys.exit(1)
+    if plan_file is not None:
+        plan = _read_plan_file(plan_file)
+    else:
+        body = _fleet_request(base_url, "/fleet/diff")
+        plan = (body.get("spec") or {}).get("layout")
+        if plan is None:
+            logger.error("the committed fleet spec carries no layout plan")
+            sys.exit(1)
+    click.echo(layout_plan.explain_plan(plan))
+
+
+@layout_group.command("apply")
+@click.argument("plan_file", type=click.Path(exists=True))
+@click.option("--base-url", required=True, help="router base URL")
+def layout_apply_cmd(plan_file, base_url):
+    """Commit PLAN_FILE into the fleet spec journal: the current spec
+    is fetched, ``layout`` is replaced, and the merged spec lands as a
+    new revision via ``POST /fleet/apply`` — journaled, diffable, and
+    revertible with ``gordo fleet rollback``."""
+    plan = _read_plan_file(plan_file)
+    body = _fleet_request(base_url, "/fleet/diff")
+    spec = dict(body.get("spec") or {})
+    spec["layout"] = plan
+    reply = _fleet_request(base_url, "/fleet/apply", method="POST",
+                           payload=spec)
+    click.echo(json.dumps(reply, indent=2))
 
 
 @gordo.group("client")

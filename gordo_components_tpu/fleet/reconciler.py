@@ -61,9 +61,12 @@ RECONCILE_JOURNAL_FILE = "reconcile_journal.jsonl"
 #: divergence classes, in repair order: ownership first (bounds are
 #: metadata), then capacity (dead/missing workers), then disk truth
 #: (generation pointers, precision rungs), then adoption of disk truth,
-#: then layout
+#: then mesh layout, then the committed layout plan (§27 — last on
+#: purpose: ring weights and residency pins assume the fleet the
+#: earlier classes just repaired)
 CLASSES = (
     "bounds", "workers", "generation", "precision", "adoption", "mesh",
+    "layout",
 )
 
 _OSCILLATION_HOLD_COOLDOWNS = 4.0
@@ -136,6 +139,11 @@ class Observed:
     mesh_shards: Optional[int] = None
     elastic_busy: bool = False
     autopilot_bounds: Optional[Tuple[int, int]] = None
+    # §27: the ring's declared weight overrides (non-1.0 entries only)
+    # and each ready worker's /healthz-reported layout-plan fingerprint
+    # (None = the worker runs no plan)
+    placement_weights: Dict[str, float] = field(default_factory=dict)
+    worker_layouts: Dict[str, Optional[str]] = field(default_factory=dict)
 
 
 @dataclass
@@ -162,6 +170,20 @@ class RepairSeams:
     calibrate: Optional[Callable[[], Any]] = None
     default_worker_bounds: Optional[
         Callable[[], Optional[Tuple[int, int]]]
+    ] = None
+    # layout plan application (§27): install the plan's ring weights
+    # atomically ({} clears them); land one worker's slice of the plan
+    # (None = clear that worker back to LRU residency); and re-derive a
+    # committed plan against fresh telemetry (returns a NEW plan when
+    # the old one went stale, None while it stands)
+    set_placement_weights: Optional[
+        Callable[[Dict[str, float]], Any]
+    ] = None
+    apply_worker_layout: Optional[
+        Callable[[str, Optional[Dict[str, Any]]], Any]
+    ] = None
+    rederive_layout: Optional[
+        Callable[[Dict[str, Any]], Optional[Dict[str, Any]]]
     ] = None
 
 
@@ -216,15 +238,30 @@ def diff_spec(
                 "generation", machine, pinned, actual,
             ))
 
-    # precision: the artifact's built rung must match the declared one
-    for machine, entry in sorted(spec.machines.items()):
+    # precision: the artifact's built rung must match the declared one.
+    # Explicit spec pins first; the layout plan's chosen rungs fill the
+    # gaps (spec-vs-plan ownership boundary, §27: a machine the operator
+    # pinned is NEVER re-rung by a plan). Machines gone from the disk
+    # index are skipped — a stale plan degrades, it never wedges.
+    plan_precisions = (
+        (spec.layout or {}).get("precision") or {}
+    )
+    for machine in sorted(
+        set(spec.machines) | set(plan_precisions)
+    ):
+        entry = spec.machines.get(machine) or {}
         rung = entry.get("precision")
+        source = "spec"
+        if rung is None:
+            rung = plan_precisions.get(machine)
+            source = "layout"
         if rung is None:
             continue
         actual = observed.disk_precisions.get(machine)
         if actual is not None and actual != rung:
             divergences.append(Divergence(
                 "precision", machine, rung, actual,
+                {"source": source},
             ))
 
     # adoption: every ready worker must serve what disk CURRENT says
@@ -255,6 +292,47 @@ def diff_spec(
         divergences.append(Divergence(
             "mesh", "layout", spec.mesh_shards, observed.mesh_shards,
         ))
+
+    # layout (§27): the committed plan's ring weights and per-worker
+    # application fingerprints. Plan entries for workers that left the
+    # fleet are DROPPED from the desired state (degrade, never wedge);
+    # with no plan committed, lingering weights/fingerprints diverge
+    # toward empty — which is exactly how `gordo fleet rollback`
+    # converges a plan away.
+    plan = spec.layout
+    ready = set(observed.workers_ready)
+    if plan is not None:
+        desired_weights = {
+            worker: round(float(weight), 6)
+            for worker, weight in (plan.get("weights") or {}).items()
+            if worker in ready and float(weight) != 1.0
+        }
+    else:
+        desired_weights = {}
+    actual_weights = {
+        worker: round(float(weight), 6)
+        for worker, weight in observed.placement_weights.items()
+        if float(weight) != 1.0
+    }
+    if (plan is not None or actual_weights) and (
+        desired_weights != actual_weights
+    ):
+        divergences.append(Divergence(
+            "layout", "weights", desired_weights, actual_weights,
+        ))
+    fingerprint = plan.get("fingerprint") if plan is not None else None
+    for worker in sorted(ready):
+        actual_fp = observed.worker_layouts.get(worker)
+        if fingerprint is not None and actual_fp != fingerprint:
+            divergences.append(Divergence(
+                "layout", worker, fingerprint, actual_fp,
+                {"action": "apply"},
+            ))
+        elif fingerprint is None and actual_fp is not None:
+            divergences.append(Divergence(
+                "layout", worker, None, actual_fp,
+                {"action": "clear"},
+            ))
 
     order = {cls: index for index, cls in enumerate(CLASSES)}
     divergences.sort(key=lambda d: (order[d.cls], d.target))
@@ -426,6 +504,44 @@ class Reconciler:
                 self.seams.calibrate()
             except Exception:
                 logger.exception("Reconciler: capacity calibration failed")
+        # layout staleness (§27): a committed plan is re-judged against
+        # fresh telemetry each tick; when the seam returns a NEW plan
+        # (age or rate-distribution drift crossed the knobs), it is
+        # committed as a new revision — rollback-able like any other —
+        # and THIS tick reconciles toward the new plan immediately.
+        if (
+            spec.layout is not None
+            and self.seams.rederive_layout is not None
+            and _env_int("GORDO_LAYOUT_REDERIVE", 1)
+        ):
+            try:
+                fresh_plan = self.seams.rederive_layout(spec.layout)
+            except Exception:
+                logger.exception("Reconciler: layout re-derive failed")
+                fresh_plan = None
+            if fresh_plan is not None and fresh_plan.get(
+                "fingerprint"
+            ) != spec.layout.get("fingerprint"):
+                payload = spec.to_dict()
+                payload["layout"] = fresh_plan
+                try:
+                    new_spec = FleetSpec.parse(payload)
+                    record = self.spec_store.commit(
+                        new_spec, op="layout", parent=revision,
+                        reason="stale layout plan re-derived",
+                    )
+                except SpecError as exc:
+                    logger.error(
+                        "Reconciler: re-derived layout plan does not "
+                        "parse: %s", exc,
+                    )
+                else:
+                    revision, spec = record["revision"], new_spec
+                    logger.info(
+                        "Reconciler: layout plan re-derived -> revision "
+                        "%d (fingerprint %s)",
+                        revision, fresh_plan.get("fingerprint"),
+                    )
         try:
             observed = self._observe()
         except Exception:
@@ -580,6 +696,11 @@ class Reconciler:
             "precision": self.seams.rebuild is None,
             "adoption": self.seams.reload_worker is None,
             "mesh": self.seams.mesh_refresh is None,
+            "layout": (
+                self.seams.set_placement_weights is None
+                if target == "weights"
+                else self.seams.apply_worker_layout is None
+            ),
         }[cls]
         if seam_missing:
             return "unwired"
@@ -691,6 +812,15 @@ class Reconciler:
                     )
         elif cls == "mesh":
             self.seams.mesh_refresh()
+        elif cls == "layout":
+            if target == "weights":
+                self.seams.set_placement_weights(
+                    dict(divergence.desired or {})
+                )
+            elif divergence.detail.get("action") == "clear":
+                self.seams.apply_worker_layout(target, None)
+            else:
+                self.seams.apply_worker_layout(target, spec.layout)
         self._steps[key] = self._wal.append(
             key, cls, target, "applied", revision,
         )

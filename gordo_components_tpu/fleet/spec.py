@@ -50,7 +50,7 @@ GEN_TRACK_CURRENT = "current"
 _SPEC_KEYS = frozenset(
     {
         "machines", "workers", "mesh_shards", "canary_fraction",
-        "residency_cap", "slo", "tenants",
+        "residency_cap", "slo", "tenants", "layout",
     }
 )
 _MACHINE_KEYS = frozenset({"generation", "precision"})
@@ -98,6 +98,10 @@ class FleetSpec:
     residency_cap: Optional[int] = None
     slo: Dict[str, float] = field(default_factory=dict)
     tenants: Optional[str] = None
+    # the committed layout plan (gordo-layout-plan/v1, §27) — validated
+    # structurally at parse time; machines/workers that no longer exist
+    # are an application-time degrade, never a parse error
+    layout: Optional[Dict[str, Any]] = None
 
     @classmethod
     def parse(
@@ -202,6 +206,20 @@ class FleetSpec:
             except Exception as exc:
                 raise SpecError(f"tenants spec does not parse: {exc}")
 
+        layout = payload.get("layout")
+        if layout is not None:
+            # lazy import: plan.py is dependency-free, but going through
+            # the layout package would pull the compiler's imports into
+            # every spec parse
+            from ..layout.plan import validate_layout_plan
+
+            problems = validate_layout_plan(layout)
+            _require(not problems,
+                     "layout plan invalid: " + "; ".join(problems[:5]))
+            # canonical deep copy: the journal must not share mutable
+            # structure with whatever the caller keeps doing to payload
+            layout = json.loads(json.dumps(layout, sort_keys=True))
+
         return cls(
             machines=machines,
             workers=workers,
@@ -210,6 +228,7 @@ class FleetSpec:
             residency_cap=residency_cap,
             slo=slo,
             tenants=tenants,
+            layout=layout,
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -233,6 +252,8 @@ class FleetSpec:
             payload["slo"] = dict(sorted(self.slo.items()))
         if self.tenants is not None:
             payload["tenants"] = self.tenants
+        if self.layout is not None:
+            payload["layout"] = self.layout
         return payload
 
 

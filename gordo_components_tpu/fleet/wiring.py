@@ -93,6 +93,7 @@ def build_router_reconciler(
             if name not in dead and control.routable(name)
         ]
         worker_generations: Dict[str, Dict[str, str]] = {}
+        worker_layouts: Dict[str, Optional[str]] = {}
         for name in ready:
             spec = supervisor.specs[name]
             try:
@@ -107,6 +108,9 @@ def build_router_reconciler(
                 machine: gen for machine, gen in gens.items()
                 if isinstance(gen, str)
             }
+            # §27: the layout-plan fingerprint this worker applied
+            fp = body.get("layout")
+            worker_layouts[name] = fp if isinstance(fp, str) else None
         disk_generations, disk_precisions = scan_disk_state(models_root)
         bounds = None
         if pilot is not None:
@@ -127,6 +131,8 @@ def build_router_reconciler(
                 else False
             ),
             autopilot_bounds=bounds,
+            placement_weights=router.placement.worker_weights(),
+            worker_layouts=worker_layouts,
         )
 
     # the telemetry view is fetched once per tick (calibrate runs before
@@ -173,6 +179,75 @@ def build_router_reconciler(
             raise RuntimeError("router has no mesh layout to refresh")
         fn()
 
+    def apply_worker_layout(
+        worker: str, plan: Optional[Dict[str, Any]]
+    ) -> Dict[str, Any]:
+        """Land one worker's slice of the committed plan on its /layout
+        endpoint (§27) — or clear it (rollback's direction)."""
+        spec = supervisor.specs.get(worker)
+        if spec is None:
+            raise RuntimeError(f"worker {worker!r} left the slot table")
+        if plan is None:
+            payload: Dict[str, Any] = {"clear": True}
+        else:
+            residency = (plan.get("residency") or {})
+            entry = (residency.get("workers") or {}).get(worker) or {}
+            payload = {
+                "fingerprint": plan.get("fingerprint"),
+                "resident": list(entry.get("resident") or ()),
+                "cap": residency.get("cap"),
+                "prefetch": list(
+                    (plan.get("prefetch") or {}).get(worker) or ()
+                ),
+            }
+        reply = router._session.post(
+            f"{spec.base_url}/layout", json=payload,
+            timeout=router.scrape_timeout,
+        )
+        reply.raise_for_status()
+        return reply.json()
+
+    def rederive_layout(
+        plan: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        """Judge the committed plan against fresh telemetry; compile a
+        replacement when it went stale. None = plan stands (also on any
+        telemetry/compile trouble — a flaky scrape must never churn
+        committed plans)."""
+        from ..layout import compiler as layout_compiler
+
+        if not telemetry_engine.enabled():
+            return None
+        window = telemetry_engine.parse_window(
+            os.environ.get("GORDO_LAYOUT_HORIZON")
+        ) or 600.0
+        try:
+            merged, _ = router._aggregate_telemetry(window)
+            doc = telemetry_engine.build_export(merged, window=window)
+        except Exception:
+            logger.exception("Reconciler: layout telemetry fetch failed")
+            return None
+        reason = layout_compiler.staleness(plan, doc)
+        if reason is None:
+            return None
+        ready = [
+            name for name in sorted(supervisor.specs)
+            if supervisor.alive(name) and control.routable(name)
+        ]
+        cap = (plan.get("residency") or {}).get("cap")
+        try:
+            fresh = layout_compiler.compile_plan(
+                doc, workers=ready or None, residency_cap=cap,
+            )
+        except ValueError as exc:
+            logger.warning(
+                "Reconciler: stale layout plan (%s) but fresh telemetry "
+                "does not compile: %s", reason, exc,
+            )
+            return None
+        logger.info("Reconciler: layout plan stale (%s)", reason)
+        return fresh
+
     seams = RepairSeams(
         respawn=lambda name: supervisor.respawn(name, cause="reconcile"),
         scale=(
@@ -192,5 +267,8 @@ def build_router_reconciler(
         release_op=router.rollout.release_op,
         calibrate=calibrate,
         default_worker_bounds=default_worker_bounds,
+        set_placement_weights=router.placement.set_worker_weights,
+        apply_worker_layout=apply_worker_layout,
+        rederive_layout=rederive_layout,
     )
     return Reconciler(spec_store, observe, seams, clock=clock)

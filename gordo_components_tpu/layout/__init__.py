@@ -1,0 +1,35 @@
+"""Fleet layout compiler (ARCHITECTURE §27, ROADMAP item 5).
+
+Four placement axes — ring shard assignment, megabatch residency,
+precision rung, host-RAM spill prefetch — were each tuned by an
+independent fixed rule (pure name hash, 2-hit LRU promotion, hand-set
+precision maps, reactive spill loads). Automap and Mesh-TensorFlow
+(PAPERS.md) both argue layout should be ONE compiled, cost-model-driven
+decision; this package is that compiler for the serving tier:
+
+- :mod:`costmodel` scores candidate layouts on measured telemetry (the
+  ``gordo-layout-input/v1`` export): device-bytes-per-worker balance,
+  expected residency hit rate under the observed rate distribution, and
+  a traffic-weighted p99 proxy.
+- :mod:`compiler` emits the deterministic, versioned
+  ``gordo-layout-plan/v1`` artifact and checks a committed plan's
+  staleness against fresh telemetry.
+- :mod:`plan` is the dependency-free plan contract: validator,
+  canonical fingerprint, and the ``explain`` rendering that names why
+  each machine moved.
+
+The plan is DECLARED (a ``FleetSpec.layout`` field, journaled like
+every other spec change) and APPLIED by the reconciler through existing
+seams only — placement weight overrides, engine residency pins,
+precision rebuilds, ``/prefetch`` hints. Rollback is a new spec
+revision, exactly like any other fleet change.
+"""
+
+from .compiler import compile_plan, staleness  # noqa: F401
+from .costmodel import CostModel, machine_rates  # noqa: F401
+from .plan import (  # noqa: F401
+    PLAN_SCHEMA,
+    explain_plan,
+    plan_fingerprint,
+    validate_layout_plan,
+)

@@ -846,25 +846,72 @@ def sample_costs(engine: Any, compile_store: Any = None) -> Dict[str, Any]:
 
 # -- the layout-input export (ROADMAP item 5's input contract) ----------------
 
+def parse_window(value: Any) -> Optional[float]:
+    """Parse a ``?window=`` / ``--window`` horizon into seconds. Accepts
+    bare seconds (``"600"``, ``600``) and the warehouse horizon labels
+    (``"1m"``, ``"10m"``, ``"1h"`` — :data:`traffic.HORIZONS`, plus the
+    general ``<n>[s|m|h]`` suffix forms). Returns None on junk so
+    callers can fall back to their default instead of 500ing."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value) if value > 0 else None
+    text = str(value).strip().lower()
+    if not text:
+        return None
+    scale = 1.0
+    if text[-1] in ("s", "m", "h"):
+        scale = {"s": 1.0, "m": 60.0, "h": 3600.0}[text[-1]]
+        text = text[:-1]
+    try:
+        seconds = float(text) * scale
+    except ValueError:
+        return None
+    return seconds if seconds > 0 else None
+
+
+def resolve_horizon(window_s: Optional[float]) -> str:
+    """The warehouse EWMA horizon label closest (in log-space) to the
+    requested window — the layout compiler plans on this horizon's
+    rates. No window requested → the middle horizon (``10m``): long
+    enough to smooth burstiness, short enough to track a shifting
+    fleet."""
+    horizons = traffic_mod.HORIZONS
+    if window_s is None or window_s <= 0:
+        return horizons[min(1, len(horizons) - 1)][0]
+    import math
+
+    return min(
+        horizons,
+        key=lambda pair: abs(math.log(pair[1]) - math.log(window_s)),
+    )[0]
+
+
 def build_export(
     view: Dict[str, Any], window: Optional[float] = None
 ) -> Dict[str, Any]:
     """Render a ``/telemetry`` view (single worker or merged fleet) as
     the versioned layout-input document: machines × observed rate ×
-    bytes × latency per rung. This is a CONTRACT — bump
-    :data:`EXPORT_SCHEMA` on any shape change."""
+    bytes × latency per rung. ``window`` selects the representative
+    EWMA horizon (resolved to the nearest warehouse horizon and echoed
+    as ``horizon``; each machine additionally carries the resolved
+    scalar ``rate``). This is a CONTRACT — bump :data:`EXPORT_SCHEMA`
+    on any shape change (the horizon/rate fields were ADDITIVE, so v1
+    stands)."""
     traffic_view = view.get("traffic") or {}
     costs = view.get("costs") or {}
     engine_costs = costs.get("engine") or {}
     rung_costs = engine_costs.get("rungs") or {}
     window_view = view.get("window") or {}
 
+    horizon = resolve_horizon(window)
     machines = [
         {
             "machine": m["machine"],
             "count": m["count"],
             "error": m["error"],
             "rates": dict(m.get("rates") or {}),
+            "rate": float((m.get("rates") or {}).get(horizon) or 0.0),
         }
         for m in traffic_view.get("machines", ())
     ]
@@ -909,6 +956,7 @@ def build_export(
             window if window is not None
             else (window_view.get("window_s") or 0.0)
         ),
+        "horizon": horizon,
         "source": {
             "workers": list(workers),
             "interval_s": float(view.get("interval_s") or 0.0),
@@ -949,6 +997,10 @@ def validate_layout_input(doc: Any) -> List[str]:
     for key in ("generated_t", "window_s"):
         if not num(doc.get(key)):
             problems.append(f"{key}: missing or not a number")
+    if doc.get("horizon") is not None and not isinstance(
+        doc.get("horizon"), str
+    ):
+        problems.append("horizon: not a string")
     source = doc.get("source")
     if not isinstance(source, dict) or not isinstance(
         source.get("workers"), list
@@ -975,6 +1027,8 @@ def validate_layout_input(doc: Any) -> List[str]:
                 num(r) for r in rates.values()
             ):
                 problems.append(f"machines[{i}].rates: not a map of numbers")
+            if m.get("rate") is not None and not num(m.get("rate")):
+                problems.append(f"machines[{i}].rate: not a number")
     rungs = doc.get("rungs")
     if not isinstance(rungs, dict):
         problems.append("rungs: missing or not a map")

@@ -56,6 +56,14 @@ class HashRing:
     Not thread-safe by itself; :class:`Placement` wraps mutations in its
     own lock (ring membership changes are rare — worker eject/join — and
     lookups dominate).
+
+    **Weighted overrides** (the layout compiler's seam, §27): a declared
+    per-worker weight scales that worker's point count —
+    ``max(1, round(vnodes * weight))`` — so a measured-load plan can
+    shift ring share without forking the ring. Declared weights win over
+    the uniform vnode count; changing one worker's weight adds or
+    removes ONLY that worker's points, so key movement is bounded by the
+    resized arcs exactly as for a join/leave.
     """
 
     def __init__(self, workers: Iterable[str] = (), vnodes: int = 64):
@@ -65,6 +73,8 @@ class HashRing:
         self._points: List[int] = []
         self._owners: List[str] = []
         self._workers: set = set()
+        self._weights: Dict[str, float] = {}
+        self._point_counts: Dict[str, int] = {}
         # membership version: bumped on every add/remove so callers
         # (Placement) can cache membership-derived views — a join/leave
         # invalidates exactly once, lookups between them are cache hits
@@ -72,20 +82,24 @@ class HashRing:
         for worker in workers:
             self.add(worker)
 
-    def _worker_points(self, worker: str) -> List[int]:
-        return [_hash64(f"{worker}#{i}") for i in range(self.vnodes)]
+    # weight clamp: a zero/negative weight would unmap the worker
+    # entirely (routing around a live worker is membership's job, not a
+    # weight's), and an unbounded one would swamp the ring
+    WEIGHT_MIN = 0.1
+    WEIGHT_MAX = 8.0
 
-    def add(self, worker: str) -> None:
-        """Incremental join (§22): ONE sorted merge of the worker's
-        ``vnodes`` points into the arrays — O(P + v), not the O(v·P) of
-        v independent ``list.insert`` memmoves. Only the joining
-        worker's arcs change ownership; incumbent points are untouched
-        (the bounded-movement property is structural)."""
-        if worker in self._workers:
-            return
-        self._workers.add(worker)
-        self.version += 1
-        incoming = sorted(self._worker_points(worker))
+    def _target_count(self, worker: str) -> int:
+        weight = self._weights.get(worker, 1.0)
+        return max(1, int(round(self.vnodes * weight)))
+
+    def _worker_points(self, worker: str, count: Optional[int] = None) -> List[int]:
+        n = self._target_count(worker) if count is None else count
+        return [_hash64(f"{worker}#{i}") for i in range(n)]
+
+    def _merge_points(self, worker: str, incoming: List[int]) -> None:
+        """ONE sorted merge of ``incoming`` (sorted) into the arrays —
+        O(P + v), not the O(v·P) of v independent ``list.insert``
+        memmoves."""
         merged_points: List[int] = []
         merged_owners: List[str] = []
         i = j = 0
@@ -105,6 +119,65 @@ class HashRing:
         self._points = merged_points
         self._owners = merged_owners
 
+    def add(self, worker: str) -> None:
+        """Incremental join (§22): one sorted merge of the worker's
+        points into the arrays. Only the joining worker's arcs change
+        ownership; incumbent points are untouched (the bounded-movement
+        property is structural). A weight declared before the join is
+        honored here."""
+        if worker in self._workers:
+            return
+        self._workers.add(worker)
+        self.version += 1
+        count = self._target_count(worker)
+        self._point_counts[worker] = count
+        self._merge_points(worker, sorted(self._worker_points(worker, count)))
+
+    def set_weight(self, worker: str, weight: float) -> bool:
+        """Declare ``worker``'s ring weight (1.0 = the uniform default).
+        Declared weights win over the vnode count: the worker's point
+        set becomes ``worker#0..worker#k-1`` for ``k = max(1,
+        round(vnodes * weight))``. Because point names are stable, a
+        resize touches ONLY the delta range ``worker#min(old,new)..`` —
+        grow merges those points in, shrink filters exactly them out —
+        so key movement is bounded by the resized arcs (the same
+        structural guarantee as join/leave; proven in
+        tests/test_placement.py). Returns True when the ring changed."""
+        weight = min(self.WEIGHT_MAX, max(self.WEIGHT_MIN, float(weight)))
+        if weight == 1.0:
+            self._weights.pop(worker, None)
+        else:
+            self._weights[worker] = weight
+        if worker not in self._workers:
+            return False
+        old_count = self._point_counts.get(worker, self.vnodes)
+        new_count = self._target_count(worker)
+        if new_count == old_count:
+            return False
+        self.version += 1
+        self._point_counts[worker] = new_count
+        if new_count > old_count:
+            grown = sorted(
+                _hash64(f"{worker}#{i}") for i in range(old_count, new_count)
+            )
+            self._merge_points(worker, grown)
+        else:
+            shed = {
+                _hash64(f"{worker}#{i}") for i in range(new_count, old_count)
+            }
+            keep = [
+                (point, owner)
+                for point, owner in zip(self._points, self._owners)
+                if not (owner == worker and point in shed)
+            ]
+            self._points = [point for point, _ in keep]
+            self._owners = [owner for _, owner in keep]
+        return True
+
+    def weights(self) -> Dict[str, float]:
+        """Non-default declared weights (1.0 entries are elided)."""
+        return dict(self._weights)
+
     def remove(self, worker: str) -> None:
         """Incremental leave: one filtering pass dropping ONLY the
         departed worker's points — its arcs fall to their clockwise
@@ -112,6 +185,9 @@ class HashRing:
         if worker not in self._workers:
             return
         self._workers.discard(worker)
+        self._point_counts.pop(worker, None)
+        # the declared weight is sticky across leave/rejoin: a respawned
+        # worker slot re-enters the ring at its planned share
         self.version += 1
         keep = [
             (point, owner)
@@ -255,6 +331,30 @@ class Placement:
     def workers(self) -> List[str]:
         with self._lock:
             return self.ring.workers()
+
+    # -- layout weights (§27) ------------------------------------------------
+    def set_worker_weights(self, weights: Dict[str, float]) -> bool:
+        """Install the layout plan's per-worker ring weights atomically.
+        Workers absent from ``weights`` revert to the uniform 1.0
+        default (so clearing a plan is ``set_worker_weights({})``).
+        Returns True when any worker's point set changed."""
+        changed = False
+        with self._lock:
+            lockcheck.assert_guard("router.placement")
+            desired = {
+                worker: float(weight)
+                for worker, weight in (weights or {}).items()
+            }
+            for worker in list(self.ring.weights()):
+                if worker not in desired:
+                    changed |= self.ring.set_weight(worker, 1.0)
+            for worker, weight in desired.items():
+                changed |= self.ring.set_weight(worker, weight)
+        return changed
+
+    def worker_weights(self) -> Dict[str, float]:
+        with self._lock:
+            return self.ring.weights()
 
     # -- mesh shards (§23) ---------------------------------------------------
     def set_mesh(
@@ -439,6 +539,8 @@ class Placement:
                 "replicas": self.replicas,
                 "hot_rps": self.hot_rps,
                 "hot_machines": sorted(self._hot),
+                # §27: declared layout weights (empty = uniform ring)
+                "weights": dict(sorted(self.ring.weights().items())),
                 # §23: worker → mesh shard (empty = mesh serving off)
                 "worker_shards": dict(sorted(self._worker_shards.items())),
             }

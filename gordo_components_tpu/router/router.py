@@ -341,7 +341,11 @@ class FleetRouter:
         if endpoint == "telemetry":
             if not telemetry_engine.enabled():
                 return _json({"enabled": False})
-            window = request.args.get("window", default=300.0, type=float)
+            # horizon forms accepted alongside bare seconds: ?window=1m
+            # /10m/1h select the matching warehouse EWMA horizon (§27)
+            window = telemetry_engine.parse_window(
+                request.args.get("window")
+            ) or 300.0
             merged, errors = self._aggregate_telemetry(window)
             if request.args.get("view") == "export":
                 payload: Dict[str, Any] = telemetry_engine.build_export(

@@ -922,6 +922,12 @@ class _Bucket:
         self._mega_hits: Dict[int, int] = {}
         self._mega_last_use: Dict[int, int] = {}
         self._mega_demotions: Dict[int, int] = {}
+        # layout plan residency pins (§27): idxs the committed plan
+        # declares resident. Pins steer the EXISTING promotion path —
+        # seeded hit counters promote a pinned machine on its next
+        # successful cold dispatch, and LRU eviction skips pinned
+        # victims — so a pin never does stack surgery of its own.
+        self._mega_pinned: set = set()
         # bounded fill window (seconds); only engages under megabatching —
         # shard mode's fallback keeps today's no-added-wait drain
         self._fill_s = max(0.0, fill_window_s) if self._mega_enabled else 0.0
@@ -2182,11 +2188,25 @@ class _Bucket:
                     )
                     continue
                 if not self._mega_free:
-                    victim = next(iter(self._mega_slots))
+                    # LRU victim, skipping plan-pinned residents (§27):
+                    # an unpinned promotion may never evict a machine
+                    # the committed layout declared resident
+                    victim = next(
+                        (
+                            v for v in self._mega_slots
+                            if v not in self._mega_pinned
+                        ),
+                        None,
+                    )
+                    if victim is None:
+                        continue  # every slot is pinned — stay cold
                     age = self.dispatch_count - self._mega_last_use.get(
                         victim, 0
                     )
-                    if age < self._hot_evict_window():
+                    if (
+                        age < self._hot_evict_window()
+                        and idx not in self._mega_pinned
+                    ):
                         continue  # working set is live — don't thrash it
                     freed = self._mega_slots.pop(victim)
                     if freed < self._mega_cap:  # resize guard, see demote
@@ -2312,6 +2332,50 @@ class _Bucket:
         _M_MEGA_EVENTS.labels("residency_resize").inc()
         spans.event("megabatch_residency", action="resize", cap=cap)
         return cap
+
+    def pin_mega(self, idxs: Iterable[int]) -> Dict[str, int]:
+        """Install the layout plan's resident-set pins for this bucket
+        (§27), REPLACING any previous pin set (pass ``()`` to clear).
+
+        Pins do not touch the stack: each newly-pinned non-resident
+        machine gets its hit counter seeded to one below the promotion
+        threshold, so its next successful cold dispatch promotes it
+        through the normal ``_maybe_promote_mega`` path (one rebuilt
+        resident stack, same program identity — zero fresh XLA compiles
+        while the cap is unchanged). Eviction skips pinned victims, so
+        once resident a pinned machine stays until demoted by its own
+        fused failures (failure demotion OUTRANKS the pin: a machine
+        that cannot serve fused must not be forced back immediately —
+        it re-earns the slot through backoff like any other, but with
+        the seeded counter it needs only the backoff threshold, not
+        extra organic hits). Full-residency buckets are a no-op beyond
+        recording the set (everything is already resident)."""
+        valid = {
+            int(idx) for idx in idxs if 0 <= int(idx) < len(self.names)
+        }
+        seeded = 0
+        with self._mega_lock:
+            lockcheck.assert_guard("engine.mega")
+            self._mega_pinned = valid
+            if not self._mega_enabled or self._mega_full:
+                resident = len(valid)
+            else:
+                resident = 0
+                for idx in sorted(valid):
+                    if idx in self._mega_slots:
+                        resident += 1
+                        continue
+                    threshold = 2 * (
+                        8 ** self._mega_demotions.get(idx, 0)
+                    )
+                    if self._mega_hits.get(idx, 0) < threshold - 1:
+                        self._mega_hits[idx] = threshold - 1
+                        seeded += 1
+        return {
+            "pinned": len(valid),
+            "resident": resident,
+            "seeded": seeded,
+        }
 
     @staticmethod
     def _pay_down_demotions(demotions: Dict[int, int], idx: int) -> None:
@@ -2722,6 +2786,36 @@ class ServingEngine:
             else:
                 applied["megabatch_residency"] = None
         return applied
+
+    def pin_residency(self, names: Iterable[str]) -> Dict[str, Any]:
+        """Install the layout plan's resident set engine-wide (§27):
+        each name maps to its bucket and the bucket's pins are REPLACED
+        (a bucket with no planned names gets its pins cleared, so
+        re-applying a plan is idempotent and clearing is
+        ``pin_residency(())``). Names the engine doesn't serve eagerly
+        (lazy spill-tier machines, typos, machines gone from the store)
+        are reported, never fatal — the plan degrades."""
+        per_bucket: Dict[int, List[int]] = {}
+        unknown: List[str] = []
+        for name in names:
+            entry = self._by_name.get(name)
+            if entry is None:
+                unknown.append(name)
+                continue
+            bucket, idx = entry
+            per_bucket.setdefault(id(bucket), []).append(idx)
+        pinned = resident = seeded = 0
+        for bucket in self._buckets:
+            result = bucket.pin_mega(per_bucket.get(id(bucket), ()))
+            pinned += result["pinned"]
+            resident += result["resident"]
+            seeded += result["seeded"]
+        return {
+            "pinned": pinned,
+            "resident": resident,
+            "seeded": seeded,
+            "unknown": sorted(unknown),
+        }
 
     def can_score(self, name: str) -> bool:
         return name in self._by_name or name in self._lazy

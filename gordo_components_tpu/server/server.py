@@ -109,6 +109,10 @@ _URL_MAP = Map(
         # host-RAM spill tier placement hint (§22): POST {"machines":
         # [...]} queues async host-cache loads for lazy machines
         Rule("/prefetch", endpoint="prefetch"),
+        # layout plan application (§27): POST pins the committed plan's
+        # residency set / cap / prefetch hints and records the plan
+        # fingerprint this worker runs; GET reports it
+        Rule("/layout", endpoint="layout"),
         Rule("/slo", endpoint="slo"),
         # fleet telemetry warehouse (§24): windowed rates / percentiles
         # from the durable history, traffic top-K, measured-cost ledger;
@@ -665,6 +669,11 @@ class ModelServer:
         # Last-applied values survive reload generation swaps via
         # self._tuning.
         self._tuning: Dict[str, int] = {}
+        # layout plan state (§27): the fingerprint + residency pins +
+        # prefetch hints last applied via /layout. Survives reload swaps
+        # the same way self._tuning does — a fresh generation re-pins
+        # from here instead of reverting to pure LRU residency.
+        self._layout: Dict[str, Any] = {}
         self.autopilot = build_server_autopilot(self)
         # fleet telemetry warehouse (§24): durable counter/gauge/histogram
         # history + traffic sketch + measured-cost ledger, snapshotted on
@@ -954,6 +963,14 @@ class ModelServer:
                 }
                 if engine_tuning:
                     new_state.engine.apply_tuning(**engine_tuning)
+                # the applied layout plan survives the swap too (§27):
+                # re-pin the declared resident set on the new engine —
+                # machines gone from the new scan are reported by
+                # pin_residency and simply skipped (plan degrades)
+                if self._layout.get("resident"):
+                    new_state.engine.pin_residency(
+                        self._layout["resident"]
+                    )
                 self._state = new_state
                 # drain the OLD generation before returning: dropped
                 # machines' device-resident params must not be released
@@ -1526,6 +1543,10 @@ class ModelServer:
                     ),
                     "quarantined": quarantined,
                     "suspect": suspects,
+                    # §27: the layout-plan fingerprint this worker has
+                    # applied (null = no plan) — the reconciler's
+                    # convergence signal for the layout class
+                    "layout": self._layout.get("fingerprint"),
                     # artifact-integrity facet: every served machine passed
                     # manifest verification at load; dirs that DIDN'T are
                     # exactly the load-quarantined set above. generations
@@ -1569,7 +1590,11 @@ class ModelServer:
             # a telemetry read is also a snapshot tick (scrape-driven,
             # like /slo) — min-interval-gated inside maybe_tick
             self.telemetry.maybe_tick()
-            window = request.args.get("window", default=300.0, type=float)
+            # horizon forms accepted alongside bare seconds: ?window=1m
+            # /10m/1h select the matching warehouse EWMA horizon (§27)
+            window = telemetry_engine.parse_window(
+                request.args.get("window")
+            ) or 300.0
             view = self.telemetry.view(window=window)
             if request.args.get("view") == "export":
                 return _json(
@@ -1666,6 +1691,55 @@ class ModelServer:
             if not isinstance(names, list):
                 _abort(400, 'Payload must contain "machines": [...]')
             return _json(state.engine.prefetch([str(n) for n in names]))
+        if endpoint == "layout":
+            # layout plan application seam (§27): the reconciler (or an
+            # operator curl) lands this worker's slice of the committed
+            # plan here — residency pins + optional cap + spill prefetch
+            # hints — and the fingerprint recorded is what /healthz
+            # reports back for convergence checks. POST {"clear": true}
+            # reverts to pure LRU residency (rollback's direction).
+            if request.method != "POST":
+                return _json({
+                    "fingerprint": self._layout.get("fingerprint"),
+                    "resident": list(self._layout.get("resident") or ()),
+                    "cap": self._layout.get("cap"),
+                    "applied": self._layout.get("applied"),
+                })
+            try:
+                payload = json.loads(request.get_data(as_text=True) or "{}")
+            except json.JSONDecodeError:
+                _abort(400, "Request body is not valid JSON")
+            if payload.get("clear"):
+                cleared = state.engine.pin_residency(())
+                self._layout = {}
+                return _json({"cleared": True, "residency": cleared})
+            fingerprint = payload.get("fingerprint")
+            if not isinstance(fingerprint, str) or not fingerprint:
+                _abort(400, 'Payload must carry the plan "fingerprint"')
+            resident = payload.get("resident") or []
+            if not isinstance(resident, list):
+                _abort(400, '"resident" must be a list of machine names')
+            resident = [str(name) for name in resident]
+            applied: Dict[str, Any] = {
+                "residency": state.engine.pin_residency(resident),
+            }
+            cap = payload.get("cap")
+            if cap is not None:
+                applied["tuning"] = self.apply_tuning(
+                    megabatch_residency=int(cap)
+                )
+            hints = payload.get("prefetch") or []
+            if isinstance(hints, list) and hints:
+                applied["prefetch"] = state.engine.prefetch(
+                    [str(name) for name in hints]
+                )
+            self._layout = {
+                "fingerprint": fingerprint,
+                "resident": resident,
+                "cap": int(cap) if cap is not None else None,
+                "applied": applied,
+            }
+            return _json({"fingerprint": fingerprint, "applied": applied})
         if endpoint == "reload":
             if request.method != "POST":
                 _abort(405, "POST required")

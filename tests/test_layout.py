@@ -1,0 +1,505 @@
+"""Fleet layout compiler (ARCHITECTURE §27): the input/plan contract
+round-trip, deterministic compilation, the cost model's skew math, the
+staleness triggers, spec-journal integration, and the reconciler's
+layout divergence class — all on synthetic documents, zero servers.
+"""
+
+import json
+
+import pytest
+
+from gordo_components_tpu.fleet.reconciler import (
+    Observed,
+    Reconciler,
+    RepairSeams,
+    diff_spec,
+)
+from gordo_components_tpu.fleet.spec import FleetSpec, SpecError, SpecStore
+from gordo_components_tpu.layout import (
+    CostModel,
+    PLAN_SCHEMA,
+    compile_plan,
+    explain_plan,
+    plan_fingerprint,
+    staleness,
+    validate_layout_plan,
+)
+from gordo_components_tpu.observability import telemetry as telemetry_engine
+
+
+def _doc(rates=None, workers=("w0", "w1"), generated_t=1000.0,
+         device_bytes=1 << 30, machine_count=None):
+    """A synthetic ``gordo-layout-input/v1`` document: Zipf-by-default
+    machine rates, one f32 rung carrying the byte ledger."""
+    if rates is None:
+        rates = {f"m-{i:03d}": 100.0 / (i + 1) for i in range(20)}
+    total = sum(rates.values())
+    return {
+        "schema": "gordo-layout-input/v1",
+        "generated_t": generated_t,
+        "window_s": 600.0,
+        "horizon": "10m",
+        "source": {
+            "workers": list(workers),
+            "interval_s": 15.0,
+            "coverage_s": 600.0,
+            "sketch_capacity": 512,
+        },
+        "machines": [
+            {
+                "machine": machine,
+                "count": rate * 600.0,
+                "error": 0.0,
+                "rates": {"10m": rate},
+                "rate": rate,
+            }
+            for machine, rate in sorted(rates.items())
+        ],
+        "rungs": {
+            "f32": {
+                "machines": machine_count or len(rates),
+                "buckets": 4,
+                "device_bytes": device_bytes,
+                "requests": total * 600.0,
+                "count": total * 600.0,
+                "rates": {"10m": total},
+                "dispatch_seconds_total": total * 600.0 * 0.02,
+                "latency_s": 0.02,
+                "compile_seconds": 12.0,
+            },
+        },
+        "tiers": {"host_cache": {}, "spill": {}},
+        "totals": {
+            "count": total * 600.0,
+            "rates": {"10m": total},
+            "machines_tracked": len(rates),
+        },
+    }
+
+
+# -- the plan contract --------------------------------------------------------
+
+def test_compile_is_deterministic():
+    """Same evidence -> byte-identical plan, same fingerprint (the plan
+    is an auditable artifact, not a sample)."""
+    a = compile_plan(_doc())
+    b = compile_plan(_doc())
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a["fingerprint"] == b["fingerprint"]
+    assert a["schema"] == PLAN_SCHEMA
+
+
+def test_plan_validator_roundtrip_and_tamper():
+    plan = compile_plan(_doc())
+    assert validate_layout_plan(plan) == []
+    # fingerprint covers the DECISION fields: editing one is caught ...
+    tampered = json.loads(json.dumps(plan))
+    tampered["weights"] = {"w0": 3.0}
+    assert any(
+        "fingerprint" in problem for problem in validate_layout_plan(tampered)
+    )
+    # ... while provenance edits keep the identity (projections are not
+    # decisions)
+    relabeled = json.loads(json.dumps(plan))
+    relabeled["cost"] = {}
+    assert plan_fingerprint(relabeled) == plan["fingerprint"]
+
+
+def test_plan_validator_is_structural_and_loud():
+    assert validate_layout_plan(["not", "a", "plan"]) == [
+        "plan is not an object"
+    ]
+    problems = validate_layout_plan({
+        "schema": "gordo-layout-plan/v2",
+        "fingerprint": "",
+        "generated_t": "yesterday",
+        "workers": [1, 2],
+        "weights": {"w0": -1},
+        "residency": {"cap": -5, "workers": {"w0": {"resident": "m-1"}}},
+        "precision": {"m-1": "fp64"},
+        "prefetch": {"w0": [3]},
+    })
+    for fragment in ("schema", "fingerprint", "generated_t", "workers",
+                     "weights[w0]", "residency.cap", "resident",
+                     "precision[m-1]", "prefetch[w0]"):
+        assert any(fragment in problem for problem in problems), fragment
+
+
+def test_compile_rejects_malformed_and_drifted_input():
+    with pytest.raises(ValueError, match="invalid"):
+        compile_plan({"schema": "gordo-layout-input/v1"})
+    drifted = _doc()
+    drifted["schema"] = "gordo-layout-input/v2"
+    with pytest.raises(ValueError, match="schema"):
+        compile_plan(drifted)
+    with pytest.raises(ValueError, match="no workers"):
+        compile_plan(_doc(workers=()))
+
+
+def test_compile_empty_fleet_degrades():
+    """A document with workers but no measured machines compiles to an
+    inert plan (degrade, never wedge): no weights, no pins, no moves."""
+    plan = compile_plan(_doc(rates={}))
+    assert validate_layout_plan(plan) == []
+    assert plan["weights"] == {} and plan["moves"] == []
+    assert all(
+        entry["resident"] == []
+        for entry in plan["residency"]["workers"].values()
+    )
+
+
+# -- the cost model on skew ---------------------------------------------------
+
+def test_plan_beats_name_hash_on_skewed_fleet():
+    """The tentpole claim in miniature: under Zipf skew the computed
+    weights reduce load imbalance and the expected-hit-rate residency
+    never loses to rate-blind pinning."""
+    plan = compile_plan(_doc(), residency_cap=4)
+    baseline = plan["cost"]["baseline"]
+    projected = plan["cost"]["plan"]
+    assert projected["imbalance"] <= baseline["imbalance"]
+    assert (
+        projected["expected_hit_rate"] >= baseline["expected_hit_rate"]
+    )
+    # weights quantized to 1/32 and clamped inside the compiler rail
+    for weight in plan["weights"].values():
+        assert 0.25 <= weight <= 4.0
+        assert abs(weight * 32 - round(weight * 32)) < 1e-9
+    # every move names its evidence
+    for move in plan["moves"]:
+        assert move["from"] and move["to"] and move["reason"]
+
+
+def test_residency_ranks_by_rate_and_skips_cold():
+    rates = {"hot": 50.0, "warm": 5.0, "cold": 0.0}
+    plan = compile_plan(_doc(rates=rates), residency_cap=2)
+    resident = set()
+    for entry in plan["residency"]["workers"].values():
+        resident.update(entry["resident"])
+    assert "cold" not in resident  # zero-rate never squats a slot
+    assert "hot" in resident
+    assert plan["residency"]["cap"] == 2
+
+
+def test_precision_spends_budget_ascending_by_rate():
+    rates = {f"m-{i}": float(i + 1) for i in range(10)}
+    plan = compile_plan(
+        _doc(rates=rates), parity_budget=0.02,
+        spec_precisions={"m-0": "f32"},
+    )
+    chosen = plan["precision"]
+    assert chosen  # a real budget buys real downgrades
+    assert "m-0" not in chosen  # the spec pin always wins
+    # the coldest unpinned machines downgrade first
+    assert "m-1" in chosen
+    hottest = max(chosen, key=lambda m: rates[m])
+    assert rates[hottest] < max(rates.values())
+    # zero budget, zero downgrades
+    assert compile_plan(_doc(rates=rates))["precision"] == {}
+
+
+def test_cost_model_machines_per_gib_projects_downgrades():
+    doc = _doc(device_bytes=1 << 30, machine_count=10)
+    model = CostModel(doc)
+    machines = sorted(m["machine"] for m in doc["machines"])
+    workers = ["w0", "w1"]
+    assignment = {m: workers[i % 2] for i, m in enumerate(machines)}
+    resident = {w: [] for w in workers}
+    _, plain = model.score(assignment, workers, resident)
+    _, quantized = model.score(
+        assignment, workers, resident,
+        {m: "int8" for m in machines},
+    )
+    assert quantized["machines_per_gib"] > plain["machines_per_gib"]
+
+
+# -- staleness ----------------------------------------------------------------
+
+def test_staleness_age_and_drift_triggers():
+    plan = compile_plan(_doc(generated_t=1000.0))
+    fresh = _doc(generated_t=1100.0)
+    assert staleness(plan, fresh, max_age_s=900.0) is None
+    aged = _doc(generated_t=2000.0)
+    assert "old" in staleness(plan, aged, max_age_s=900.0)
+    # same age, but the traffic mass moved machines entirely
+    moved = _doc(
+        rates={f"x-{i:03d}": 100.0 / (i + 1) for i in range(20)},
+        generated_t=1100.0,
+    )
+    assert "drifted" in staleness(plan, moved, drift_limit=0.35)
+
+
+def test_staleness_tolerates_malformed_fresh_doc():
+    """A flaky scrape must never churn a committed plan: junk fresh
+    telemetry degrades to 'no signal', not a re-derive."""
+    plan = compile_plan(_doc(generated_t=1000.0))
+    assert staleness(plan, {"machines": "garbage"}, max_age_s=900.0) is None
+
+
+def test_explain_names_the_decisions():
+    plan = compile_plan(_doc(), residency_cap=4)
+    rendered = explain_plan(plan)
+    assert plan["fingerprint"] in rendered
+    assert "ring weights" in rendered
+    assert "resident" in rendered
+
+
+# -- spec-journal integration -------------------------------------------------
+
+def test_spec_carries_and_roundtrips_a_plan(tmp_path):
+    plan = compile_plan(_doc())
+    spec = FleetSpec.parse({"layout": plan})
+    assert FleetSpec.parse(spec.to_dict()) == spec
+    store = SpecStore(str(tmp_path))
+    store.commit(spec)
+    _, loaded = store.current_spec()
+    assert loaded.layout["fingerprint"] == plan["fingerprint"]
+    # rollback reverts the plan like any other declaration
+    store.commit(FleetSpec.parse({}))
+    record = store.rollback()
+    assert record["spec"]["layout"]["fingerprint"] == plan["fingerprint"]
+
+
+def test_spec_rejects_tampered_plan():
+    plan = compile_plan(_doc())
+    plan["weights"] = {"w0": 2.0}  # decision edited after emission
+    with pytest.raises(SpecError, match="fingerprint"):
+        FleetSpec.parse({"layout": plan})
+    with pytest.raises(SpecError, match="layout"):
+        FleetSpec.parse({"layout": ["not", "a", "plan"]})
+
+
+# -- the reconciler's layout class --------------------------------------------
+
+def _observed(**kwargs):
+    base = dict(
+        workers_total=2,
+        workers_ready=["w0", "w1"],
+        workers_dead=[],
+        worker_generations={},
+        disk_generations={},
+        disk_precisions={},
+        mesh_shards=None,
+        elastic_busy=False,
+        autopilot_bounds=None,
+    )
+    base.update(kwargs)
+    return Observed(**base)
+
+
+def _spec_with_plan(**compile_kwargs):
+    plan = compile_plan(_doc(), **compile_kwargs)
+    return FleetSpec.parse({"layout": plan}), plan
+
+
+def test_diff_layout_weights_and_fingerprints():
+    spec, plan = _spec_with_plan()
+    fp = plan["fingerprint"]
+    divergences = diff_spec(spec, _observed())
+    classes = {(d.cls, d.target) for d in divergences}
+    assert ("layout", "w0") in classes and ("layout", "w1") in classes
+    if plan["weights"]:
+        assert ("layout", "weights") in classes
+    # a worker already running the plan stops diverging
+    converged = diff_spec(spec, _observed(
+        placement_weights=dict(plan["weights"]),
+        worker_layouts={"w0": fp, "w1": fp},
+    ))
+    assert [d for d in converged if d.cls == "layout"] == []
+
+
+def test_diff_layout_drops_workers_gone_from_fleet():
+    """Plan entries for departed workers degrade to skips — a stale
+    plan never wedges the diff or targets a ghost."""
+    spec, plan = _spec_with_plan()
+    divergences = diff_spec(spec, _observed(
+        workers_total=1, workers_ready=["w0"],
+    ))
+    layout = [d for d in divergences if d.cls == "layout"]
+    assert all(d.target in ("weights", "w0") for d in layout)
+    for d in layout:
+        if d.target == "weights":
+            assert set(d.desired) <= {"w0"}
+
+
+def test_diff_no_plan_converges_leftovers_to_empty():
+    """`gordo fleet rollback` off a plan: lingering weights and worker
+    fingerprints diverge toward cleared, not toward nothing-happens."""
+    spec = FleetSpec.parse({})
+    divergences = diff_spec(spec, _observed(
+        placement_weights={"w0": 2.0},
+        worker_layouts={"w0": "deadbeef00000000", "w1": None},
+    ))
+    by_target = {d.target: d for d in divergences if d.cls == "layout"}
+    assert by_target["weights"].desired == {}
+    assert by_target["w0"].detail == {"action": "clear"}
+    assert "w1" not in by_target
+
+
+def test_diff_spec_precision_pin_beats_plan_rung():
+    plan = compile_plan(
+        _doc(rates={"m-a": 1.0, "m-b": 2.0}), parity_budget=0.05,
+    )
+    assert "m-a" in plan["precision"]  # the plan wants a downgrade
+    spec = FleetSpec.parse({
+        "layout": plan, "machines": {"m-a": {"precision": "f32"}},
+    })
+    divergences = diff_spec(spec, _observed(
+        disk_precisions={"m-a": "bf16", "m-b": "f32"},
+        worker_layouts={
+            "w0": plan["fingerprint"], "w1": plan["fingerprint"],
+        },
+        placement_weights=dict(plan["weights"]),
+    ))
+    precision = {d.target: d for d in divergences if d.cls == "precision"}
+    # the spec pin drives m-a back UP to f32 despite the plan's rung
+    assert precision["m-a"].desired == "f32"
+    assert precision["m-a"].detail == {"source": "spec"}
+    # plan rungs fill the gaps for unpinned machines, tagged as such
+    if "m-b" in plan["precision"]:
+        assert precision["m-b"].detail == {"source": "layout"}
+    # machines gone from the disk index are skipped, never divergent
+    gone = diff_spec(spec, _observed(
+        disk_precisions={},
+        worker_layouts={
+            "w0": plan["fingerprint"], "w1": plan["fingerprint"],
+        },
+        placement_weights=dict(plan["weights"]),
+    ))
+    assert [d for d in gone if d.cls == "precision"] == []
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _layout_seams(calls):
+    def record(name):
+        def seam(*args):
+            calls.append((name, args))
+            return None
+        return seam
+
+    return RepairSeams(
+        set_placement_weights=record("set_placement_weights"),
+        apply_worker_layout=record("apply_worker_layout"),
+    )
+
+
+def test_reconciler_applies_and_clears_layout(tmp_path):
+    spec, plan = _spec_with_plan()
+    clock = _Clock()
+    store = SpecStore(str(tmp_path), clock=clock)
+    store.commit(spec)
+    calls = []
+    holder = {"observed": _observed()}
+    rec = Reconciler(
+        store, lambda: holder["observed"], _layout_seams(calls),
+        clock=clock, min_interval=0.0, cooldown=0.0, repair_budget=10,
+    )
+    rec.tick()
+    names = [name for name, _ in calls]
+    if plan["weights"]:
+        assert "set_placement_weights" in names
+    applied = [
+        args for name, args in calls if name == "apply_worker_layout"
+    ]
+    assert {worker for worker, _ in applied} == {"w0", "w1"}
+    assert all(
+        payload["fingerprint"] == plan["fingerprint"]
+        for _, payload in applied
+    )
+
+    # converged fleet, then rollback to the empty spec: the same seams
+    # fire in the clear direction
+    store.commit(FleetSpec.parse({}))
+    calls.clear()
+    holder["observed"] = _observed(
+        placement_weights=dict(plan["weights"]),
+        worker_layouts={
+            "w0": plan["fingerprint"], "w1": plan["fingerprint"],
+        },
+    )
+    rec.tick()
+    cleared = [
+        args for name, args in calls if name == "apply_worker_layout"
+    ]
+    assert all(payload is None for _, payload in cleared)
+    assert {worker for worker, _ in cleared} == {"w0", "w1"}
+
+
+def test_reconciler_unwired_layout_seam_journals_unwired(tmp_path):
+    spec, _ = _spec_with_plan()
+    store = SpecStore(str(tmp_path))
+    store.commit(spec)
+    rec = Reconciler(
+        store, _observed, RepairSeams(),
+        min_interval=0.0, cooldown=0.0, repair_budget=10,
+    )
+    entries = rec.tick()
+    assert entries and all(
+        entry["outcome"] == "unwired" for entry in entries
+    )
+    assert {entry["class"] for entry in entries} == {"layout"}
+
+
+def test_reconciler_rederives_stale_plan_as_new_revision(tmp_path,
+                                                         monkeypatch):
+    monkeypatch.delenv("GORDO_LAYOUT_REDERIVE", raising=False)
+    spec, plan = _spec_with_plan()
+    fresh_plan = compile_plan(_doc(
+        rates={f"x-{i:03d}": 100.0 / (i + 1) for i in range(20)},
+        generated_t=5000.0,
+    ))
+    assert fresh_plan["fingerprint"] != plan["fingerprint"]
+    clock = _Clock()
+    store = SpecStore(str(tmp_path), clock=clock)
+    store.commit(spec)
+    calls = []
+    seams = _layout_seams(calls)
+    seams.rederive_layout = lambda committed: fresh_plan
+    rec = Reconciler(
+        store, _observed, seams,
+        clock=clock, min_interval=0.0, cooldown=0.0, repair_budget=10,
+    )
+    rec.tick()
+    record = store.load()
+    assert record["revision"] == 2
+    assert record["op"] == "layout"
+    assert record["spec"]["layout"]["fingerprint"] == fresh_plan[
+        "fingerprint"
+    ]
+    # the SAME tick reconciles toward the fresh plan, not the stale one
+    applied = [
+        args for name, args in calls if name == "apply_worker_layout"
+    ]
+    assert applied and all(
+        payload["fingerprint"] == fresh_plan["fingerprint"]
+        for _, payload in applied
+    )
+    # ... and the kill switch stops authorship entirely
+    monkeypatch.setenv("GORDO_LAYOUT_REDERIVE", "0")
+    rec.tick()
+    assert store.load()["revision"] == 2
+
+
+# -- the export window satellite ----------------------------------------------
+
+def test_parse_window_and_horizon_forms():
+    assert telemetry_engine.parse_window("1m") == 60.0
+    assert telemetry_engine.parse_window("10m") == 600.0
+    assert telemetry_engine.parse_window("1h") == 3600.0
+    assert telemetry_engine.parse_window("90") == 90.0
+    assert telemetry_engine.parse_window("45s") == 45.0
+    assert telemetry_engine.parse_window(600) == 600.0
+    assert telemetry_engine.parse_window("junk") is None
+    assert telemetry_engine.parse_window("-5") is None
+    assert telemetry_engine.parse_window(None) is None
+    assert telemetry_engine.resolve_horizon(60.0) == "1m"
+    assert telemetry_engine.resolve_horizon(500.0) == "10m"
+    assert telemetry_engine.resolve_horizon(3600.0) == "1h"
+    assert telemetry_engine.resolve_horizon(None) == "10m"

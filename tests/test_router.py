@@ -106,6 +106,71 @@ def test_ring_preference_distinct_and_ordered():
     assert len(ring.preference("machine-000", 10)) == 3
 
 
+# -- weighted arcs (layout plans, §27) ---------------------------------------
+
+def test_ring_weight_shifts_share_with_bounded_movement():
+    """Raising one worker's weight grows its key share, and ONLY keys
+    flowing to/from that worker move — incumbents never trade keys
+    among themselves (the property that lets a layout plan rebalance a
+    live fleet without a residency cold start)."""
+    ring = HashRing(["worker-0", "worker-1", "worker-2"])
+    before = {key: ring.primary(key) for key in KEYS}
+    share_before = sum(1 for o in before.values() if o == "worker-1")
+    assert ring.set_weight("worker-1", 2.0) is True
+    after = {key: ring.primary(key) for key in KEYS}
+    share_after = sum(1 for o in after.values() if o == "worker-1")
+    assert share_after > share_before
+    for key in KEYS:
+        if before[key] != after[key]:
+            assert after[key] == "worker-1", f"{key} moved between others"
+    # shrinking back: only worker-1's keys are shed
+    ring.set_weight("worker-1", 0.5)
+    shrunk = {key: ring.primary(key) for key in KEYS}
+    for key in KEYS:
+        if after[key] != shrunk[key]:
+            assert after[key] == "worker-1", f"{key} moved without cause"
+
+
+def test_ring_weight_is_deterministic_and_clamped():
+    a = HashRing(["worker-0", "worker-1"])
+    b = HashRing(["worker-1", "worker-0"])
+    a.set_weight("worker-0", 1.5)
+    b.set_weight("worker-0", 1.5)
+    assert {k: a.primary(k) for k in KEYS} == {k: b.primary(k) for k in KEYS}
+    # same value again: no-op, no version churn
+    version = a.version
+    assert a.set_weight("worker-0", 1.5) is False
+    assert a.version == version
+    # the guard rails: a weight cannot starve or monopolize the ring
+    a.set_weight("worker-1", 0.001)
+    assert a.weights()["worker-1"] == pytest.approx(0.1)
+    a.set_weight("worker-1", 100.0)
+    assert a.weights()["worker-1"] == pytest.approx(8.0)
+
+
+def test_placement_set_worker_weights_reverts_absent():
+    """The reconciler seam: declared weights win, workers missing from
+    the new declaration revert to 1.0 (how rollback clears a plan)."""
+    placement = Placement(
+        ["worker-0", "worker-1", "worker-2"], hot_rps=0,
+    )
+    assert placement.set_worker_weights(
+        {"worker-0": 2.0, "worker-2": 0.5}
+    ) is True
+    assert placement.worker_weights() == {
+        "worker-0": 2.0, "worker-2": 0.5,
+    }
+    assert placement.stats()["weights"] == {
+        "worker-0": 2.0, "worker-2": 0.5,
+    }
+    # idempotent re-apply: the reconciler converges, it never churns
+    assert placement.set_worker_weights(
+        {"worker-0": 2.0, "worker-2": 0.5}
+    ) is False
+    assert placement.set_worker_weights({}) is True
+    assert placement.worker_weights() == {}
+
+
 # -- placement: hot replication ----------------------------------------------
 
 def test_placement_replication_fanout():
