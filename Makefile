@@ -1,7 +1,7 @@
 # Developer entry points (reference parity: the reference ships a Makefile
 # driving tests and its four docker images).
 
-.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke reconcile-smoke layout-smoke smoke images builder-image server-image watchman-image
+.PHONY: lint test testfast bench bench-serving metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke reconcile-smoke layout-smoke incident-smoke smoke images builder-image server-image watchman-image
 
 # invariant linter (docs/ARCHITECTURE.md §17/§21): lock discipline
 # against the declared hierarchy, blocking-calls-under-hot-locks,
@@ -178,6 +178,17 @@ reconcile-smoke:
 layout-smoke:
 	JAX_PLATFORMS=cpu python tools/layout_smoke.py
 
+# fleet black box check (§28): kill -9 a ledger writer mid-append and
+# assert the reload contract (torn tail truncated, contiguous seq
+# prefix, zero pre-tail loss); then the full 2-worker tier with an
+# activated GORDO_FAULTS dispatch stall AND a planted innocent
+# autopilot downscale — within 3 scrape ticks a DURABLE incident
+# report's TOP ranked candidate names the injected fault seam; every
+# control loop's ledger events schema-validate in the same run.
+# GORDO_INCIDENT_SMOKE_MACHINES/SECONDS resize
+incident-smoke:
+	JAX_PLATFORMS=cpu python tools/incident_smoke.py
+
 # the full smoke battery: invariant lint + exposition + resilience +
 # store integrity + serving data plane + span attribution + cold-start
 # economics + cross-machine megabatching + the horizontal serving tier
@@ -194,7 +205,9 @@ layout-smoke:
 #   convergence / WAL exactly-once disaster drills)
 # + the fleet layout compiler (measured-cost plans / zero-compile live
 #   apply / p99 + density gates / rollback)
-smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke reconcile-smoke layout-smoke
+# + the fleet black box (crash-safe control ledger / incident
+#   root-cause attribution)
+smoke: lint metrics-smoke chaos-smoke store-fsck perf-smoke trace-smoke coldstart-smoke megabatch-smoke router-smoke slo-smoke quant-smoke autopilot-smoke capacity-smoke mesh-smoke telemetry-smoke qos-smoke reconcile-smoke layout-smoke incident-smoke
 
 images: builder-image server-image watchman-image
 

@@ -222,6 +222,33 @@ KNOBS: Dict[str, Knob] = dict(
               "warehouse segment rotation threshold in KiB (smaller = "
               "finer-grained budget trims, more files)",
               "observability"),
+        _knob("GORDO_LEDGER", "1", "bool",
+              "control ledger (§28): `0` disables control-event "
+              "recording (every writer's emit becomes a no-op)",
+              "observability"),
+        _knob("GORDO_LEDGER_DIR", "unset", "path",
+              "ledger segment root; each process appends under its own "
+              "role subdir (`worker-<id>`/`router`); unset = "
+              "`<models_root>/.telemetry/ledger-<role>` (in-memory only "
+              "when no models root either)", "observability"),
+        _knob("GORDO_LEDGER_MB", "16", "int",
+              "hard byte budget for the on-disk control ledger in MiB; "
+              "whole oldest segments are deleted to stay under it",
+              "observability"),
+        _knob("GORDO_LEDGER_SEGMENT_KB", "128", "int",
+              "ledger segment rotation threshold in KiB",
+              "observability"),
+        _knob("GORDO_INCIDENT_LOOKBACK", "600", "float",
+              "incident correlator (§28): seconds of ledger history and "
+              "warehouse deltas gathered into a breach report",
+              "observability"),
+        _knob("GORDO_INCIDENT_COOLDOWN", "120", "float",
+              "min seconds between incident reports for the same "
+              "objective (breach flapping folds into one incident)",
+              "observability"),
+        _knob("GORDO_INCIDENT_KEEP", "32", "int",
+              "incident reports retained (ring + on-disk files); oldest "
+              "are dropped past it", "observability"),
         # -- autopilot (§20) ---------------------------------------------
         _knob("GORDO_AUTOPILOT", "unset", "bool",
               "closed-loop controller: `1` enables at boot, unset boots "
@@ -421,6 +448,13 @@ KNOBS: Dict[str, Knob] = dict(
         _knob("GORDO_LAYOUT_SMOKE_SECONDS", "5", "float",
               "layout smoke: seconds of skewed Zipf load per phase "
               "through the 2-worker router tier", "bench"),
+        _knob("GORDO_INCIDENT_SMOKE_MACHINES", "8", "int",
+              "incident smoke (§28): synthetic-fleet size for "
+              "`tools/incident_smoke.py`", "bench"),
+        _knob("GORDO_INCIDENT_SMOKE_SECONDS", "6", "float",
+              "incident smoke: seconds of load driven through the "
+              "fault-stalled server while waiting for the breach "
+              "incident", "bench"),
         # -- test / validation harnesses ---------------------------------
         _knob("GORDO_LOCKCHECK", "0", "bool",
               "runtime lock-order validator: named locks record real "

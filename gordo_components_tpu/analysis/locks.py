@@ -50,9 +50,15 @@ LOCK_RANKS: Dict[str, int] = {
     "router.stitch": 52,        # router.py truncated-stitch pull ledger
     "resilience.quarantine": 62,  # quarantine.py ledger
     "resilience.faults": 64,    # faults.py injection plan
+    "observability.incident": 65,  # incidents.py report ring + cooldowns
+                                # (the correlator GATHERS lock-free; this
+                                # only guards its in-memory state)
     "client.io": 66,            # client.py pooled-loop lifecycle
     "observability.telemetry": 67,  # telemetry.py warehouse index + segments
     "observability.slo": 68,    # slo.py evaluator history + breach state
+    "observability.ledger": 69, # ledger.py control-event segments — a LEAF
+                                # below every control-plane writer's lock
+                                # (emit acquires nothing inside it)
     # -- engine data plane (innermost: these sit under everything above
     # via reload-time warmup and request-path scoring)
     "engine.bucket_cond": 70,   # _Bucket._cond leader/follower latch
@@ -115,6 +121,8 @@ LOCK_ATTRS: Dict[Tuple[str, str], str] = {
     ("observability/slo.py", "_lock"): "observability.slo",
     ("observability/telemetry.py", "_lock"): "observability.telemetry",
     ("observability/traffic.py", "_lock"): "observability.traffic",
+    ("observability/ledger.py", "_lock"): "observability.ledger",
+    ("observability/incidents.py", "_lock"): "observability.incident",
     ("autopilot/controller.py", "_lock"): "autopilot.state",
     ("autopilot/elastic.py", "_lock"): "autopilot.elastic",
     ("parallel/shard_plan.py", "_PLAN_LOCK"): "parallel.shard_plan",
@@ -190,6 +198,9 @@ GUARDED_FIELDS: Dict[Tuple[str, str], str] = {
     ("observability/telemetry.py", "_index"): "observability.telemetry",
     ("observability/traffic.py", "_pending"): "observability.traffic",
     ("observability/traffic.py", "_rates"): "observability.traffic",
+    # control ledger segment index + incident report ring (§28)
+    ("observability/ledger.py", "_index"): "observability.ledger",
+    ("observability/incidents.py", "_reports"): "observability.incident",
     # fleet spec journal cache + reconciler repair ring / WAL step map (§26)
     ("fleet/spec.py", "_records"): "fleet.spec",
     ("fleet/reconciler.py", "_ring"): "fleet.reconcile",

@@ -33,6 +33,7 @@ COMPONENTS = (
     "server", "engine", "client", "build", "builds", "fleet", "watchman",
     "router", "resilience", "store", "compile_cache", "span", "stage",
     "drift", "lint", "slo", "autopilot", "mesh", "telemetry", "tenant",
+    "incident",
 )
 
 # §7 label allowlist: low-cardinality enums only. ``machine``/``worker``/
@@ -44,13 +45,15 @@ COMPONENTS = (
 # ``tenant`` is bounded by the DECLARED tenant table — unknown header
 # values fold into 'default' before any label is minted — and ``class``
 # is the three-value interactive/standard/bulk enum (§25).
+# ``actor`` is the control ledger's closed writer enum — unknown actors
+# fold into 'operator' before the label is minted (§28).
 ALLOWED_LABELS = frozenset(
     {
         "endpoint", "status", "kind", "outcome", "path", "event", "phase",
         "reason", "stage", "name", "trigger", "format", "worker",
         "machine", "target", "cause", "point", "to", "where", "error",
         "window", "precision", "actuator", "direction", "shard",
-        "tenant", "class",
+        "tenant", "class", "actor",
     }
 )
 

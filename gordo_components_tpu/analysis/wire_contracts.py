@@ -144,6 +144,12 @@ ROUTES: Tuple[RouteSpec, ...] = (
               "warehouse window queries + traffic top-K + cost ledger; "
               "?view=export = layout-input doc; router merges workers "
               "(§24)"),
+    RouteSpec("/incidents", ("server", "router"),
+              "incident reports + correlator status; ?view=ledger = raw "
+              "control-ledger window; router merges workers (§28)"),
+    RouteSpec("/incidents/<incident_id>", ("server", "router"),
+              "one durable incident report: lookback control events, "
+              "metric deltas, ranked root-cause candidates (§28)"),
     RouteSpec("/models", ("server", "router"), "served machine list"),
     RouteSpec("/prefetch", ("server",),
               "POST placement hint (§22): queue async host-cache loads "

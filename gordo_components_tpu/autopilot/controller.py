@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..analysis import lockcheck
 from ..observability import flightrec
+from ..observability import ledger as control_ledger
 from ..observability.registry import REGISTRY
 from ..observability.spans import Timeline
 from ..resilience import qos
@@ -163,6 +164,9 @@ class Autopilot:
             self._disabled_reason = None
         _M_ENABLED.set(1.0)
         logger.info("Autopilot (%s) enabled", self.role)
+        control_ledger.emit(
+            actor="autopilot", action="enable", target=self.role,
+        )
 
     def disable(self, reason: str = "operator freeze") -> None:
         """The runtime kill switch: stop adapting NOW. Status stays
@@ -176,6 +180,10 @@ class Autopilot:
                 state.pending_count = 0
         _M_ENABLED.set(0.0)
         logger.warning("Autopilot (%s) disabled: %s", self.role, reason)
+        control_ledger.emit(
+            actor="autopilot", action="disable", target=self.role,
+            reason=reason,
+        )
 
     def set_bounds(self, name: str, lo: int, hi: int) -> bool:
         """Re-aim one actuator's hard bounds at runtime — the fleet
@@ -389,6 +397,13 @@ class Autopilot:
             recorder.record(timeline)
         except Exception:  # journaling must never break actuation
             logger.exception("Autopilot: flight-recorder journal failed")
+        # §28: the same decision lands in the shared control ledger
+        # (rank 69 nests under autopilot.state; emit never raises)
+        control_ledger.emit(
+            actor="autopilot", action="decision", target=actuator,
+            before=value_from, after=value_to,
+            reason=f"{direction}: {reason}",
+        )
         return decision
 
     # -- views ---------------------------------------------------------------

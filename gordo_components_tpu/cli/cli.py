@@ -1279,6 +1279,83 @@ def telemetry_export_cmd(base_url, window, output):
         click.echo(rendered)
 
 
+@gordo.group("incidents")
+def incidents_group():
+    """The fleet black box (ARCHITECTURE §28): the unified control
+    ledger every control loop emits into, and the incident reports the
+    breach-edge correlator snapshots from it.
+
+    ``list`` shows newest-first incident summaries (router answers with
+    the whole fleet merged; a worker answers for itself); ``show``
+    renders one full report — trigger, lookback ledger events, metric
+    deltas, spec/layout revisions, and the ranked root-cause candidate
+    list; ``ledger`` tails the raw control-event journal.
+    """
+
+
+def _incidents_request(base_url: str, path: str, params=None):
+    import requests
+
+    url = f"{base_url.rstrip('/')}{path}"
+    try:
+        response = requests.get(url, params=params or {}, timeout=30)
+    except requests.RequestException as exc:
+        logger.error("Could not reach %s: %s", url, exc)
+        sys.exit(1)
+    try:
+        body = response.json()
+    except ValueError:
+        logger.error("Non-JSON answer from %s (HTTP %d)", url,
+                     response.status_code)
+        sys.exit(1)
+    if response.status_code >= 400:
+        logger.error("%s answered HTTP %d: %s", url, response.status_code,
+                     body.get("error", body))
+        sys.exit(1)
+    return body
+
+
+@incidents_group.command("list")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+def incidents_list_cmd(base_url):
+    """Newest-first incident summaries from ``GET /incidents``."""
+    click.echo(json.dumps(_incidents_request(base_url, "/incidents"),
+                          indent=2))
+
+
+@incidents_group.command("show")
+@click.argument("incident_id")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+def incidents_show_cmd(incident_id, base_url):
+    """One full incident report: ``GET /incidents/<id>`` (the router
+    also searches its workers for the id)."""
+    click.echo(json.dumps(
+        _incidents_request(base_url, f"/incidents/{incident_id}"),
+        indent=2,
+    ))
+
+
+@incidents_group.command("ledger")
+@click.option("--base-url", required=True,
+              help="router or model-server base URL")
+@click.option("--window", default=None,
+              help="only events in this trailing window: seconds or "
+                   "1m/10m/1h forms (default: all retained)")
+@click.option("--limit", default=200, show_default=True,
+              help="newest events kept")
+def incidents_ledger_cmd(base_url, window, limit):
+    """Tail the raw control ledger: ``GET /incidents?view=ledger``."""
+    params = {"view": "ledger", "limit": limit}
+    if window is not None:
+        params["window"] = window
+    click.echo(json.dumps(
+        _incidents_request(base_url, "/incidents", params=params),
+        indent=2,
+    ))
+
+
 @gordo.group("layout")
 def layout_group():
     """The fleet layout compiler (ARCHITECTURE §27): measured-cost

@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..analysis import lockcheck
 from ..observability import flightrec
+from ..observability import ledger as control_ledger
 from ..observability.registry import REGISTRY
 from ..observability.spans import Timeline
 from ..resilience import faults
@@ -870,6 +871,14 @@ class Reconciler:
             recorder.record(timeline)
         except Exception:  # journaling must never break the repair loop
             logger.exception("Reconciler: flight-recorder journal failed")
+        # §28: every repair attempt is a control event (rank 69 nests
+        # under fleet.reconcile; emit never raises)
+        control_ledger.emit(
+            actor="reconciler", action="repair",
+            target=f"{cls}:{target}",
+            before=actual, after=desired, reason=outcome,
+            revision=revision,
+        )
         return entry
 
     # -- views ---------------------------------------------------------------

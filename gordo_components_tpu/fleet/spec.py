@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import precision as precision_mod
 from ..analysis import lockcheck
+from ..observability import ledger as control_ledger
 from ..observability.registry import REGISTRY
 from ..resilience import faults
 from ..store.atomic import atomic_write_file
@@ -436,6 +437,13 @@ class SpecStore:
             "Fleet spec revision %d committed (%s, parent %s)",
             revision, op, parent,
         )
+        # §28: spec revision edges are control events (emitted OUTSIDE
+        # fleet.spec — the ledger fsync must not extend the commit's
+        # critical section)
+        control_ledger.emit(
+            actor="fleet-spec", action="commit", target=op,
+            before=parent, after=revision, revision=revision,
+        )
         return record
 
     def rollback(self, reason: str = "operator rollback") -> Dict[str, Any]:
@@ -468,5 +476,10 @@ class SpecStore:
         logger.warning(
             "Fleet spec rolled back: revision %d re-applies revision %d "
             "(%s)", record["revision"], record["reverted_to"], reason,
+        )
+        control_ledger.emit(
+            actor="fleet-spec", action="rollback", target="spec",
+            before=record["parent"], after=record["reverted_to"],
+            reason=reason, revision=record["revision"],
         )
         return record

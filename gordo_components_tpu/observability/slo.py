@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..analysis import lockcheck
 from . import flightrec
+from . import ledger as control_ledger
 from .registry import REGISTRY, Histogram, Registry
 from .spans import Timeline
 
@@ -159,6 +160,7 @@ class SLOEvaluator:
         min_interval: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
         recorder: Optional[flightrec.FlightRecorder] = None,
+        breach_hook: Optional[Callable[[Dict[str, Any]], Any]] = None,
     ):
         self.objectives = list(objectives)
         self.registry = registry
@@ -184,6 +186,10 @@ class SLOEvaluator:
         )
         self._clock = clock
         self._recorder = recorder
+        # §28: called once per breach EDGE with the crossing dict —
+        # the incident correlator's entry point (set post-construction
+        # by server/router wiring; never called under the SLO lock)
+        self.breach_hook = breach_hook
         self._lock = lockcheck.named_lock("observability.slo")
         # per objective: ring of (t, good, total) cumulative samples,
         # pruned past the slow window — bounded by construction
@@ -329,6 +335,26 @@ class SLOEvaluator:
                     self._breached[key] = above
         for crossing in crossings:
             self._record_crossing(crossing)
+            # §28: the breach edge itself is a control event (outside
+            # the SLO lock — the ledger fsyncs), then the incident
+            # correlator snapshots its report
+            control_ledger.emit(
+                actor="slo", action="breach",
+                target=crossing["objective"],
+                after={"burn_rate": crossing["burn_rate"],
+                       "window": crossing["window"]},
+                reason="burn {} >= {} ({} window)".format(
+                    crossing["burn_rate"], crossing["threshold"],
+                    crossing["window"],
+                ),
+            )
+            if self.breach_hook is not None:
+                try:
+                    self.breach_hook(crossing)
+                except Exception:
+                    logger.exception(
+                        "slo: breach hook failed for %s", crossing
+                    )
         return {"ticks": self.ticks, "crossings": crossings}
 
     def _burn_locked(

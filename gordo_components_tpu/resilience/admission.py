@@ -40,6 +40,7 @@ from collections import deque
 from typing import Dict, Optional
 
 from ..analysis import lockcheck
+from ..observability import ledger as control_ledger
 from ..observability.registry import REGISTRY
 from . import deadline, qos
 
@@ -214,8 +215,16 @@ class AdmissionController:
         level = max(0, min(qos.SHED_MAX, int(level)))
         with self._cond:
             lockcheck.assert_guard("server.admission")
+            previous = self._shed_level
             self._shed_level = level
             self._cond.notify_all()
+        if level != previous:
+            # §28: emitted AFTER releasing the HOT admission lock (the
+            # ledger fsyncs; a stall here would block every admit)
+            control_ledger.emit(
+                actor="qos", action="shed-level", target="bulk",
+                before=previous, after=level,
+            )
         return level
 
     def set_max_inflight(self, max_inflight: int) -> int:

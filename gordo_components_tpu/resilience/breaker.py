@@ -21,6 +21,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..analysis import lockcheck
+from ..observability import ledger as control_ledger
 from ..observability.registry import REGISTRY
 
 CLOSED = "closed"
@@ -89,6 +90,10 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probe_in_flight = False
         self._probe_started = 0.0
+        # §28: transitions noted under the HOT breaker lock, emitted to
+        # the control ledger only after release (fsync under a hot lock
+        # is a traffic stall) — (from, to) pairs, oldest first
+        self._pending_events: list = []
         _M_STATE.labels(name).set(_STATE_VALUE[CLOSED])
 
     @property
@@ -100,13 +105,37 @@ class CircuitBreaker:
         # caller holds self._lock
         if to == self._state:
             return
+        self._pending_events.append((self._state, to))
         self._state = to
         _M_TRANSITIONS.labels(self.name, to).inc()
         _M_STATE.labels(self.name).set(_STATE_VALUE[to])
 
+    def _drain_events(self) -> None:
+        """Emit stashed transitions into the control ledger, OUTSIDE the
+        breaker lock (the §28 hot-lock rule)."""
+        with self._lock:
+            if not self._pending_events:
+                return
+            pending, self._pending_events = self._pending_events, []
+        for src, dst in pending:
+            control_ledger.emit(
+                actor="breaker",
+                action=(
+                    "breaker-open" if dst == OPEN
+                    else "breaker-close" if dst == CLOSED
+                    else "breaker-probe"
+                ),
+                target=self.name, before=src, after=dst,
+            )
+
     def allow(self) -> bool:
         """True when the caller may attempt the guarded call (and MUST then
         ``record`` its outcome). False = short-circuit: fail fast."""
+        allowed = self._allow()
+        self._drain_events()
+        return allowed
+
+    def _allow(self) -> bool:
         with self._lock:
             if self._state == CLOSED:
                 return True
@@ -148,6 +177,10 @@ class CircuitBreaker:
             raise CircuitOpen(self.name, self.retry_after())
 
     def record(self, ok: bool) -> None:
+        self._record(ok)
+        self._drain_events()
+
+    def _record(self, ok: bool) -> None:
         with self._lock:
             if self._state == HALF_OPEN:
                 self._probe_in_flight = False

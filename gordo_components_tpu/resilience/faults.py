@@ -43,6 +43,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..analysis import lockcheck
+from ..observability import ledger as control_ledger
 from ..observability.registry import REGISTRY
 
 logger = logging.getLogger(__name__)
@@ -136,6 +137,7 @@ def configure(spec: str) -> int:
             len(rules),
             "; ".join(f"{r.point}:{r.target}:{r.kind}" for r in rules),
         )
+    _emit_plan(rules)
     return len(rules)
 
 
@@ -143,8 +145,22 @@ def clear() -> None:
     configure("")
 
 
+def _emit_plan(rules: List[_Rule]) -> None:
+    """§28: an activated fault plan is a control event per rule — the
+    incident correlator's strongest root-cause candidate (a chaos drill
+    that burns an SLO should blame itself, not an innocent controller).
+    Called OUTSIDE resilience.faults (the ledger fsyncs)."""
+    for rule in rules:
+        control_ledger.emit(
+            actor="faults", action="inject-plan",
+            target=f"{rule.point}:{rule.target}",
+            reason=rule.kind + (f":{rule.param}" if rule.param else ""),
+        )
+
+
 def _active_rules() -> List[_Rule]:
     global _configured
+    fresh: List[_Rule] = []
     with _lock:
         if not _configured:
             # lazy env pickup: a server started with GORDO_FAULTS set needs
@@ -163,7 +179,11 @@ def _active_rules() -> List[_Rule]:
                     ENV_VAR,
                     len(_rules),
                 )
-        return list(_rules)
+                fresh = list(_rules)
+        rules = list(_rules)
+    if fresh:
+        _emit_plan(fresh)
+    return rules
 
 
 def active() -> bool:

@@ -28,6 +28,7 @@ import time
 from typing import Any, Dict, Optional
 
 from ..analysis import lockcheck
+from ..observability import ledger as control_ledger
 from ..observability.registry import REGISTRY
 
 _M_EVENTS = REGISTRY.counter(
@@ -68,6 +69,12 @@ class Quarantine:
             entry["_since"] = self._clock()
             _M_EVENTS.labels("quarantine").inc()
             _M_QUARANTINED.set(len(self._hard))
+        # §28: emit AFTER releasing resilience.quarantine — the ledger
+        # fsync must not extend a request-path critical section
+        control_ledger.emit(
+            actor="quarantine", action="quarantine", target=name,
+            reason=f"{phase}: {error}",
+        )
 
     def is_quarantined(self, name: str) -> bool:
         with self._lock:
@@ -115,7 +122,11 @@ class Quarantine:
             if entry is not None:
                 _M_EVENTS.labels("recover").inc()
                 _M_QUARANTINED.set(len(self._hard))
-            return entry is not None
+        if entry is not None:
+            control_ledger.emit(
+                actor="quarantine", action="recover", target=name,
+            )
+        return entry is not None
 
     # -- soft (suspect) tier -------------------------------------------------
     def mark_suspect(self, name: str, error: str) -> None:

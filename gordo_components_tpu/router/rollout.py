@@ -34,6 +34,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..analysis import lockcheck
+from ..observability import ledger as control_ledger
 from ..observability.registry import REGISTRY
 
 logger = logging.getLogger(__name__)
@@ -204,6 +205,14 @@ class RolloutManager:
             reloaded["verified"] = verified
             reloaded["ok"] = verified["ok"]
         result["workers"][canary] = reloaded
+        # §28: the canary step is the rollout's first control event —
+        # an abort right after it is the strongest root-cause signal a
+        # bad build leaves behind
+        control_ledger.emit(
+            actor="rollout", action="canary", target=canary,
+            after="ok" if reloaded["ok"] else "failed",
+            reason=str(reloaded.get("error") or ""),
+        )
         if not reloaded["ok"]:
             # the canary caught it: the sweep never runs, the fleet keeps
             # serving the old generation. The canary itself is left to the
@@ -216,6 +225,10 @@ class RolloutManager:
             )
             logger.warning("Rollout aborted: %s", result["error"])
             _M_ROLLOUTS.labels(kind, "aborted").inc()
+            control_ledger.emit(
+                actor="rollout", action="sweep", target=kind,
+                after="aborted", reason=str(result["error"]),
+            )
             return self._finish(result)
         failures = 0
         for name in rest:
@@ -241,6 +254,10 @@ class RolloutManager:
         logger.info(
             "Rollout %s %s: canary %s, %d swept, %d failed",
             kind, outcome, canary, len(rest) - failures, failures,
+        )
+        control_ledger.emit(
+            actor="rollout", action="sweep", target=kind, after=outcome,
+            reason=f"{len(rest) - failures} swept, {failures} failed",
         )
         return self._finish(result)
 
@@ -281,6 +298,10 @@ class RolloutManager:
                     restored[name] = rollback_generation(path)
                 except StoreError as exc:
                     skipped[name] = str(exc)
+            control_ledger.emit(
+                actor="rollout", action="rollback", target="fleet",
+                after={"restored": len(restored), "skipped": len(skipped)},
+            )
             result = self._rolling_reload_locked(kind="rollback")
             result["restored"] = restored
             result["skipped"] = skipped

@@ -50,6 +50,8 @@ from ..observability import (
     stitch,
     tracing,
 )
+from ..observability import incidents as incidents_engine
+from ..observability import ledger as ledger_engine
 from ..observability import slo as slo_engine
 from ..observability import telemetry as telemetry_engine
 from ..observability.registry import REGISTRY
@@ -116,6 +118,20 @@ def _aggregate_enabled() -> bool:
         "GORDO_ROUTER_AGGREGATE", "1"
     ).strip().lower() not in ("0", "false", "off", "no")
 
+class _AggregateWarehouse:
+    """The router-side stand-in for a telemetry warehouse (§28): the
+    incident correlator's ``window_view`` queries fan out to every
+    routable worker and merge — so a router incident's metric deltas
+    describe the FLEET, not the (warehouse-less) router process."""
+
+    def __init__(self, router: "FleetRouter"):
+        self._router = router
+
+    def window_view(self, window, now_wall=None):
+        merged, _errors = self._router._aggregate_telemetry(window)
+        return merged.get("window") or {}
+
+
 _URL_MAP = Map(
     [
         Rule("/healthz", endpoint="healthz"),
@@ -127,6 +143,11 @@ _URL_MAP = Map(
         # fleet telemetry warehouse (§24): per-worker warehouses fetched
         # and merged (rates summed, percentiles recomputed, latency MAX)
         Rule("/telemetry", endpoint="telemetry"),
+        # fleet black box (§28): the router's own incident reports plus
+        # every routable worker's, one merged newest-first index;
+        # ?view=ledger serves the router's raw control-ledger tail
+        Rule("/incidents", endpoint="incidents"),
+        Rule("/incidents/<incident_id>", endpoint="incident"),
         # elastic autopilot: status + runtime kill switch (§20)
         Rule("/autopilot", endpoint="autopilot"),
         Rule("/autopilot/<action>", endpoint="autopilot-action"),
@@ -231,7 +252,40 @@ class FleetRouter:
         from ..fleet.wiring import build_router_reconciler
 
         self.fleet = build_router_reconciler(self)
+        # fleet black box (§28): the router's own control ledger (its
+        # autopilot, reconciler, rollout, spec, and breaker events land
+        # here) plus its breach-edge incident correlator. Warehouse
+        # deltas come through the aggregate fan-out — the router has no
+        # warehouse of its own.
+        ledger_dir = os.environ.get("GORDO_LEDGER_DIR")
+        if ledger_dir:
+            ledger_dir = os.path.join(ledger_dir, "router")
+        elif models_root:
+            ledger_dir = os.path.join(
+                models_root, ".telemetry", "ledger-router",
+            )
+        ledger_engine.configure(ledger_dir or None)
+        self.incidents = incidents_engine.IncidentCorrelator(
+            directory=(
+                os.path.join(ledger_dir, "incidents") if ledger_dir
+                else None
+            ),
+            warehouse=(
+                _AggregateWarehouse(self)
+                if telemetry_engine.enabled() else None
+            ),
+            spec_revision=self._current_spec_revision,
+            role="router",
+        )
+        if self.slo is not None:
+            self.slo.breach_hook = self.incidents.on_breach
         tracing.install_log_record_factory()
+
+    def _current_spec_revision(self) -> Optional[int]:
+        if self.fleet is None:
+            return None
+        loaded = self.fleet.spec_store.current_spec()
+        return loaded[0] if loaded else None
 
     # -- WSGI ----------------------------------------------------------------
     def __call__(self, environ, start_response):
@@ -356,6 +410,39 @@ class FleetRouter:
             if errors:
                 payload["errors"] = errors
             return _json(payload)
+        if endpoint == "incidents":
+            # §28: reading incidents ticks the router's SLO engine first
+            # (breach edges materialize their reports before rendering)
+            if self.slo is not None:
+                self.slo.maybe_tick()
+            if request.args.get("view") == "ledger":
+                window = telemetry_engine.parse_window(
+                    request.args.get("window")
+                )
+                return _json({
+                    "ledger": ledger_engine.LEDGER.snapshot(),
+                    "events": ledger_engine.LEDGER.recent(
+                        window=window,
+                        limit=request.args.get("limit", type=int) or 200,
+                    ),
+                })
+            merged, errors = self._aggregate_incidents()
+            payload = {
+                "incidents": merged,
+                "correlator": self.incidents.snapshot(),
+            }
+            if errors:
+                payload["errors"] = errors
+            return _json(payload)
+        if endpoint == "incident":
+            report = self._find_incident(str(args.get("incident_id")))
+            if report is None:
+                return _json(
+                    {"error": f"no incident {args.get('incident_id')!r} "
+                              "on the router or any routable worker"},
+                    status=404,
+                )
+            return _json(report)
         if endpoint == "autopilot":
             if self.autopilot is None:
                 return _json(disabled_snapshot())
@@ -853,6 +940,65 @@ class FleetRouter:
         for name in sorted(set(self.supervisor.specs) - set(targets)):
             errors[name] = "not routable, skipped"
         return telemetry_engine.merge_views(views), errors
+
+    def _aggregate_incidents(
+        self,
+    ) -> "tuple[List[Dict[str, Any]], Dict[str, str]]":
+        """The router's own incident summaries plus every routable
+        worker's, one newest-first list with a ``source`` on each row.
+        Unreachable workers are named in the errors map and skipped —
+        the fleet view degrades, never dies (§24's rule)."""
+        import requests
+
+        merged: List[Dict[str, Any]] = []
+        for summary in self.incidents.list():
+            merged.append({**summary, "source": "router"})
+        errors: Dict[str, str] = {}
+        for name, spec in sorted(self.supervisor.specs.items()):
+            if not self.control.routable(name):
+                errors[name] = "not routable, skipped"
+                continue
+            try:
+                reply = self._session.get(
+                    f"{spec.base_url}/incidents",
+                    timeout=self.scrape_timeout,
+                )
+                reply.raise_for_status()
+                body = reply.json()
+            except (requests.RequestException, ValueError) as exc:
+                errors[name] = str(exc)
+                continue
+            for summary in (body or {}).get("incidents") or []:
+                if isinstance(summary, dict):
+                    merged.append({**summary, "source": name})
+        merged.sort(key=lambda s: -(s.get("ts") or 0.0))
+        return merged, errors
+
+    def _find_incident(self, incident_id: str) -> Optional[Dict[str, Any]]:
+        """Serve a full report from the router's own correlator, else the
+        first routable worker that has it (reports are per-process; the
+        id encodes nothing about where it lives)."""
+        import requests
+
+        report = self.incidents.get(incident_id)
+        if report is not None:
+            return {**report, "source": "router"}
+        for name, spec in sorted(self.supervisor.specs.items()):
+            if not self.control.routable(name):
+                continue
+            try:
+                reply = self._session.get(
+                    f"{spec.base_url}/incidents/{incident_id}",
+                    timeout=self.scrape_timeout,
+                )
+                if reply.status_code != 200:
+                    continue
+                body = reply.json()
+            except (requests.RequestException, ValueError):
+                continue
+            if isinstance(body, dict) and body.get("id") == incident_id:
+                return {**body, "source": name}
+        return None
 
     # -- views ---------------------------------------------------------------
     def _healthz(self) -> Response:

@@ -60,6 +60,8 @@ from ..analysis import lockcheck
 from ..autopilot import build_server_autopilot, disabled_snapshot
 from ..models.anomaly.base import AnomalyDetectorBase
 from ..observability import exposition, flightrec, spans, stitch, tracing
+from ..observability import incidents as incidents_engine
+from ..observability import ledger as ledger_engine
 from ..observability import slo as slo_engine
 from ..observability import telemetry as telemetry_engine
 from ..observability.registry import REGISTRY
@@ -118,6 +120,10 @@ _URL_MAP = Map(
         # from the durable history, traffic top-K, measured-cost ledger;
         # ?view=export renders the layout-input document
         Rule("/telemetry", endpoint="telemetry"),
+        # fleet black box (§28): incident report index / one durable
+        # report; ?view=ledger serves the raw control-ledger tail
+        Rule("/incidents", endpoint="incidents"),
+        Rule("/incidents/<incident_id>", endpoint="incident"),
         Rule("/models", endpoint="models"),
         Rule("/reload", endpoint="reload"),
         # closed-loop controller status + runtime kill switch (§20)
@@ -697,6 +703,32 @@ class ModelServer:
                     self._state.engine, self.compile_cache
                 ),
             )
+        # fleet black box (§28): the shared control ledger every control
+        # loop in this process emits into, durable next to the telemetry
+        # warehouse, plus the breach-edge incident correlator
+        ledger_dir = os.environ.get("GORDO_LEDGER_DIR")
+        role_name = f"worker-{worker_id if worker_id is not None else 0}"
+        if ledger_dir:
+            # one GORDO_LEDGER_DIR serves the whole tier: each process
+            # gets its own subtree (two writers in one segment dir would
+            # interleave torn tails)
+            ledger_dir = os.path.join(ledger_dir, role_name)
+        elif models_root:
+            ledger_dir = os.path.join(
+                models_root, ".telemetry", f"ledger-{role_name}",
+            )
+        ledger_engine.configure(ledger_dir or None)
+        self.incidents = incidents_engine.IncidentCorrelator(
+            directory=(
+                os.path.join(ledger_dir, "incidents") if ledger_dir
+                else None
+            ),
+            warehouse=self.telemetry,
+            layout_fingerprint=lambda: self._layout.get("fingerprint"),
+            role=role_name,
+        )
+        if self.slo is not None:
+            self.slo.breach_hook = self.incidents.on_breach
         # every record emitted while serving a request carries its trace id
         # (idempotent; composes with logsetup.configure_logging)
         tracing.install_log_record_factory()
@@ -1601,6 +1633,35 @@ class ModelServer:
                     telemetry_engine.build_export(view, window=window)
                 )
             return _json(view)
+        if endpoint == "incidents":
+            # §28: reading incidents is also an evaluation tick — a
+            # breach that happened since the last scrape materializes
+            # its report before this response renders
+            if self.slo is not None:
+                self.slo.maybe_tick()
+            if request.args.get("view") == "ledger":
+                window = telemetry_engine.parse_window(
+                    request.args.get("window")
+                )
+                return _json({
+                    "ledger": ledger_engine.LEDGER.snapshot(),
+                    "events": ledger_engine.LEDGER.recent(
+                        window=window,
+                        limit=request.args.get("limit", type=int) or 200,
+                    ),
+                })
+            return _json({
+                "incidents": self.incidents.list(),
+                "correlator": self.incidents.snapshot(),
+            })
+        if endpoint == "incident":
+            report = self.incidents.get(str(args.get("incident_id")))
+            if report is None:
+                raise NotFound(
+                    f"no incident {args.get('incident_id')!r} (rotated "
+                    "out of GORDO_INCIDENT_KEEP, or never opened)"
+                )
+            return _json(report)
         if endpoint == "autopilot":
             if self.autopilot is None:
                 return _json(disabled_snapshot())
@@ -1711,7 +1772,14 @@ class ModelServer:
                 _abort(400, "Request body is not valid JSON")
             if payload.get("clear"):
                 cleared = state.engine.pin_residency(())
+                previous = self._layout.get("fingerprint")
                 self._layout = {}
+                # §28: plan reverts are control events too (rollback's
+                # direction reads as clear-plan in the ledger)
+                ledger_engine.emit(
+                    actor="layout", action="clear-plan", target="worker",
+                    before=previous,
+                )
                 return _json({"cleared": True, "residency": cleared})
             fingerprint = payload.get("fingerprint")
             if not isinstance(fingerprint, str) or not fingerprint:
@@ -1733,12 +1801,18 @@ class ModelServer:
                 applied["prefetch"] = state.engine.prefetch(
                     [str(name) for name in hints]
                 )
+            previous = self._layout.get("fingerprint")
             self._layout = {
                 "fingerprint": fingerprint,
                 "resident": resident,
                 "cap": int(cap) if cap is not None else None,
                 "applied": applied,
             }
+            ledger_engine.emit(
+                actor="layout", action="apply-plan", target="worker",
+                before=previous, after=fingerprint,
+                reason=f"{len(resident)} pin(s), cap {cap}",
+            )
             return _json({"fingerprint": fingerprint, "applied": applied})
         if endpoint == "reload":
             if request.method != "POST":

@@ -135,3 +135,90 @@ def test_dense_fleet_exec_regression_gate(tmp_path):
     ring_path.write_text(
         json.dumps({"ring": ring, "best_ever": best_ever, "env": _GATE_ENV})
     )
+
+
+# -- cross-round history gate (fast tier) -------------------------------------
+# The live gate above re-measures (slow tier, one host). This gate instead
+# reads the CHECKED-IN ``BENCH_HISTORY.jsonl`` — the rows every bench round
+# appended across rigs — and fails on SUSTAINED drift: the 5-25% class that
+# slips under the 1.5x live tolerance but compounds across rounds. Raw
+# exec seconds vary ±30% run-to-run with ambient load (the r5 calibration
+# above), so each row is normalized by its own ``calib_matmul_ms`` rig
+# probe, and one noisy round is never enough: only the last TWO rounds
+# both exceeding the prior-median baseline by >25% fails.
+
+_HISTORY = _REPO_ROOT / "BENCH_HISTORY.jsonl"
+_DRIFT_TOLERANCE = 1.25
+
+
+def _normalized_exec_history(path: Path) -> dict:
+    """Per-config list of rig-normalized exec costs, round order kept.
+    A row qualifies when it carries both the per-config ``exec_s`` block
+    and the ``calib_matmul_ms`` rig probe measured in the same process —
+    ``exec_s / calib_matmul_ms`` cancels the rig's scalar speed."""
+    series: dict = {}
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue  # a torn tail row must not fail the gate
+        calib = row.get("calib_matmul_ms")
+        configs = row.get("exec_s")
+        if not isinstance(calib, (int, float)) or calib <= 0:
+            continue
+        if not isinstance(configs, dict):
+            continue
+        for config, block in configs.items():
+            exec_s = (block or {}).get("exec_s")
+            if isinstance(exec_s, (int, float)) and exec_s > 0:
+                series.setdefault(config, []).append(exec_s / calib)
+    return series
+
+
+def _sustained_regression(values, tolerance=_DRIFT_TOLERANCE):
+    """None, or (baseline, last_two) when BOTH of the newest two rounds
+    exceed the median of all earlier rounds by ``tolerance``. A single
+    bad round — however bad — is noise by calibration, not a verdict."""
+    if len(values) < 3:
+        return None
+    import statistics
+
+    baseline = statistics.median(values[:-2])
+    last_two = values[-2:]
+    if all(v > baseline * tolerance for v in last_two):
+        return baseline, last_two
+    return None
+
+
+def test_bench_history_has_no_sustained_exec_drift():
+    assert _HISTORY.exists(), "BENCH_HISTORY.jsonl missing from the repo"
+    series = _normalized_exec_history(_HISTORY)
+    assert series, (
+        "no exec_s+calib_matmul_ms rows in BENCH_HISTORY.jsonl — the "
+        "bench stopped recording the very numbers this gate watches"
+    )
+    for config, values in sorted(series.items()):
+        verdict = _sustained_regression(values)
+        assert verdict is None, (
+            f"{config}: rig-normalized exec cost drifted "
+            f">{(_DRIFT_TOLERANCE - 1) * 100:.0f}% for two consecutive "
+            f"rounds (baseline {verdict[0]:.5f}, last two "
+            f"{[round(v, 5) for v in verdict[1]]}) — a sustained "
+            "execution regression reached the checked-in history"
+        )
+
+
+def test_sustained_drift_detector_tolerates_single_run_noise():
+    # a ±30% one-round spike (the calibrated rig noise band) passes…
+    assert _sustained_regression([1.0, 1.0, 1.0, 1.3, 1.0]) is None
+    assert _sustained_regression([1.0, 1.0, 1.0, 1.0, 1.3]) is None
+    # …and so does drift that stays inside the 25% tolerance
+    assert _sustained_regression([1.0, 1.0, 1.0, 1.2, 1.24]) is None
+    # but two consecutive rounds past it fail, spike-magnitude aside
+    verdict = _sustained_regression([1.0, 1.0, 1.0, 1.3, 1.3])
+    assert verdict is not None and verdict[0] == 1.0
+    # short histories cannot render a verdict
+    assert _sustained_regression([1.0, 2.0]) is None

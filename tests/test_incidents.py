@@ -1,0 +1,505 @@
+"""Fleet black box (§28): the unified control ledger's durability and
+schema contracts, root-cause ranking, and the SLO-breach → incident
+pipeline.
+
+Ledger tests reuse the §24 warehouse idiom — fake clocks, private
+directories, deliberate torn tails — and the correlator tests inject
+every provider, so the whole file runs in milliseconds with no serving
+tier. The end-to-end tier path is ``tools/incident_smoke.py``."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from gordo_components_tpu.observability import incidents, slo
+from gordo_components_tpu.observability import flightrec
+from gordo_components_tpu.observability import ledger as ledger_mod
+from gordo_components_tpu.observability.ledger import (
+    ControlLedger,
+    validate_event,
+)
+from gordo_components_tpu.observability.registry import Registry
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> float:
+        self.now += seconds
+        return self.now
+
+
+# -- event schema -------------------------------------------------------------
+
+
+def test_emit_produces_schema_valid_events():
+    clock = FakeClock()
+    ledger = ControlLedger(directory=None, wall=clock)
+    event = ledger.emit(
+        actor="autopilot", action="decision", target="GORDO_MAX_INFLIGHT",
+        before=64, after=32, reason="down: sustained burn",
+        trace_id="t-1", revision=7,
+    )
+    assert event is not None
+    assert validate_event(event) == []
+    assert event["seq"] == 0 and event["ts"] == pytest.approx(clock.now)
+    # optional keys are elided when unset, never emitted as nulls
+    bare = ledger.emit(actor="slo", action="breach", target="latency")
+    assert validate_event(bare) == []
+    assert set(bare) == {"schema", "seq", "ts", "actor", "action", "target"}
+
+
+def test_validate_event_catches_malformed_documents():
+    assert validate_event([]) == ["event is list, not an object"]
+    problems = validate_event({
+        "schema": "gordo-control-event/v0",
+        "seq": "one",
+        "ts": "yesterday",
+        "actor": "gremlin",
+        "action": "",
+        "target": 3,
+        "bonus": True,
+    })
+    joined = "\n".join(problems)
+    for needle in ("schema", "seq", "ts", "actor", "action", "target",
+                   "unknown key 'bonus'"):
+        assert needle in joined, (needle, problems)
+
+
+def test_emit_never_raises_and_counts_drops(monkeypatch, tmp_path):
+    ledger = ControlLedger(directory=str(tmp_path))
+
+    def explode(*a, **k):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(ledger, "_append_locked", explode)
+    assert ledger.emit(actor="qos", action="shed-level", target="bulk") is None
+    assert ledger.drops == 1
+    # and the kill switch drops visibly instead of half-writing
+    monkeypatch.setenv("GORDO_LEDGER", "0")
+    assert ledger.emit(actor="qos", action="shed-level", target="bulk") is None
+    assert ledger.drops == 2
+    ledger.close()
+
+
+# -- durability: reload, torn tail, byte budget -------------------------------
+
+
+def test_durable_reload_restores_history_and_sequence(tmp_path):
+    clock = FakeClock()
+    ledger = ControlLedger(directory=str(tmp_path), wall=clock)
+    for i in range(5):
+        ledger.emit(actor="reconciler", action="repair",
+                    target=f"mach-{i}", reason="applied")
+        clock.advance(10.0)
+    ledger.close()
+
+    reloaded = ControlLedger(directory=str(tmp_path), wall=clock)
+    events = reloaded.recent()
+    assert [e["seq"] for e in events] == list(range(5))
+    assert [e["target"] for e in events] == [f"mach-{i}" for i in range(5)]
+    # the sequence resumes PAST the durable tail — causal order survives
+    # a restart, readers can detect loss as a gap
+    resumed = reloaded.emit(actor="reconciler", action="repair", target="next")
+    assert resumed["seq"] == 5
+    reloaded.close()
+
+
+def test_torn_final_line_is_dropped_without_pretail_loss(tmp_path):
+    clock = FakeClock()
+    ledger = ControlLedger(directory=str(tmp_path), wall=clock)
+    for i in range(4):
+        ledger.emit(actor="rollout", action="canary", target=f"w-{i}")
+    ledger.close()
+    segment = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("seg-")
+    )[-1]
+    path = tmp_path / segment
+    data = path.read_bytes().rstrip(b"\n")
+    cut = data.rfind(b"\n") + 1
+    path.write_bytes(data[: cut + (len(data) - cut) // 2])
+
+    reloaded = ControlLedger(directory=str(tmp_path), wall=clock)
+    events = reloaded.recent()
+    # the torn record is gone, every record before it survives intact
+    assert [e["seq"] for e in events] == [0, 1, 2]
+    assert all(validate_event(e) == [] for e in events)
+    reloaded.close()
+
+
+def test_corrupt_midfile_line_skipped_tail_kept(tmp_path):
+    clock = FakeClock()
+    ledger = ControlLedger(directory=str(tmp_path), wall=clock)
+    for i in range(4):
+        ledger.emit(actor="layout", action="apply-plan", target=f"w-{i}")
+    ledger.close()
+    segment = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("seg-")
+    )[0]
+    lines = (tmp_path / segment).read_text().splitlines()
+    lines[1] = "NOT JSON AT ALL"
+    (tmp_path / segment).write_text("\n".join(lines) + "\n")
+    reloaded = ControlLedger(directory=str(tmp_path), wall=clock)
+    assert [e["seq"] for e in reloaded.recent()] == [0, 2, 3]
+    reloaded.close()
+
+
+def test_byte_budget_deletes_whole_oldest_segments(tmp_path):
+    clock = FakeClock()
+    ledger = ControlLedger(
+        directory=str(tmp_path), wall=clock,
+        segment_limit=512, budget=1500,
+    )
+    for i in range(60):
+        ledger.emit(actor="breaker", action="breaker-open",
+                    target=f"mach-{i:04d}", reason="x" * 32)
+        clock.advance(1.0)
+    assert ledger.rotations > 0
+    segments = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("seg-")
+    )
+    assert "seg-00000000.jsonl" not in segments  # oldest really deleted
+    on_disk = sum(os.path.getsize(tmp_path / f) for f in segments)
+    assert on_disk == ledger.total_bytes() <= 1500 + 512
+    # the survivors are still a CONTIGUOUS seq run (suffix, not sieve)
+    seqs = [e["seq"] for e in ledger.recent()]
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+    ledger.close()
+
+
+def test_recent_filters_by_window_and_limit():
+    clock = FakeClock(start=0.0)
+    ledger = ControlLedger(directory=None, wall=clock)
+    for _ in range(10):
+        ledger.emit(actor="qos", action="shed-level", target="bulk")
+        clock.advance(60.0)
+    assert len(ledger.recent()) == 10
+    assert len(ledger.recent(window=150.0, now=clock.now)) == 2
+    assert [e["seq"] for e in ledger.recent(limit=3)] == [7, 8, 9]
+    assert ledger.recent(window=0.0, now=clock.now + 1) == []
+
+
+def test_emit_is_thread_safe_under_concurrent_writers(tmp_path):
+    ledger = ControlLedger(directory=str(tmp_path))
+
+    def writer(actor):
+        for _ in range(50):
+            ledger.emit(actor=actor, action="decision", target="x")
+
+    threads = [
+        threading.Thread(target=writer, args=(a,))
+        for a in ("autopilot", "reconciler", "qos", "rollout")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = ledger.recent()
+    assert len(events) == 200
+    assert sorted(e["seq"] for e in events) == list(range(200))
+    ledger.close()
+
+
+def test_configure_replays_boot_buffer_into_durable_ledger(tmp_path, monkeypatch):
+    # events emitted BEFORE the serving role attaches its durable dir
+    # (e.g. run-server --faults activates the plan at CLI-parse time)
+    # must survive the configure() swap — the chaos drill is the
+    # correlator's strongest candidate and must not vanish at boot
+    monkeypatch.setattr(ledger_mod, "LEDGER", ControlLedger(directory=None))
+    ledger_mod.emit(actor="faults", action="inject-plan",
+                    target="engine-dispatch:*", reason="latency:0.3")
+    boot_ts = ledger_mod.LEDGER.recent()[0]["ts"]
+    durable = ledger_mod.configure(str(tmp_path))
+    try:
+        events = durable.recent()
+        assert [e["action"] for e in events] == ["inject-plan"]
+        assert events[0]["ts"] == boot_ts  # original timestamp kept
+        assert ledger_mod.validate_event(events[0]) == []
+        # and it is durable: a fresh reload sees it
+        reloaded = ControlLedger(directory=str(tmp_path))
+        assert [e["target"] for e in reloaded.recent()] == ["engine-dispatch:*"]
+        reloaded.close()
+        # a durable→durable switch does NOT replay (history already
+        # lives in the old directory — replaying would duplicate it)
+        other = ledger_mod.configure(str(tmp_path / "other"))
+        assert other.recent() == []
+        other.close()
+    finally:
+        durable.close()
+        monkeypatch.setattr(ledger_mod, "LEDGER", ControlLedger(directory=None))
+
+
+# -- root-cause ranking -------------------------------------------------------
+
+
+def _event(actor, action, target, ts, reason=""):
+    return {
+        "schema": ledger_mod.SCHEMA, "seq": int(ts), "ts": ts,
+        "actor": actor, "action": action, "target": target,
+        "reason": reason,
+    }
+
+
+def test_rank_candidates_orders_fault_over_innocent_autopilot():
+    """The smoke's acceptance shape, in miniature: an activated fault
+    plan outranks an equally-recent autopilot hold, and breach events
+    never rank themselves."""
+    breach_ts = 1000.0
+    events = [
+        _event("autopilot", "decision", "GORDO_MAX_INFLIGHT", 995.0,
+               reason="down: deliberate"),
+        _event("faults", "inject-plan", "engine-dispatch:*", 996.0,
+               reason="latency:0.4"),
+        _event("qos", "shed-level", "bulk", 990.0),
+        _event("slo", "breach", "scoring-latency", 999.0),
+    ]
+    crossing = {"objective": "scoring-latency", "window": "fast"}
+    ranked = incidents.rank_candidates(events, crossing, breach_ts)
+    assert [c["actor"] for c in ranked] == ["faults", "qos", "autopilot"]
+    assert ranked[0]["action"] == "inject-plan"
+    assert all(c["actor"] != "slo" for c in ranked)
+
+
+def test_rank_candidates_weighs_proximity_and_overlap():
+    breach_ts = 1000.0
+    # same action, same weight: the closer event wins…
+    near = _event("reconciler", "repair", "mach-a", 990.0)
+    far = _event("reconciler", "repair", "mach-b", 700.0)
+    ranked = incidents.rank_candidates(
+        [far, near], {"objective": "latency"}, breach_ts
+    )
+    assert [c["target"] for c in ranked] == ["mach-a", "mach-b"]
+    # …and token overlap with the objective multiplies the score
+    plain = _event("rollout", "sweep", "fleet", 990.0)
+    related = _event("rollout", "sweep", "scoring-pool", 990.0)
+    ranked = incidents.rank_candidates(
+        [plain, related], {"objective": "scoring-latency"}, breach_ts
+    )
+    assert ranked[0]["target"] == "scoring-pool"
+    assert ranked[0]["score"] == pytest.approx(
+        ranked[1]["score"] * 1.5, rel=1e-3  # scores round to 4 places
+    )
+    # events AFTER the breach cannot have caused it
+    future = _event("rollout", "sweep", "fleet", breach_ts + 30.0)
+    assert incidents.rank_candidates(
+        [future], {"objective": "latency"}, breach_ts
+    ) == []
+
+
+# -- the correlator -----------------------------------------------------------
+
+
+def _correlator(ledger, clock, **kwargs):
+    defaults = dict(
+        ledger=ledger, lookback=600.0, cooldown=120.0, keep=4,
+        wall=clock, role="test",
+    )
+    defaults.update(kwargs)
+    return incidents.IncidentCorrelator(**defaults)
+
+
+def _crossing(objective="scoring-latency"):
+    return {"objective": objective, "window": "fast", "burn_rate": 20.0}
+
+
+def test_breach_writes_durable_report_with_context(tmp_path):
+    clock = FakeClock()
+    ledger = ControlLedger(directory=None, wall=clock)
+    ledger.emit(actor="faults", action="inject-plan",
+                target="engine-dispatch:*", reason="latency:0.4")
+    correlator = _correlator(
+        ledger, clock, directory=str(tmp_path),
+        spec_revision=lambda: 42,
+        layout_fingerprint=lambda: "plan-abc",
+    )
+    report = correlator.on_breach(_crossing())
+    assert report is not None
+    assert report["schema"] == incidents.SCHEMA
+    assert report["spec_revision"] == 42
+    assert report["layout"] == "plan-abc"
+    assert report["trigger"]["objective"] == "scoring-latency"
+    assert report["candidates"][0]["actor"] == "faults"
+    on_disk = json.loads(
+        (tmp_path / f"incident-{report['id']}.json").read_text()
+    )
+    assert on_disk == report
+    summary = correlator.list()[0]
+    assert summary["id"] == report["id"]
+    assert summary["top_candidate"]["actor"] == "faults"
+    assert correlator.get(report["id"]) == report
+
+
+def test_cooldown_suppresses_flapping_objective(tmp_path):
+    clock = FakeClock()
+    ledger = ControlLedger(directory=None, wall=clock)
+    correlator = _correlator(ledger, clock, directory=str(tmp_path),
+                             cooldown=120.0)
+    assert correlator.on_breach(_crossing()) is not None
+    clock.advance(30.0)  # same objective, inside the cooldown
+    assert correlator.on_breach(_crossing()) is None
+    assert correlator.suppressed == 1
+    # a DIFFERENT objective is its own cooldown track
+    assert correlator.on_breach(_crossing("availability")) is not None
+    clock.advance(121.0)  # past the cooldown: reports again
+    assert correlator.on_breach(_crossing()) is not None
+    assert len(correlator.list()) == 3
+
+
+def test_keep_bound_trims_oldest_reports_and_files(tmp_path):
+    clock = FakeClock()
+    ledger = ControlLedger(directory=None, wall=clock)
+    correlator = _correlator(ledger, clock, directory=str(tmp_path),
+                             cooldown=0.0, keep=3)
+    ids = []
+    for _ in range(5):
+        report = correlator.on_breach(_crossing())
+        ids.append(report["id"])
+        clock.advance(10.0)
+    kept = [s["id"] for s in correlator.list()]
+    assert kept == list(reversed(ids[-3:]))  # newest first, bounded
+    files = sorted(f for f in os.listdir(tmp_path) if f.endswith(".json"))
+    assert files == sorted(f"incident-{i}.json" for i in ids[-3:])
+
+
+def test_correlator_reloads_durable_reports(tmp_path):
+    clock = FakeClock()
+    ledger = ControlLedger(directory=None, wall=clock)
+    correlator = _correlator(ledger, clock, directory=str(tmp_path),
+                             cooldown=0.0)
+    first = correlator.on_breach(_crossing())
+    clock.advance(50.0)
+    second = correlator.on_breach(_crossing())
+
+    rebooted = _correlator(ledger, clock, directory=str(tmp_path),
+                           cooldown=0.0)
+    assert [s["id"] for s in rebooted.list()] == [second["id"], first["id"]]
+    # the incident counter resumes past the reloaded reports, so new
+    # ids cannot collide with durable ones
+    clock.advance(50.0)
+    third = rebooted.on_breach(_crossing())
+    assert third["n"] > second["n"]
+
+
+def test_on_breach_never_raises_into_the_slo_tick(tmp_path):
+    clock = FakeClock()
+
+    class ExplodingWarehouse:
+        def window_view(self, *a, **k):
+            raise RuntimeError("warehouse on fire")
+
+    ledger = ControlLedger(directory=None, wall=clock)
+    correlator = _correlator(
+        ledger, clock, directory=str(tmp_path),
+        warehouse=ExplodingWarehouse(),
+        spec_revision=lambda: (_ for _ in ()).throw(RuntimeError("no")),
+    )
+    report = correlator.on_breach(_crossing())
+    # degraded providers degrade the REPORT, never the breach path
+    assert report is not None
+    assert report["metric_deltas"] == {}
+    assert report["spec_revision"] is None
+
+
+def test_metric_deltas_ranks_largest_movers():
+    class Warehouse:
+        def window_view(self, window, now_wall=None):
+            if window < 600:  # the recent window
+                return {"rates": {
+                    "gordo_server_errors_total": {"total": 9.0},
+                    "gordo_server_requests_total": {"total": 10.0},
+                    "gordo_quiet_total": {"total": 0.0},
+                }}
+            return {"rates": {  # the lookback baseline
+                "gordo_server_errors_total": {"total": 1.0},
+                "gordo_server_requests_total": {"total": 10.0},
+                "gordo_quiet_total": {"total": 0.0},
+            }}
+
+    deltas = incidents.metric_deltas(Warehouse(), lookback=600.0, now=0.0)
+    movers = deltas["movers"]
+    assert movers[0]["metric"] == "gordo_server_errors_total"
+    assert movers[0]["ratio"] == pytest.approx(9.0)
+    names = [m["metric"] for m in movers]
+    assert "gordo_quiet_total" not in names  # flat-zero series elided
+    assert incidents.metric_deltas(None, 600.0) == {}
+
+
+# -- SLO breach edge -> ledger event + hook -----------------------------------
+
+
+def test_slo_breach_edge_emits_ledger_event_and_fires_hook(monkeypatch):
+    registry = Registry()
+    clock = FakeClock()
+    ledger = ControlLedger(directory=None, wall=clock)
+    monkeypatch.setattr(ledger_mod, "LEDGER", ledger)
+    hooked = []
+    evaluator = slo.SLOEvaluator(
+        slo.server_objectives(), registry=registry, clock=clock,
+        recorder=flightrec.FlightRecorder(enabled=True),
+        fast_window=300.0, slow_window=3600.0,
+        fast_burn=14.4, slow_burn=6.0, min_interval=0.0,
+        breach_hook=hooked.append,
+    )
+    # AFTER the constructor's baseline tick: every request blows 250ms
+    hist = registry.histogram(
+        "gordo_server_request_duration_seconds", "lat",
+        labels=("endpoint",),
+    )
+    counter = registry.counter(
+        "gordo_server_requests_total", "reqs",
+        labels=("endpoint", "status"),
+    )
+    for _ in range(50):
+        hist.labels("anomaly").observe(5.0)
+        counter.labels("anomaly", "200").inc()
+    clock.advance(60.0)
+    crossings = evaluator.tick()["crossings"]
+    assert crossings, "the saturated latency objective must breach"
+    breaches = [
+        e for e in ledger.recent()
+        if e["actor"] == "slo" and e["action"] == "breach"
+    ]
+    assert len(breaches) == len(crossings)
+    assert all(validate_event(e) == [] for e in breaches)
+    assert breaches[0]["target"] == crossings[0]["objective"]
+    assert [c["objective"] for c in hooked] == [
+        c["objective"] for c in crossings
+    ]
+    # the breach is an EDGE: a second tick while still burning is silent
+    clock.advance(30.0)
+    evaluator.tick()
+    assert len(hooked) == len(crossings)
+
+
+def test_breach_hook_exception_does_not_break_the_tick():
+    registry = Registry()
+    clock = FakeClock()
+    evaluator = slo.SLOEvaluator(
+        slo.server_objectives(), registry=registry, clock=clock,
+        recorder=flightrec.FlightRecorder(enabled=True),
+        fast_window=300.0, slow_window=3600.0,
+        fast_burn=14.4, slow_burn=6.0, min_interval=0.0,
+        breach_hook=lambda crossing: (_ for _ in ()).throw(
+            RuntimeError("correlator on fire")
+        ),
+    )
+    hist = registry.histogram(
+        "gordo_server_request_duration_seconds", "lat",
+        labels=("endpoint",),
+    )
+    counter = registry.counter(
+        "gordo_server_requests_total", "reqs",
+        labels=("endpoint", "status"),
+    )
+    for _ in range(50):
+        hist.labels("anomaly").observe(5.0)
+        counter.labels("anomaly", "200").inc()
+    clock.advance(60.0)
+    crossings = evaluator.tick()["crossings"]  # must not raise
+    assert crossings

@@ -186,6 +186,96 @@ def test_reload_skips_corrupt_midfile_line(tmp_path):
     wh2.close()
 
 
+# -- /telemetry ?window= edge queries (ISSUE 20 satellite) --------------------
+
+
+def test_window_query_covering_no_records_answers_empty(tmp_path):
+    """A window too recent to cover any tick (the scrape raced the
+    snapshotter) is a well-formed EMPTY answer — zero coverage, zero
+    rates, no division by the empty window."""
+    clock = FakeClock()
+    registry = Registry()
+    counter = registry.counter("gordo_server_requests_total", "reqs")
+    wh = _warehouse(tmp_path, clock, registry)
+    counter.labels().inc(10)
+    clock.advance(30.0)
+    wh.tick()
+    clock.advance(500.0)  # a long quiet gap, then a tiny trailing window
+    view = wh.view(window=1.0)
+    assert view["window"]["records"] == 0
+    assert view["window"]["coverage_s"] == 0
+    assert view["window"]["rates"] == {}
+    assert view["window"]["histograms"] == {}
+    rate = wh.rate("gordo_server_requests_total", window=1.0)
+    assert rate == {"total": 0.0, "series": {}, "coverage_s": 0.0}
+    wh.close()
+
+
+def test_window_query_older_than_retained_history(tmp_path):
+    """A window reaching past what the byte budget retained answers
+    from the SURVIVING records only — coverage reports what the answer
+    actually stands on, so a caller can see the window was clipped."""
+    clock = FakeClock()
+    registry = Registry()
+    counter = registry.counter("gordo_server_requests_total", "reqs",
+                               labels=("endpoint",))
+    wh = _warehouse(
+        tmp_path, clock, registry, segment_limit=512, budget=1500
+    )
+    n_ticks = 40
+    for _ in range(n_ticks):
+        counter.labels("anomaly").inc(10)
+        clock.advance(10.0)
+        wh.tick()
+    retained = wh.view(window=10.0 * n_ticks * 2)["warehouse"]["records"]
+    assert 0 < retained < n_ticks  # the budget really trimmed segments
+    # ask for the FULL history anyway: the answer covers only retained
+    # ticks, and the rate math divides by covered time, not the ask
+    view = wh.view(window=10.0 * n_ticks * 2)
+    assert view["window"]["records"] == retained
+    assert view["window"]["coverage_s"] == pytest.approx(10.0 * retained)
+    rate = view["window"]["rates"]["gordo_server_requests_total"]
+    assert rate["total"] == pytest.approx(1.0)  # 10 per 10s tick
+    wh.close()
+
+
+def test_window_query_spans_torn_tail_recovered_boundary(tmp_path):
+    """A window straddling a crash-recovered segment boundary: records
+    on BOTH sides of the torn tail fold into one answer, the half
+    record from the crash contributes nothing."""
+    clock = FakeClock()
+    registry = Registry()
+    counter = registry.counter("gordo_server_requests_total", "reqs")
+    wh = _warehouse(tmp_path, clock, registry)
+    for _ in range(6):
+        counter.labels().inc(30)
+        clock.advance(30.0)
+        wh.tick()
+    wh.close()
+    segments = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("seg-")
+    )
+    with open(tmp_path / segments[-1], "a") as fh:
+        fh.write('{"v": 1, "t": 99999.0, "dt": 30.0, "c": {"gordo')
+
+    clock2 = FakeClock(start=clock.now)
+    registry2 = Registry()
+    wh2 = _warehouse(tmp_path, clock2, registry2)
+    counter2 = registry2.counter("gordo_server_requests_total", "reqs")
+    for _ in range(4):
+        counter2.labels().inc(30)
+        clock2.advance(30.0)
+        wh2.tick()
+    # 10 whole records (6 pre-crash + 4 post-recovery) in one window
+    # spanning the recovered boundary; the torn line is not a record
+    view = wh2.view(window=30.0 * 20)
+    assert view["window"]["records"] == 10
+    rate = view["window"]["rates"]["gordo_server_requests_total"]
+    assert rate["total"] == pytest.approx(1.0)
+    assert view["window"]["coverage_s"] == pytest.approx(300.0)
+    wh2.close()
+
+
 # -- sketch correctness on Zipf traffic ---------------------------------------
 
 
